@@ -1,0 +1,27 @@
+"""photon_ml_tpu — a TPU-native framework for GLMs and GAME mixed-effect models.
+
+A ground-up JAX/XLA re-design with the capabilities of LinkedIn Photon-ML
+(reference: jinyu0310/photon-ml, Spark/Scala): generalized linear models
+(linear / logistic / Poisson regression, smoothed-hinge linear SVM) with
+L1/L2/elastic-net regularization, box constraints, feature normalization,
+offsets, feature summarization and diagnostics — plus GAME (Generalized
+Additive Mixed Effects): coordinate descent over a fixed-effect GLM, many
+per-entity random-effect GLMs, and factored random effects, sharded over a
+TPU device mesh instead of Spark partitions.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- ``parallel/``   device mesh + sharding policy (replaces Spark runtime)
+- ``data/``       columnar device batches, GAME datasets, entity blocking
+- ``ops/``        pointwise losses + fused objective kernels (XLA-fused)
+- ``optimize/``   L-BFGS / OWL-QN / TRON as jitted lax.while_loop kernels
+- ``game/``       coordinate descent, fixed/random/factored coordinates
+- ``models/``     coefficient containers + GLM / GAME model families
+- ``evaluation/`` metrics and (sharded) evaluators
+- ``projector/``  per-entity dimension reduction
+- ``io/``         Avro object-container codec, model serialization, LibSVM
+- ``cli/``        training / scoring / indexing drivers
+- ``diagnostics/`` bootstrap, fitting, HL, importance, reporting
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,211 @@
+"""Device-resident batch representations of labeled GLM data.
+
+TPU-native replacement for the reference's ``LabeledPoint`` rows
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/data/
+LabeledPoint.scala:29-44 — (label, sparse features, offset, weight) with
+``computeMargin = x.w + offset``). Where the reference streams rows through
+Spark closures, we hold the whole shard as columnar arrays so the margin is
+one matmul on the MXU.
+
+Two layouts:
+
+- :class:`DenseBatch` — features as a dense ``[N, D]`` matrix. Right for
+  narrow-to-medium feature spaces (the reference densifies per-entity blocks
+  the same way after projection).
+- :class:`EllBatch`  — padded row-sparse (ELL) layout: ``indices``/``values``
+  of shape ``[N, K]`` with ``K`` = max nnz per row, padded entries pointing at
+  a dummy column with value 0. Margins via gather + row-sum; gradients via
+  scatter-add (segment-sum). Right for wide sparse spaces (reference policy
+  switches representation around 200k features; SURVEY §7 hard-part 5).
+
+Both carry ``labels``, ``offsets``, ``weights`` (length N) and are registered
+pytrees so they cross ``jit``/``pjit`` boundaries and shard over the mesh data
+axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+class DenseBatch(NamedTuple):
+    """Columnar dense design matrix plus per-row metadata."""
+
+    X: Array  # [N, D]
+    labels: Array  # [N]
+    offsets: Array  # [N]
+    weights: Array  # [N]  (0 for padded rows => they drop out of every sum)
+
+    @property
+    def num_features(self) -> int:
+        return self.X.shape[-1]
+
+    def _acc_dtype(self):
+        # Accumulate bf16/f16 data in f32 on the MXU; never downcast f64.
+        return jnp.promote_types(self.X.dtype, jnp.float32)
+
+    def margins(self, w_eff: Array, margin_shift: Array) -> Array:
+        """x_i . w_eff + margin_shift + offset_i, batched on the MXU."""
+        return (
+            jnp.einsum(
+                "nd,d->n", self.X, w_eff, preferred_element_type=self._acc_dtype()
+            )
+            + margin_shift
+            + self.offsets
+        )
+
+    def weighted_feature_sum(self, row_scalars: Array) -> Array:
+        """sum_i row_scalars_i * x_i — the gradient's vector sum (X^T r)."""
+        return jnp.einsum(
+            "nd,n->d", self.X, row_scalars, preferred_element_type=self._acc_dtype()
+        )
+
+    def hadamard_square_sum(self, row_scalars: Array) -> Array:
+        """sum_i row_scalars_i * x_i**2 — Hessian-diagonal inner sum."""
+        return jnp.einsum(
+            "nd,n->d", self.X * self.X, row_scalars,
+            preferred_element_type=self._acc_dtype(),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+class EllBatch:
+    """Padded row-sparse (ELL) design matrix.
+
+    Padded slots must satisfy ``values == 0`` (their index value is then
+    irrelevant for margins; for scatter ops we still route them to a real
+    column but the zero value contributes nothing).
+
+    ``dim`` is static pytree aux data (not a leaf): ``segment_sum`` needs a
+    concrete ``num_segments`` under jit, so crossing a jit/pjit boundary must
+    not trace it.
+    """
+
+    def __init__(self, indices: Array, values: Array, labels: Array,
+                 offsets: Array, weights: Array, dim: int):
+        self.indices = indices  # [N, K] int32
+        self.values = values  # [N, K]
+        self.labels = labels  # [N]
+        self.offsets = offsets  # [N]
+        self.weights = weights  # [N]
+        self.dim = dim  # D, static
+
+    def tree_flatten(self):
+        return ((self.indices, self.values, self.labels, self.offsets,
+                 self.weights), self.dim)
+
+    @classmethod
+    def tree_unflatten(cls, dim, leaves):
+        return cls(*leaves, dim=dim)
+
+    def _replace(self, **kw):
+        fields = dict(indices=self.indices, values=self.values,
+                      labels=self.labels, offsets=self.offsets,
+                      weights=self.weights, dim=self.dim)
+        fields.update(kw)
+        return EllBatch(**fields)
+
+    @property
+    def num_features(self) -> int:
+        return self.dim
+
+    def margins(self, w_eff: Array, margin_shift: Array) -> Array:
+        gathered = w_eff[self.indices]  # [N, K]
+        return (
+            jnp.sum(gathered * self.values, axis=-1) + margin_shift + self.offsets
+        )
+
+    def weighted_feature_sum(self, row_scalars: Array) -> Array:
+        contrib = self.values * row_scalars[:, None]  # [N, K]
+        return jax.ops.segment_sum(
+            contrib.reshape(-1), self.indices.reshape(-1), num_segments=self.dim
+        )
+
+    def hadamard_square_sum(self, row_scalars: Array) -> Array:
+        contrib = (self.values * self.values) * row_scalars[:, None]
+        return jax.ops.segment_sum(
+            contrib.reshape(-1), self.indices.reshape(-1), num_segments=self.dim
+        )
+
+
+Batch = Union[DenseBatch, EllBatch]
+
+
+def dense_batch(
+    X: np.ndarray,
+    labels: np.ndarray,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+    dtype=jnp.float32,
+) -> DenseBatch:
+    n = X.shape[0]
+    return DenseBatch(
+        X=jnp.asarray(X, dtype=dtype),
+        labels=jnp.asarray(labels, dtype=jnp.float32),
+        offsets=jnp.zeros(n, jnp.float32)
+        if offsets is None
+        else jnp.asarray(offsets, jnp.float32),
+        weights=jnp.ones(n, jnp.float32)
+        if weights is None
+        else jnp.asarray(weights, jnp.float32),
+    )
+
+
+def ell_from_rows(
+    rows: list[tuple[np.ndarray, np.ndarray]],
+    dim: int,
+    labels: np.ndarray,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+    pad_to_multiple: int = 8,
+) -> EllBatch:
+    """Build an ELL batch from per-row (indices, values) sparse rows.
+
+    K is padded up to a multiple of ``pad_to_multiple`` to stabilize compiled
+    shapes across similar batches.
+    """
+    n = len(rows)
+    k = max((len(ix) for ix, _ in rows), default=1)
+    k = max(1, -(-k // pad_to_multiple) * pad_to_multiple)
+    indices = np.zeros((n, k), dtype=np.int32)
+    values = np.zeros((n, k), dtype=np.float32)
+    for i, (ix, v) in enumerate(rows):
+        indices[i, : len(ix)] = ix
+        values[i, : len(v)] = v
+    return EllBatch(
+        indices=jnp.asarray(indices),
+        values=jnp.asarray(values),
+        labels=jnp.asarray(labels, jnp.float32),
+        offsets=jnp.zeros(n, jnp.float32)
+        if offsets is None
+        else jnp.asarray(offsets, jnp.float32),
+        weights=jnp.ones(n, jnp.float32)
+        if weights is None
+        else jnp.asarray(weights, jnp.float32),
+        dim=dim,
+    )
+
+
+def pad_batch(batch: DenseBatch, target_rows: int) -> DenseBatch:
+    """Zero-pad a dense batch to ``target_rows`` rows (weights 0 => no-op rows).
+
+    Used to make shard sizes uniform before placing a batch on a device mesh.
+    """
+    n = batch.X.shape[0]
+    if n == target_rows:
+        return batch
+    if n > target_rows:
+        raise ValueError(f"batch has {n} rows > target {target_rows}")
+    pad = target_rows - n
+    return DenseBatch(
+        X=jnp.pad(batch.X, ((0, pad), (0, 0))),
+        labels=jnp.pad(batch.labels, (0, pad)),
+        offsets=jnp.pad(batch.offsets, (0, pad)),
+        weights=jnp.pad(batch.weights, (0, pad)),
+    )

@@ -1,0 +1,171 @@
+"""Feature normalization algebra.
+
+TPU-native re-design of the reference's ``NormalizationContext``
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/normalization/
+NormalizationContext.scala:46-140) and the ``NormalizationType`` enum
+(normalization/NormalizationType.java).
+
+The key trick carried over verbatim (SURVEY §3.4): training data is *never*
+transformed. Instead the objective evaluates margins with *effective*
+coefficients:
+
+    w_eff        = w * factors                      (elementwise)
+    margin_shift = -(w_eff . shifts)
+    margin_i     = x_i . w_eff + margin_shift + offset_i
+
+and the gradient in normalized space is reconstructed from plain sums over
+raw features:
+
+    grad_j = factors_j * (sum_i w_i l'_i x_ij  -  shifts_j * sum_i w_i l'_i)
+
+(reference ValueAndGradientAggregator.scala:34-221). On TPU both sums are a
+single fused matmul + reduction, so normalization costs one extra elementwise
+multiply — no densification, no data copy.
+
+``transform_model_coefficients`` maps a model trained in normalized space back
+to the original feature space (NormalizationContext.scala: model back-
+transform), keeping the intercept consistent:
+
+    w_orig_j     = w_j * factors_j                   (j != intercept)
+    b_orig       = b - sum_j w_j * factors_j * shifts_j
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+class NormalizationType(enum.Enum):
+    """Mirror of normalization/NormalizationType.java."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """Optional per-feature multiplicative factors and additive shifts.
+
+    ``factors`` and ``shifts`` are length-D device arrays or ``None`` (the
+    identity). ``intercept_index`` marks the intercept column: it never gets a
+    shift and its factor is fixed to 1, matching the reference where the
+    intercept is excluded from both (NormalizationContext.scala:46-93).
+
+    Registered as a pytree (arrays are leaves; ``intercept_index`` is static)
+    so objectives carrying a context cross jit/pjit boundaries.
+    """
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+    intercept_index: Optional[int] = None
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def identity() -> "NormalizationContext":
+        return NormalizationContext()
+
+    @staticmethod
+    def build(
+        norm_type: NormalizationType,
+        summary: "object",
+        intercept_index: Optional[int] = None,
+    ) -> "NormalizationContext":
+        """Build from a feature summary (stat/BasicStatisticalSummary analog).
+
+        ``summary`` must expose ``mean``, ``variance`` and ``max_magnitude``
+        per-feature arrays (see photon_ml_tpu.stat.summary). Reference factor
+        definitions (NormalizationContext.scala:95-140):
+          - SCALE_WITH_STANDARD_DEVIATION: factor = 1/std
+          - SCALE_WITH_MAX_MAGNITUDE:      factor = 1/max|x|
+          - STANDARDIZATION:               factor = 1/std, shift = mean
+        Zero std / zero magnitude features get factor 1 (no scaling), matching
+        the reference's guard against division by zero.
+        """
+        if norm_type == NormalizationType.NONE:
+            return NormalizationContext(intercept_index=intercept_index)
+
+        def _safe_inv(x: np.ndarray) -> np.ndarray:
+            x = np.asarray(x, dtype=np.float64)
+            return np.where(x > 0.0, 1.0 / np.maximum(x, 1e-300), 1.0)
+
+        factors = None
+        shifts = None
+        if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+            factors = _safe_inv(np.sqrt(np.asarray(summary.variance)))
+        elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+            factors = _safe_inv(np.asarray(summary.max_magnitude))
+        elif norm_type == NormalizationType.STANDARDIZATION:
+            factors = _safe_inv(np.sqrt(np.asarray(summary.variance)))
+            shifts = np.asarray(summary.mean, dtype=np.float64).copy()
+        else:
+            raise ValueError(f"unsupported normalization type {norm_type}")
+
+        if intercept_index is not None:
+            factors[intercept_index] = 1.0
+            if shifts is not None:
+                shifts[intercept_index] = 0.0
+        return NormalizationContext(
+            factors=jnp.asarray(factors, dtype=jnp.float32),
+            shifts=jnp.asarray(shifts, dtype=jnp.float32)
+            if shifts is not None
+            else None,
+            intercept_index=intercept_index,
+        )
+
+    # -- algebra -------------------------------------------------------------
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def effective_coefficients(self, coef: Array) -> tuple[Array, Array]:
+        """Return (w_eff, margin_shift) for margin evaluation."""
+        w_eff = coef if self.factors is None else coef * self.factors
+        if self.shifts is None:
+            margin_shift = jnp.zeros((), dtype=coef.dtype)
+        else:
+            margin_shift = -jnp.dot(w_eff, self.shifts)
+        return w_eff, margin_shift
+
+    def reconstruct_gradient(self, vector_sum: Array, prefactor_sum: Array) -> Array:
+        """grad_j = factors_j * (vector_sum_j - shifts_j * prefactor_sum)."""
+        g = vector_sum
+        if self.shifts is not None:
+            g = g - self.shifts * prefactor_sum
+        if self.factors is not None:
+            g = g * self.factors
+        return g
+
+    def transform_model_coefficients(self, coef: Array) -> Array:
+        """Normalized-space model -> original-space model."""
+        if self.is_identity:
+            return coef
+        w = coef if self.factors is None else coef * self.factors
+        if self.shifts is not None and self.intercept_index is not None:
+            # intercept factor is 1 by construction, so w[intercept] == b;
+            # absorb the shift term into it: b_orig = b - w_eff . shifts.
+            w = w.at[self.intercept_index].add(-jnp.dot(w, self.shifts))
+        elif self.shifts is not None:
+            raise ValueError(
+                "STANDARDIZATION requires an intercept column to absorb shifts"
+            )
+        return w
+
+
+import jax  # noqa: E402  (registration tail)
+
+jax.tree_util.register_dataclass(
+    NormalizationContext,
+    data_fields=["factors", "shifts"],
+    meta_fields=["intercept_index"],
+)

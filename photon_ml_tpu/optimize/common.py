@@ -1,0 +1,161 @@
+"""Shared optimizer structures: convergence reasons, results, box projection.
+
+TPU-native re-design of the reference's ``Optimizer`` state machine
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/optimization/
+Optimizer.scala:39-245). The reference mutates driver-side state per
+iteration; here each solver is one jitted ``lax.while_loop`` whose carry holds
+(x, value, gradient, history) in device arrays, and convergence reasons are
+re-derived from the recorded history exactly as Optimizer.scala:156-170 does:
+
+- MaxIterations:            iter >= max_iter
+- ObjectiveNotImproving:    the last iteration failed to produce a new state
+- FunctionValuesConverged:  |f_k - f_{k-1}| <= tol * f_0
+- GradientConverged:        ||g_k||_2 <= tol * ||g_0||_2
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+class ConvergenceReason(enum.Enum):
+    MAX_ITERATIONS = "MaxIterations"
+    OBJECTIVE_NOT_IMPROVING = "ObjectiveNotImproving"
+    FUNCTION_VALUES_CONVERGED = "FunctionValuesConverged"
+    GRADIENT_CONVERGED = "GradientConverged"
+
+
+class BoxConstraints(NamedTuple):
+    """Elementwise [lower, upper] bounds; +-inf for unconstrained coords.
+
+    Replaces OptimizationUtils.projectCoefficientsToHypercube — the reference
+    projects iterates onto the hypercube after each optimizer step
+    (optimization/LBFGS.scala:42-150, TRON.scala accept branch).
+    """
+
+    lower: Array
+    upper: Array
+
+    @staticmethod
+    def from_map(dim: int, constraint_map: Optional[dict[int, tuple[float, float]]]):
+        if not constraint_map:
+            return None
+        lower = np.full(dim, -np.inf)
+        upper = np.full(dim, np.inf)
+        for idx, (lo, hi) in constraint_map.items():
+            lower[idx], upper[idx] = lo, hi
+        # Full-precision bounds; project_box casts to the iterate dtype.
+        return BoxConstraints(jnp.asarray(lower), jnp.asarray(upper))
+
+
+def project_box(x: Array, box: Optional[BoxConstraints]) -> Array:
+    if box is None:
+        return x
+    return jnp.clip(x, box.lower.astype(x.dtype), box.upper.astype(x.dtype))
+
+
+class RunHistory(NamedTuple):
+    """Fixed-shape device-side record of the optimization trajectory.
+
+    ``values[k]`` / ``grad_norms[k]`` hold f and ||g|| *after* iteration k
+    (k=0 is the initial state); slots beyond ``num_iterations`` are NaN.
+    Feeds OptimizationStatesTracker (ring buffer of at most 100 states,
+    reference OptimizationStatesTracker.scala:31-98) host-side.
+    """
+
+    values: Array  # [max_iter + 1]
+    grad_norms: Array  # [max_iter + 1]
+    num_iterations: Array  # scalar int32: last completed iteration index
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationResult:
+    """Host-side summary of one solver run."""
+
+    coefficients: Array
+    value: float
+    grad_norm: float
+    iterations: int
+    convergence_reason: ConvergenceReason
+    values: np.ndarray  # trajectory f_0..f_k
+    grad_norms: np.ndarray  # trajectory ||g_0||..||g_k||
+
+    @staticmethod
+    def from_history(
+        coefficients: Array,
+        history: RunHistory,
+        max_iter: int,
+        tolerance: float,
+        made_progress_last_iter: bool = True,
+    ) -> "OptimizationResult":
+        k = int(history.num_iterations)
+        values = np.asarray(history.values)[: k + 1]
+        grad_norms = np.asarray(history.grad_norms)[: k + 1]
+        reason = _convergence_reason(
+            k, values, grad_norms, max_iter, tolerance, made_progress_last_iter
+        )
+        return OptimizationResult(
+            coefficients=coefficients,
+            value=float(values[-1]),
+            grad_norm=float(grad_norms[-1]),
+            iterations=k,
+            convergence_reason=reason,
+            values=values,
+            grad_norms=grad_norms,
+        )
+
+
+def _convergence_reason(
+    k: int,
+    values: np.ndarray,
+    grad_norms: np.ndarray,
+    max_iter: int,
+    tolerance: float,
+    made_progress_last_iter: bool,
+) -> ConvergenceReason:
+    """Port of Optimizer.getConvergenceReason (Optimizer.scala:156-170)."""
+    if k >= max_iter:
+        return ConvergenceReason.MAX_ITERATIONS
+    if not made_progress_last_iter:
+        return ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+    if k >= 1 and abs(values[-1] - values[-2]) <= tolerance * abs(values[0]):
+        return ConvergenceReason.FUNCTION_VALUES_CONVERGED
+    if grad_norms[-1] <= tolerance * grad_norms[0]:
+        return ConvergenceReason.GRADIENT_CONVERGED
+    # Loop exited without tripping a criterion (shouldn't happen, but keep a
+    # total function): classify by the strongest signal available.
+    return ConvergenceReason.FUNCTION_VALUES_CONVERGED
+
+
+def should_continue(
+    it: Array,
+    value: Array,
+    prev_value: Array,
+    grad_norm: Array,
+    init_value: Array,
+    init_grad_norm: Array,
+    max_iter: int,
+    tolerance: float,
+    made_progress: Array,
+) -> Array:
+    """jit-side mirror of the host convergence check (Optimizer.scala:156-170).
+
+    Iteration 0 (prev_value == init_value sentinel) always continues.
+    """
+    not_done = (
+        (it < max_iter)
+        & made_progress
+        & (jnp.abs(value - prev_value) > tolerance * jnp.abs(init_value))
+        & (grad_norm > tolerance * init_grad_norm)
+    )
+    # Iteration 0 runs unless already at a stationary point (zero initial
+    # gradient) — a warm start at the optimum must report GradientConverged,
+    # not burn a degenerate line search.
+    return (it == 0) & made_progress & (init_grad_norm > 0.0) | not_done

@@ -1,0 +1,41 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX init.
+
+Analog of the reference's shared Spark ``local[4]`` test context
+(reference: photon-test/.../SparkTestUtils.scala:55-69,190) — all distributed
+code paths (pjit sharding, psum collectives, mesh layouts) run for real
+in-process over 8 host devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A site hook may pin jax_platforms to an accelerator backend; tests must run
+# on the virtual multi-device CPU platform regardless.
+jax.config.update("jax_platforms", "cpu")
+
+# Tests validate kernel math against finite differences / scipy in float64;
+# production code passes explicit float32 dtypes, which x64 mode preserves.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

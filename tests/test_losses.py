@@ -1,0 +1,95 @@
+"""Loss kernel math vs finite differences and closed forms.
+
+Mirrors the reference unit tier (test/.../function/LogisticLossFunctionTest,
+PoissonLossFunctionTest, SquaredLossFunctionTest, SmoothedHingeLossFunctionTest).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+
+
+ALL = [losses.logistic_loss, losses.squared_loss, losses.poisson_loss,
+       losses.smoothed_hinge_loss]
+LABELS = {
+    "logistic": [0.0, 1.0],
+    "squared": [-2.0, 0.0, 1.5],
+    "poisson": [0.0, 1.0, 3.0],
+    "smoothed_hinge": [0.0, 1.0],
+}
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_first_derivative_matches_finite_difference(loss):
+    eps = 1e-5
+    zs = np.linspace(-4.0, 4.0, 33)
+    for y in LABELS[loss.name]:
+        for z in zs:
+            got = float(loss.d1(jnp.float64(z), jnp.float64(y)))
+            fd = (float(loss.loss(jnp.float64(z + eps), jnp.float64(y)))
+                  - float(loss.loss(jnp.float64(z - eps), jnp.float64(y)))) / (2 * eps)
+            assert got == pytest.approx(fd, abs=5e-4), (loss.name, z, y)
+
+
+@pytest.mark.parametrize("loss", [l for l in ALL if l.name != "smoothed_hinge"],
+                         ids=lambda l: l.name)
+def test_second_derivative_matches_finite_difference(loss):
+    eps = 1e-4
+    zs = np.linspace(-3.0, 3.0, 25)
+    for y in LABELS[loss.name]:
+        for z in zs:
+            got = float(loss.d2(jnp.float64(z), jnp.float64(y)))
+            fd = (float(loss.d1(jnp.float64(z + eps), jnp.float64(y)))
+                  - float(loss.d1(jnp.float64(z - eps), jnp.float64(y)))) / (2 * eps)
+            assert got == pytest.approx(fd, abs=5e-3), (loss.name, z, y)
+
+
+def test_logistic_loss_stable_at_extreme_margins():
+    # The raw formulation log(1+exp(z)) - y z overflows for z ~ 1e3;
+    # the stable kernel must not.
+    for z, y, expected in [(1000.0, 1.0, 0.0), (-1000.0, 0.0, 0.0),
+                           (1000.0, 0.0, 1000.0), (-1000.0, 1.0, 1000.0)]:
+        v = float(losses.logistic_loss.loss(jnp.float32(z), jnp.float32(y)))
+        assert np.isfinite(v)
+        assert v == pytest.approx(expected, rel=1e-5, abs=1e-5)
+
+
+def test_logistic_loss_closed_form():
+    # l(0, y) = log 2 for both labels.
+    for y in (0.0, 1.0):
+        assert float(losses.logistic_loss.loss(jnp.float32(0.0), jnp.float32(y))) \
+            == pytest.approx(np.log(2.0), rel=1e-6)
+
+
+def test_squared_loss_values():
+    assert float(losses.squared_loss.loss(jnp.float32(3.0), jnp.float32(1.0))) == 2.0
+    assert float(losses.squared_loss.d1(jnp.float32(3.0), jnp.float32(1.0))) == 2.0
+    assert float(losses.squared_loss.d2(jnp.float32(3.0), jnp.float32(1.0))) == 1.0
+
+
+def test_poisson_loss_values():
+    z, y = 1.2, 3.0
+    assert float(losses.poisson_loss.loss(jnp.float32(z), jnp.float32(y))) == \
+        pytest.approx(np.exp(z) - y * z, rel=1e-5)
+
+
+def test_smoothed_hinge_regions():
+    l = losses.smoothed_hinge_loss
+    # y=1 (positive class): t = z
+    assert float(l.loss(jnp.float32(2.0), jnp.float32(1.0))) == 0.0
+    assert float(l.loss(jnp.float32(0.5), jnp.float32(1.0))) == pytest.approx(0.125)
+    assert float(l.loss(jnp.float32(-1.0), jnp.float32(1.0))) == pytest.approx(1.5)
+    # y=0 maps to -1: t = -z
+    assert float(l.loss(jnp.float32(-2.0), jnp.float32(0.0))) == 0.0
+    assert float(l.loss(jnp.float32(1.0), jnp.float32(0.0))) == pytest.approx(1.5)
+
+
+def test_log1p_exp_matches_reference_util():
+    # util/Utils.scala:270 behavior across the switch point.
+    xs = np.array([-50.0, -1.0, 0.0, 1.0, 50.0, 500.0])
+    got = np.asarray(losses.log1p_exp(jnp.asarray(xs)))
+    expected = np.where(xs > 0, xs + np.log1p(np.exp(-np.abs(xs))),
+                        np.log1p(np.exp(np.minimum(xs, 0))))
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
