@@ -145,15 +145,19 @@ def dense_batch(
     dtype=jnp.float32,
 ) -> DenseBatch:
     n = X.shape[0]
+    # Per-row metadata stays exact even for low-precision features: labels,
+    # offsets and weights are at least float32 (counts > 256 and cumulative
+    # weight sums would corrupt in bf16).
+    meta = jnp.promote_types(dtype, jnp.float32)
     return DenseBatch(
         X=jnp.asarray(X, dtype=dtype),
-        labels=jnp.asarray(labels, dtype=jnp.float32),
-        offsets=jnp.zeros(n, jnp.float32)
+        labels=jnp.asarray(labels, dtype=meta),
+        offsets=jnp.zeros(n, meta)
         if offsets is None
-        else jnp.asarray(offsets, jnp.float32),
-        weights=jnp.ones(n, jnp.float32)
+        else jnp.asarray(offsets, meta),
+        weights=jnp.ones(n, meta)
         if weights is None
-        else jnp.asarray(weights, jnp.float32),
+        else jnp.asarray(weights, meta),
     )
 
 
@@ -164,6 +168,7 @@ def ell_from_rows(
     offsets: np.ndarray | None = None,
     weights: np.ndarray | None = None,
     pad_to_multiple: int = 8,
+    dtype=jnp.float32,
 ) -> EllBatch:
     """Build an ELL batch from per-row (indices, values) sparse rows.
 
@@ -173,21 +178,24 @@ def ell_from_rows(
     n = len(rows)
     k = max((len(ix) for ix, _ in rows), default=1)
     k = max(1, -(-k // pad_to_multiple) * pad_to_multiple)
+    meta = jnp.promote_types(dtype, jnp.float32)
+    # Host staging in the narrowest exact container (f64 only when asked).
+    stage = np.float64 if meta == jnp.float64 else np.float32
     indices = np.zeros((n, k), dtype=np.int32)
-    values = np.zeros((n, k), dtype=np.float32)
+    values = np.zeros((n, k), dtype=stage)
     for i, (ix, v) in enumerate(rows):
         indices[i, : len(ix)] = ix
         values[i, : len(v)] = v
     return EllBatch(
         indices=jnp.asarray(indices),
-        values=jnp.asarray(values),
-        labels=jnp.asarray(labels, jnp.float32),
-        offsets=jnp.zeros(n, jnp.float32)
+        values=jnp.asarray(values, dtype),
+        labels=jnp.asarray(labels, meta),
+        offsets=jnp.zeros(n, meta)
         if offsets is None
-        else jnp.asarray(offsets, jnp.float32),
-        weights=jnp.ones(n, jnp.float32)
+        else jnp.asarray(offsets, meta),
+        weights=jnp.ones(n, meta)
         if weights is None
-        else jnp.asarray(weights, jnp.float32),
+        else jnp.asarray(weights, meta),
         dim=dim,
     )
 

@@ -1,0 +1,93 @@
+"""Full-model metric maps + best-lambda selection.
+
+TPU-native replacement for the reference's legacy evaluation
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/Evaluation.scala
+:32-152 — produces a Map[metricName -> value] per model; metric names :32-39)
+and ModelSelection.scala (best-lambda pick per task: AUC for classifiers,
+RMSE / mean loss for regressions).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.evaluation import metrics
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, score_batch
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.optimize.config import TaskType
+
+# Metric name constants (Evaluation.scala:32-39).
+MEAN_ABSOLUTE_ERROR = "MEAN_ABSOLUTE_ERROR"
+MEAN_SQUARED_ERROR = "MEAN_SQUARED_ERROR"
+ROOT_MEAN_SQUARED_ERROR = "ROOT_MEAN_SQUARED_ERROR"
+AREA_UNDER_PRECISION_RECALL = "AREA_UNDER_PRECISION_RECALL"
+AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS = (
+    "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS")
+PEAK_F1_SCORE = "PEAK_F1_SCORE"
+DATA_LOG_LIKELIHOOD = "DATA_LOG_LIKELIHOOD"
+AKAIKE_INFORMATION_CRITERION = "AKAIKE_INFORMATION_CRITERION"
+
+
+def evaluate_model(model: GeneralizedLinearModel, batch: Batch
+                   ) -> dict[str, float]:
+    """Compute the task-appropriate metric map on a validation batch."""
+    margins = score_batch(model, batch)
+    predictions = model.mean(margins)
+    labels, weights = batch.labels, batch.weights
+    out: dict[str, float] = {
+        MEAN_ABSOLUTE_ERROR: float(
+            metrics.mean_absolute_error(labels, predictions, weights)),
+        MEAN_SQUARED_ERROR: float(
+            metrics.mean_squared_error(labels, predictions, weights)),
+        ROOT_MEAN_SQUARED_ERROR: float(
+            metrics.root_mean_squared_error(labels, predictions, weights)),
+    }
+    k = model.coefficients.dim
+
+    if model.task == TaskType.LOGISTIC_REGRESSION:
+        out[AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] = float(
+            metrics.area_under_roc_curve(labels, margins, weights))
+        out[AREA_UNDER_PRECISION_RECALL] = float(
+            metrics.area_under_pr_curve(labels, margins, weights))
+        out[PEAK_F1_SCORE] = float(metrics.peak_f1(labels, margins, weights))
+    elif model.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        out[AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] = float(
+            metrics.area_under_roc_curve(labels, margins, weights))
+        loss = get_loss("smoothed_hinge")
+        out["SMOOTHED_HINGE_LOSS"] = float(
+            metrics.mean_loss(loss, labels, margins, weights))
+
+    ll_fn = {
+        TaskType.LOGISTIC_REGRESSION: metrics.logistic_log_likelihood,
+        TaskType.POISSON_REGRESSION: metrics.poisson_log_likelihood,
+        TaskType.LINEAR_REGRESSION: metrics.linear_log_likelihood,
+    }.get(model.task)
+    if ll_fn is not None:
+        mean_ll = float(ll_fn(labels, margins, weights))
+        out[DATA_LOG_LIKELIHOOD] = mean_ll
+        total_ll = mean_ll * float(jnp.sum(weights))
+        out[AKAIKE_INFORMATION_CRITERION] = float(
+            metrics.akaike_information_criterion(jnp.asarray(total_ll), k))
+    return out
+
+
+def select_best_model(
+    per_lambda_metrics: Mapping[float, Mapping[str, float]],
+    task: TaskType,
+) -> float:
+    """Best-lambda selection (ModelSelection.scala): max AUC for classifiers,
+    min RMSE for linear, max log-likelihood for Poisson. Returns the winning
+    lambda."""
+    if not per_lambda_metrics:
+        raise ValueError("no models to select from")
+    if task in (TaskType.LOGISTIC_REGRESSION,
+                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        key, best = AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS, max
+    elif task == TaskType.LINEAR_REGRESSION:
+        key, best = ROOT_MEAN_SQUARED_ERROR, min
+    else:
+        key, best = DATA_LOG_LIKELIHOOD, max
+    return best(per_lambda_metrics, key=lambda lam: per_lambda_metrics[lam][key])

@@ -1,0 +1,127 @@
+"""Generalized linear model family.
+
+TPU-native re-design of the reference's model hierarchy
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/supervised/model/
+GeneralizedLinearModel.scala:25-148 and subclasses in supervised/
+classification/ and supervised/regression/): a model is a coefficient
+container plus a mean function; scoring is a batched margin matmul.
+
+- Coefficients: means + optional variances (model/Coefficients.scala:33-126)
+- LogisticRegressionModel: sigmoid mean, binary classifier
+- LinearRegressionModel: identity mean
+- PoissonRegressionModel: exp mean
+- SmoothedHingeLossLinearSVMModel: identity "mean" (raw margin score)
+
+Models are frozen pytree dataclasses, so a whole entity-batch of random-effect
+models is just a stacked ``[E, D]`` coefficient matrix scored under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.ops.losses import sigmoid
+from photon_ml_tpu.optimize.config import TaskType
+
+Array = jnp.ndarray
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """Coefficient means + optional variance estimates."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def score(self, features: Array) -> Array:
+        """x . w for a [N, D] (or [D]) feature array."""
+        return features @ self.means
+
+    def summary(self) -> str:
+        m = np.asarray(self.means)
+        lines = [f"coefficients: dim={m.shape[-1]} "
+                 f"l2norm={np.linalg.norm(m):.6g} "
+                 f"nnz={int(np.sum(m != 0))}"]
+        if self.variances is not None:
+            v = np.asarray(self.variances)
+            lines.append(f"variances: mean={v.mean():.6g} max={v.max():.6g}")
+        return "\n".join(lines)
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros(dim, dtype))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A GLM: coefficients + task-determined mean function.
+
+    ``task`` is static metadata; swapping coefficients (lambda grid, warm
+    starts, per-entity stacking) reuses compiled scoring kernels.
+    """
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    # -- scoring -------------------------------------------------------------
+
+    def compute_score(self, features: Array, offsets: Array | float = 0.0) -> Array:
+        """Raw margin x . w + offset (DatumScoringModel.score analog)."""
+        return self.coefficients.score(features) + offsets
+
+    def mean(self, margins: Array) -> Array:
+        """Map margins through the task's inverse link function
+        (GeneralizedLinearModel.computeMean analog)."""
+        if self.task == TaskType.LOGISTIC_REGRESSION:
+            return sigmoid(margins)
+        if self.task == TaskType.POISSON_REGRESSION:
+            return jnp.exp(margins)
+        # linear regression and smoothed-hinge SVM: identity
+        return margins
+
+    def predict(self, features: Array, offsets: Array | float = 0.0) -> Array:
+        return self.mean(self.compute_score(features, offsets))
+
+    def predict_class(self, features: Array, threshold: float = 0.5,
+                      offsets: Array | float = 0.0) -> Array:
+        """Binary classification (BinaryClassifier trait analog)."""
+        if self.task not in (TaskType.LOGISTIC_REGRESSION,
+                             TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+            raise ValueError(f"{self.task} is not a classifier")
+        if self.task == TaskType.LOGISTIC_REGRESSION:
+            return (self.predict(features, offsets) >= threshold).astype(jnp.int32)
+        return (self.compute_score(features, offsets) >= 0.0).astype(jnp.int32)
+
+    # -- validation ----------------------------------------------------------
+
+    def validate_coefficients(self) -> bool:
+        """NaN/Inf scan (GeneralizedLinearModel.validateCoefficients :80)."""
+        return bool(jnp.all(jnp.isfinite(self.coefficients.means)))
+
+    # -- helpers -------------------------------------------------------------
+
+    def with_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        return dataclasses.replace(self, coefficients=coefficients)
+
+    @staticmethod
+    def zeros(dim: int, task: TaskType, dtype=jnp.float32) -> "GeneralizedLinearModel":
+        return GeneralizedLinearModel(Coefficients.zeros(dim, dtype), task)
+
+
+def score_batch(model: GeneralizedLinearModel, batch: Batch) -> Array:
+    """Margins of a whole batch including its stored offsets (delegates to
+    the batch's own fused margin kernel — one implementation per layout)."""
+    w = model.coefficients.means
+    return batch.margins(w, jnp.zeros((), w.dtype))

@@ -1,0 +1,207 @@
+"""OWL-QN (Orthant-Wise Limited-memory Quasi-Newton) for L1 objectives.
+
+TPU-native replacement for the reference's Breeze-backed OWLQN
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/optimization/
+OWLQN.scala:43-90 — extends LBFGS, delegating to ``BreezeOWLQN`` with a
+mutable L1 weight for the warm-started lambda grid). Implements Andrew & Gao
+(2007) as one jitted ``lax.while_loop``:
+
+- pseudo-gradient of F(x) = f(x) + l1 ||x||_1 (subgradient selection at 0)
+- L-BFGS two-loop direction from *smooth* gradient history, projected onto
+  the orthant of the negative pseudo-gradient
+- backtracking line search on points projected onto the current orthant
+- history pairs from smooth gradients only
+
+``l1`` may be a scalar or a per-coordinate vector (e.g. zero for the
+intercept), covering the reference's elastic-net split where lambda1 = alpha *
+lambda goes to OWL-QN and lambda2 stays in the smooth L2 mixin
+(RegularizationContext.scala:35-90).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimize.common import (
+    BoxConstraints,
+    RunHistory,
+    project_box,
+    should_continue,
+)
+from photon_ml_tpu.optimize.lbfgs import two_loop_direction
+
+Array = jnp.ndarray
+
+DEFAULT_MAX_ITER = 100
+DEFAULT_M = 10
+DEFAULT_TOLERANCE = 1e-7
+_LS_MAX_STEPS = 30
+_LS_C1 = 1e-4
+
+
+def pseudo_gradient(x: Array, g: Array, l1: Array) -> Array:
+    """Subgradient selection for F = f + l1 ||x||_1 (Andrew & Gao eq. 4)."""
+    right = g + l1  # derivative approaching from x_j > 0
+    left = g - l1  # from x_j < 0
+    at_zero = jnp.where(right < 0.0, right, jnp.where(left > 0.0, left, 0.0))
+    return jnp.where(x > 0.0, right, jnp.where(x < 0.0, left, at_zero))
+
+
+class _OWLQNCarry(NamedTuple):
+    it: Array
+    x: Array
+    f: Array  # F = f + l1 |x|  (the tracked objective)
+    g: Array  # smooth gradient
+    prev_f: Array
+    S: Array
+    Y: Array
+    rho: Array
+    valid: Array
+    head: Array
+    made_progress: Array
+    values: Array
+    grad_norms: Array  # pseudo-gradient norms
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4, 5))
+def _minimize_owlqn_impl(
+    value_and_grad_fn,
+    x0: Array,
+    data,
+    max_iter: int,
+    m: int,
+    tolerance: float,
+    l1: Array = 0.0,
+    box: Optional[BoxConstraints] = None,
+):
+    d = x0.shape[0]
+    dtype = x0.dtype
+    l1 = jnp.broadcast_to(jnp.asarray(l1, dtype), (d,))
+
+    def full_objective(x):
+        f, g = value_and_grad_fn(x, data)
+        return f + jnp.sum(l1 * jnp.abs(x)), g
+
+    f0, g0 = full_objective(x0)
+    pg0 = pseudo_gradient(x0, g0, l1)
+    pg0n = jnp.linalg.norm(pg0)
+
+    values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f0)
+    grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(pg0n)
+
+    init = _OWLQNCarry(
+        it=jnp.int32(0), x=x0, f=f0, g=g0,
+        prev_f=f0 + jnp.asarray(jnp.inf, dtype),
+        S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros(m, dtype), valid=jnp.zeros(m, bool),
+        head=jnp.int32(0), made_progress=jnp.bool_(True),
+        values=values, grad_norms=grad_norms,
+    )
+
+    def cond(c: _OWLQNCarry) -> Array:
+        pg = pseudo_gradient(c.x, c.g, l1)
+        return should_continue(
+            c.it, c.f, c.prev_f, jnp.linalg.norm(pg), f0, pg0n,
+            max_iter, tolerance, c.made_progress,
+        )
+
+    def body(c: _OWLQNCarry) -> _OWLQNCarry:
+        pg = pseudo_gradient(c.x, c.g, l1)
+        direction = two_loop_direction(pg, c.S, c.Y, c.rho, c.valid, c.head)
+        # Project direction onto the orthant of -pg (keep only components
+        # that actually descend along the pseudo-gradient).
+        direction = jnp.where(direction * pg < 0.0, direction, 0.0)
+
+        # Orthant for this step: sign(x_j), or sign(-pg_j) where x_j == 0.
+        xi = jnp.where(c.x != 0.0, jnp.sign(c.x), jnp.sign(-pg))
+
+        def project_trial(x_new):
+            x_new = jnp.where(x_new * xi > 0.0, x_new, 0.0)
+            # Box projection after the orthant projection, mirroring the
+            # reference where OWLQN inherits LBFGS's per-iterate hypercube
+            # projection (optimization/LBFGS.scala:42-150).
+            if box is not None:
+                x_new = project_box(x_new, box)
+            return x_new
+
+        init_alpha = jnp.where(
+            c.it == 0,
+            1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
+            jnp.asarray(1.0, dtype),
+        )
+
+        # Backtracking: accept F(pi(x + a d)) <= F(x) + c1 * pg . (x_new - x).
+        def ls_cond(state):
+            a, f_a, g_a, x_a, k, accepted = state
+            return (~accepted) & (k < _LS_MAX_STEPS)
+
+        def ls_body(state):
+            a, _, _, _, k, _ = state
+            x_a = project_trial(c.x + a * direction)
+            f_a, g_a = full_objective(x_a)
+            accepted = f_a <= c.f + _LS_C1 * jnp.dot(pg, x_a - c.x)
+            a_next = jnp.where(accepted, a, a * 0.5)
+            return a_next, f_a, g_a, x_a, k + 1, accepted
+
+        a, f_new, g_new, x_new, _, accepted = lax.while_loop(
+            ls_cond, ls_body,
+            (init_alpha, c.f, c.g, c.x, jnp.int32(0), jnp.bool_(False)),
+        )
+
+        s = x_new - c.x
+        y = g_new - c.g  # smooth gradient difference
+        sy = jnp.dot(s, y)
+        store = accepted & (sy > 1e-10)
+
+        S = jnp.where(store, c.S.at[c.head].set(s), c.S)
+        Y = jnp.where(store, c.Y.at[c.head].set(y), c.Y)
+        rho = jnp.where(store, c.rho.at[c.head].set(1.0 / jnp.maximum(sy, 1e-300)),
+                        c.rho)
+        valid = jnp.where(store, c.valid.at[c.head].set(True), c.valid)
+        head = jnp.where(store, (c.head + 1) % m, c.head)
+
+        it_new = c.it + 1
+        pg_new = pseudo_gradient(x_new, g_new, l1)
+        values = c.values.at[it_new].set(jnp.where(accepted, f_new, c.f))
+        grad_norms = c.grad_norms.at[it_new].set(jnp.linalg.norm(
+            jnp.where(accepted, pg_new, pg)))
+
+        return _OWLQNCarry(
+            it=it_new,
+            x=jnp.where(accepted, x_new, c.x),
+            f=jnp.where(accepted, f_new, c.f),
+            g=jnp.where(accepted, g_new, c.g),
+            prev_f=c.f,
+            S=S, Y=Y, rho=rho, valid=valid, head=head,
+            made_progress=accepted,
+            values=values, grad_norms=grad_norms,
+        )
+
+    final = lax.while_loop(cond, body, init)
+    history = RunHistory(values=final.values, grad_norms=final.grad_norms,
+                         num_iterations=final.it)
+    return final.x, history, final.made_progress
+
+
+def minimize_owlqn(
+    value_and_grad_fn: Callable[[Array, object], tuple[Array, Array]],
+    x0: Array,
+    data=None,
+    l1: float | Array = 0.0,
+    max_iter: int = DEFAULT_MAX_ITER,
+    m: int = DEFAULT_M,
+    tolerance: float = DEFAULT_TOLERANCE,
+    box: Optional[BoxConstraints] = None,
+):
+    """Minimize f(x, data) + l1 ||x||_1; returns (x, RunHistory, made_progress).
+
+    ``value_and_grad_fn`` returns the SMOOTH part's (value, gradient); the L1
+    term is handled here. ``l1`` may be scalar or per-coordinate (length d).
+    """
+    return _minimize_owlqn_impl(value_and_grad_fn, x0, data, max_iter, m,
+                                tolerance, l1, box)

@@ -1,0 +1,102 @@
+"""Device mesh + sharding policy: the distributed runtime.
+
+TPU-native replacement for the reference's Spark runtime layer
+(reference: Spark 1.6 RDD/Broadcast/treeAggregate; photon-ml's wrappers
+RDDLike.scala:30-60, BroadcastLike.scala:25, SparkContextConfiguration.scala:
+39-110, and the treeAggregate-depth policy cli/game/training/Driver.scala:
+357-363). The mapping (SURVEY §5.8):
+
+- ``treeAggregate(depth)``  ->  XLA all-reduce over the mesh ``data`` axis,
+  inserted automatically by GSPMD when a reduction crosses sharded rows.
+  The depth-1-vs-2 knob disappears: ICI all-reduce is already tree/ring.
+- ``Broadcast[coefficients]`` -> coefficients replicated in HBM; no per-
+  iteration host broadcast, no persist/unpersist choreography.
+- entity-partitioned RDDs -> arrays sharded over the ``entity`` axis.
+
+One mesh with two logical axes covers the framework:
+- ``data``:   shards example rows (fixed-effect aggregation axis)
+- ``entity``: shards per-entity blocks (random-effect axis)
+
+On a single chip both axes have size 1 and every sharding below is a no-op;
+the same code compiles unchanged for a v5e-16 slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.data.batch import DenseBatch, EllBatch
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def make_mesh(
+    num_data: Optional[int] = None,
+    num_entity: int = 1,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """Build a (data x entity) mesh over the available devices.
+
+    Defaults to all devices on the data axis — the right layout for
+    fixed-effect-dominated workloads; GAME drivers pass ``num_entity`` to
+    split the mesh (e.g. 4x2 on 8 chips).
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n = devs.size
+    if num_data is None:
+        num_data = n // num_entity
+    if num_data * num_entity != n:
+        raise ValueError(
+            f"mesh {num_data}x{num_entity} != {n} available devices")
+    return Mesh(devs.reshape(num_data, num_entity), (DATA_AXIS, ENTITY_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the data axis (1-D arrays and leading dim of 2-D)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a batch with rows sharded over the mesh data axis.
+
+    Rows must be a multiple of the data-axis size — callers pad with
+    zero-weight rows first (data/batch.pad_batch), the moral equivalent of
+    the reference's partition balancing.
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+    rows = batch.labels.shape[0]
+    if rows % n_shards != 0:
+        raise ValueError(
+            f"batch rows {rows} not divisible by data axis {n_shards}; "
+            "pad with zero-weight rows first")
+    row_sharded = NamedSharding(mesh, P(DATA_AXIS))
+    if isinstance(batch, DenseBatch):
+        return DenseBatch(
+            X=jax.device_put(batch.X, row_sharded),
+            labels=jax.device_put(batch.labels, row_sharded),
+            offsets=jax.device_put(batch.offsets, row_sharded),
+            weights=jax.device_put(batch.weights, row_sharded),
+        )
+    if isinstance(batch, EllBatch):
+        return EllBatch(
+            indices=jax.device_put(batch.indices, row_sharded),
+            values=jax.device_put(batch.values, row_sharded),
+            labels=jax.device_put(batch.labels, row_sharded),
+            offsets=jax.device_put(batch.offsets, row_sharded),
+            weights=jax.device_put(batch.weights, row_sharded),
+            dim=batch.dim,
+        )
+    raise TypeError(f"unknown batch type {type(batch)}")
+
+
+def pad_rows_to_multiple(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple

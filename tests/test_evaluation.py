@@ -1,0 +1,143 @@
+"""Metric kernels vs sklearn and hand-computed values.
+
+Mirrors reference evaluation tests (EvaluationTest, AreaUnderROCCurve*Test,
+ShardedEvaluatorTest analogs).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics
+
+from photon_ml_tpu.evaluation import metrics
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluatorSpec,
+    EvaluatorType,
+    evaluate,
+    sharded_auc,
+    sharded_precision_at_k,
+)
+
+
+def test_auc_matches_sklearn(rng):
+    for _ in range(5):
+        y = (rng.random(200) > 0.4).astype(float)
+        s = rng.normal(size=200) + y  # informative scores
+        ours = float(metrics.area_under_roc_curve(jnp.asarray(y), jnp.asarray(s)))
+        ref = sklearn.metrics.roc_auc_score(y, s)
+        assert ours == pytest.approx(ref, abs=1e-10)
+
+
+def test_auc_with_ties_matches_sklearn(rng):
+    y = (rng.random(300) > 0.5).astype(float)
+    s = np.round(rng.normal(size=300), 1)  # heavy ties
+    ours = float(metrics.area_under_roc_curve(jnp.asarray(y), jnp.asarray(s)))
+    ref = sklearn.metrics.roc_auc_score(y, s)
+    assert ours == pytest.approx(ref, abs=1e-10)
+
+
+def test_weighted_auc_matches_sklearn(rng):
+    y = (rng.random(150) > 0.5).astype(float)
+    s = rng.normal(size=150) + 0.8 * y
+    w = rng.integers(1, 5, size=150).astype(float)
+    ours = float(metrics.area_under_roc_curve(jnp.asarray(y), jnp.asarray(s),
+                                              jnp.asarray(w)))
+    ref = sklearn.metrics.roc_auc_score(y, s, sample_weight=w)
+    assert ours == pytest.approx(ref, abs=1e-10)
+
+
+def test_auc_perfect_and_inverted():
+    y = jnp.asarray([0.0, 0.0, 1.0, 1.0])
+    assert float(metrics.area_under_roc_curve(y, jnp.asarray([1., 2., 3., 4.]))) == 1.0
+    assert float(metrics.area_under_roc_curve(y, jnp.asarray([4., 3., 2., 1.]))) == 0.0
+    assert float(metrics.area_under_roc_curve(y, jnp.zeros(4))) == 0.5
+
+
+def test_pr_auc_matches_sklearn_trapezoid(rng):
+    y = (rng.random(120) > 0.6).astype(float)
+    s = rng.normal(size=120) + 1.2 * y
+    p, r, _ = sklearn.metrics.precision_recall_curve(y, s)
+    # sklearn returns the curve from high threshold (r=0) to low; integrate
+    # trapezoidally in recall order, prepending the (0, p_first) convention.
+    ref = -np.trapezoid(p, r)
+    ours = float(metrics.area_under_pr_curve(jnp.asarray(y), jnp.asarray(s)))
+    assert ours == pytest.approx(ref, abs=2e-3)
+
+
+def test_peak_f1(rng):
+    y = (rng.random(100) > 0.5).astype(float)
+    s = rng.normal(size=100) + y
+    p, r, _ = sklearn.metrics.precision_recall_curve(y, s)
+    f1_ref = np.max(2 * p * r / np.maximum(p + r, 1e-300))
+    ours = float(metrics.peak_f1(jnp.asarray(y), jnp.asarray(s)))
+    assert ours == pytest.approx(f1_ref, abs=1e-9)
+
+
+def test_regression_metrics(rng):
+    y = rng.normal(size=50)
+    s = y + rng.normal(size=50) * 0.3
+    assert float(metrics.mean_absolute_error(jnp.asarray(y), jnp.asarray(s))) == \
+        pytest.approx(np.mean(np.abs(s - y)), rel=1e-9)
+    assert float(metrics.root_mean_squared_error(jnp.asarray(y), jnp.asarray(s))) == \
+        pytest.approx(np.sqrt(np.mean((s - y) ** 2)), rel=1e-9)
+
+
+def test_sharded_auc_equals_mean_of_per_entity_auc(rng):
+    n_entities = 7
+    ids, ys, ss = [], [], []
+    per_entity = []
+    for e in range(n_entities):
+        m = int(rng.integers(10, 40))
+        y = (rng.random(m) > 0.5).astype(float)
+        s = rng.normal(size=m) + 0.7 * y
+        ids += [e] * m
+        ys.append(y)
+        ss.append(s)
+        if 0 < y.sum() < m:
+            per_entity.append(sklearn.metrics.roc_auc_score(y, s))
+    got = float(sharded_auc(jnp.asarray(np.concatenate(ys)),
+                            jnp.asarray(np.concatenate(ss)),
+                            jnp.asarray(ids, dtype=jnp.int32), n_entities))
+    assert got == pytest.approx(np.mean(per_entity), abs=1e-9)
+
+
+def test_sharded_precision_at_k(rng):
+    # entity 0: top-2 scores are both positive => precision 1
+    # entity 1: top-2 has one positive => 0.5
+    ids = jnp.asarray([0, 0, 0, 1, 1, 1], dtype=jnp.int32)
+    scores = jnp.asarray([3.0, 2.0, 1.0, 3.0, 2.0, 1.0])
+    labels = jnp.asarray([1.0, 1.0, 0.0, 1.0, 0.0, 1.0])
+    got = float(sharded_precision_at_k(labels, scores, ids, 2, 2))
+    assert got == pytest.approx(0.75)
+
+
+def test_sharded_precision_at_k_small_entity():
+    # entity with fewer than k rows uses all rows
+    ids = jnp.asarray([0, 1, 1, 1], dtype=jnp.int32)
+    scores = jnp.asarray([1.0, 3.0, 2.0, 1.0])
+    labels = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    got = float(sharded_precision_at_k(labels, scores, ids, 2, 3))
+    assert got == pytest.approx((1.0 + 1.0 / 3.0) / 2.0)
+
+
+def test_evaluator_spec_parsing():
+    assert EvaluatorSpec.parse("AUC").evaluator_type == EvaluatorType.AUC
+    assert EvaluatorSpec.parse("rmse").evaluator_type == EvaluatorType.RMSE
+    s = EvaluatorSpec.parse("AUC:userId")
+    assert s.evaluator_type == EvaluatorType.SHARDED_AUC and s.id_type == "userId"
+    p = EvaluatorSpec.parse("precision@5:songId")
+    assert (p.evaluator_type == EvaluatorType.SHARDED_PRECISION_AT_K
+            and p.k == 5 and p.id_type == "songId")
+    with pytest.raises(ValueError):
+        EvaluatorSpec.parse("precision@3")
+    assert s.better_than(0.9, 0.8)
+    assert EvaluatorSpec.parse("RMSE").better_than(0.1, 0.2)
+
+
+def test_evaluate_dispatch(rng):
+    y = (rng.random(80) > 0.5).astype(float)
+    s = rng.normal(size=80) + y
+    auc = evaluate(EvaluatorSpec.parse("AUC"), jnp.asarray(s), jnp.asarray(y))
+    assert auc == pytest.approx(sklearn.metrics.roc_auc_score(y, s), abs=1e-10)
+    rmse = evaluate(EvaluatorSpec.parse("RMSE"), jnp.asarray(s), jnp.asarray(y))
+    assert rmse == pytest.approx(np.sqrt(np.mean((s - y) ** 2)), rel=1e-9)

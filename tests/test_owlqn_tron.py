@@ -1,0 +1,161 @@
+"""OWL-QN and TRON solver behavior.
+
+Mirrors reference test tier: OWLQNTest (L1 solutions, sparsity) and the TRON
+integration tests (agreement with L-BFGS solutions on twice-differentiable
+objectives, BaseGLMIntegTest's max-difference check between TRON and LBFGS).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import dense_batch
+from photon_ml_tpu.ops.aggregators import GLMObjective
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimize.owlqn import minimize_owlqn, pseudo_gradient
+from photon_ml_tpu.optimize.tron import minimize_tron
+
+
+def _obj_vg(w, payload):
+    obj, batch = payload
+    return obj.calculate(w, batch)
+
+
+def _obj_hvp(w, v, payload):
+    obj, batch = payload
+    return obj.hessian_vector(w, v, batch)
+
+
+def _problem(rng, loss="logistic", n=400, d=8, l2=0.0, sparse_truth=False):
+    X = rng.normal(size=(n, d))
+    X[:, -1] = 1.0
+    w_true = rng.normal(size=d)
+    if sparse_truth:
+        w_true[1:5] = 0.0
+    if loss == "squared":
+        y = X @ w_true + 0.1 * rng.normal(size=n)
+    elif loss == "poisson":
+        y = rng.poisson(np.exp(np.clip(X @ w_true * 0.3, -3, 3))).astype(float)
+    else:
+        y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    obj = GLMObjective(get_loss(loss), l2_lambda=l2)
+    return batch, obj
+
+
+# --- pseudo-gradient unit behavior -----------------------------------------
+
+def test_pseudo_gradient_regions():
+    x = jnp.asarray([1.0, -1.0, 0.0, 0.0, 0.0])
+    g = jnp.asarray([0.5, 0.5, -2.0, 2.0, 0.3])
+    l1 = jnp.asarray(1.0)
+    pg = np.asarray(pseudo_gradient(x, g, jnp.broadcast_to(l1, (5,))))
+    assert pg[0] == pytest.approx(1.5)  # x>0: g + l1
+    assert pg[1] == pytest.approx(-0.5)  # x<0: g - l1
+    assert pg[2] == pytest.approx(-1.0)  # 0, g+l1<0: g + l1
+    assert pg[3] == pytest.approx(1.0)  # 0, g-l1>0: g - l1
+    assert pg[4] == pytest.approx(0.0)  # 0, inside [-l1, l1]: 0
+
+
+# --- OWL-QN ----------------------------------------------------------------
+
+def test_owlqn_zero_l1_matches_lbfgs(rng):
+    batch, obj = _problem(rng)
+    x_owl, _, _ = minimize_owlqn(_obj_vg, jnp.zeros(8, jnp.float64),
+                                 (obj, batch), l1=0.0, tolerance=1e-10)
+    x_lb, _, _ = minimize_lbfgs(_obj_vg, jnp.zeros(8, jnp.float64),
+                                (obj, batch), tolerance=1e-10)
+    np.testing.assert_allclose(np.asarray(x_owl), np.asarray(x_lb), atol=1e-5)
+
+
+def test_owlqn_l1_induces_sparsity_and_optimality(rng):
+    batch, obj = _problem(rng, sparse_truth=True)
+    l1 = 20.0
+    x, hist, ok = minimize_owlqn(_obj_vg, jnp.zeros(8, jnp.float64),
+                                 (obj, batch), l1=l1, tolerance=1e-12)
+    xa = np.asarray(x)
+    assert np.sum(np.abs(xa) < 1e-8) >= 2, f"expected sparsity, got {xa}"
+    # KKT check for F = f + l1|x|: |g_j| <= l1 where x_j == 0, g_j = -l1*sign
+    # elsewhere (within solver tolerance).
+    _, g = obj.calculate(x, batch)
+    g = np.asarray(g)
+    for j in range(8):
+        if abs(xa[j]) < 1e-8:
+            assert abs(g[j]) <= l1 + 1e-3
+        else:
+            assert g[j] + l1 * np.sign(xa[j]) == pytest.approx(0.0, abs=2e-3)
+
+
+def test_owlqn_objective_beats_unregularized_point(rng):
+    """F(x_owlqn) must be <= F(x_lbfgs): the L1 solution is optimal for F."""
+    batch, obj = _problem(rng)
+    l1 = 5.0
+    x_owl, _, _ = minimize_owlqn(_obj_vg, jnp.zeros(8, jnp.float64),
+                                 (obj, batch), l1=l1, tolerance=1e-12)
+    x_lb, _, _ = minimize_lbfgs(_obj_vg, jnp.zeros(8, jnp.float64),
+                                (obj, batch))
+
+    def F(x):
+        v, _ = obj.calculate(x, batch)
+        return float(v) + l1 * float(jnp.sum(jnp.abs(x)))
+
+    assert F(x_owl) <= F(x_lb) + 1e-9
+
+
+def test_owlqn_per_coordinate_l1_spares_intercept(rng):
+    batch, obj = _problem(rng, sparse_truth=True)
+    l1_vec = np.full(8, 50.0)
+    l1_vec[-1] = 0.0  # intercept unregularized
+    x, _, _ = minimize_owlqn(_obj_vg, jnp.zeros(8, jnp.float64), (obj, batch),
+                             l1=jnp.asarray(l1_vec), tolerance=1e-12)
+    xa = np.asarray(x)
+    # Heavy L1 kills features but the unpenalized intercept survives.
+    assert np.abs(xa[-1]) > 1e-4
+    assert np.sum(np.abs(xa[:-1]) < 1e-8) >= 5
+
+
+# --- TRON ------------------------------------------------------------------
+
+@pytest.mark.parametrize("loss", ["logistic", "squared", "poisson"])
+def test_tron_matches_lbfgs_solution(rng, loss):
+    """BaseGLMIntegTest analog: TRON and LBFGS must land on the same optimum
+    of a strictly convex objective."""
+    batch, obj = _problem(rng, loss=loss, l2=1.0)
+    x_t, hist_t, ok_t = minimize_tron(_obj_vg, _obj_hvp,
+                                      jnp.zeros(8, jnp.float64), (obj, batch),
+                                      max_iter=50, tolerance=1e-10)
+    x_l, _, _ = minimize_lbfgs(_obj_vg, jnp.zeros(8, jnp.float64), (obj, batch),
+                               tolerance=1e-10)
+    np.testing.assert_allclose(np.asarray(x_t), np.asarray(x_l), atol=2e-4)
+    assert bool(ok_t)
+
+
+def test_tron_quadratic_converges_in_few_iterations():
+    """On a quadratic, Newton + exact CG should converge essentially in one
+    accepted step."""
+    A = jnp.asarray(np.diag([1.0, 4.0, 9.0, 16.0]))
+    b = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+
+    def vg(x, _):
+        return 0.5 * x @ A @ x - b @ x, A @ x - b
+
+    def hvp(x, v, _):
+        return A @ v
+
+    x, hist, ok = minimize_tron(vg, hvp, jnp.zeros(4, jnp.float64), None,
+                                max_iter=30, tolerance=1e-12)
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(np.asarray(A),
+                                                              np.asarray(b)),
+                               atol=1e-6)
+    assert int(hist.num_iterations) <= 5
+
+
+def test_tron_values_monotone(rng):
+    batch, obj = _problem(rng, loss="squared", l2=0.5)
+    _, hist, _ = minimize_tron(_obj_vg, _obj_hvp, jnp.zeros(8, jnp.float64),
+                               (obj, batch), max_iter=40)
+    k = int(hist.num_iterations)
+    vals = np.asarray(hist.values)[: k + 1]
+    assert np.all(np.isfinite(vals))
+    assert np.all(np.diff(vals) <= 1e-10)

@@ -1,0 +1,100 @@
+"""Distributed (mesh-sharded) training paths on the 8-device CPU harness.
+
+The analog of the reference's SparkTestUtils ``local[4]`` integration tier
+(photon-test/.../SparkTestUtils.scala:55-190): real collectives run
+in-process over 8 virtual devices. A sharded fit must agree exactly with the
+single-device fit — GSPMD's all-reduce replaces treeAggregate without
+changing the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import dense_batch, pad_batch
+from photon_ml_tpu.ops.aggregators import GLMObjective
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
+from photon_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    ENTITY_AXIS,
+    make_mesh,
+    pad_rows_to_multiple,
+    shard_batch,
+)
+
+
+def _obj_vg(w, payload):
+    obj, batch = payload
+    return obj.calculate(w, batch)
+
+
+def test_mesh_construction(devices):
+    mesh = make_mesh()
+    assert mesh.shape[DATA_AXIS] == len(devices)
+    assert mesh.shape[ENTITY_AXIS] == 1
+    mesh2 = make_mesh(num_data=4, num_entity=2)
+    assert mesh2.shape[DATA_AXIS] == 4 and mesh2.shape[ENTITY_AXIS] == 2
+    with pytest.raises(ValueError):
+        make_mesh(num_data=3, num_entity=3)
+
+
+def test_sharded_gradient_equals_local(rng, devices):
+    n, d = 96, 10
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) > 0.5).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    obj = GLMObjective(get_loss("logistic"), l2_lambda=0.5)
+    w = jnp.asarray(rng.normal(size=d))
+
+    v_local, g_local = obj.calculate(w, batch)
+
+    mesh = make_mesh()
+    sharded = shard_batch(batch, mesh)
+    v_sh, g_sh = jax.jit(lambda w, b: obj.calculate(w, b))(w, sharded)
+    assert float(v_sh) == pytest.approx(float(v_local), rel=1e-12)
+    np.testing.assert_allclose(np.asarray(g_sh), np.asarray(g_local), rtol=1e-12)
+
+
+def test_sharded_lbfgs_fit_equals_local(rng, devices):
+    """Full distributed L-BFGS solve over the 8-device mesh — the
+    treeAggregate-replacement end to end."""
+    n, d = 200, 8
+    X = rng.normal(size=(n, d))
+    X[:, -1] = 1.0
+    y = (rng.random(n) > 0.5).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    obj = GLMObjective(get_loss("logistic"), l2_lambda=1.0)
+
+    x_local, _, _ = minimize_lbfgs(_obj_vg, jnp.zeros(d, jnp.float64),
+                                   (obj, batch), tolerance=1e-12)
+
+    mesh = make_mesh()
+    target = pad_rows_to_multiple(n, mesh.shape[DATA_AXIS])
+    padded = pad_batch(batch, target)
+    sharded = shard_batch(padded, mesh)
+    x_sh, hist, ok = minimize_lbfgs(_obj_vg, jnp.zeros(d, jnp.float64),
+                                    (obj, sharded), tolerance=1e-12)
+    np.testing.assert_allclose(np.asarray(x_sh), np.asarray(x_local),
+                               atol=1e-9)
+
+
+def test_padding_preserves_objective(rng):
+    n, d = 37, 5
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) > 0.5).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    padded = pad_batch(batch, 40)
+    obj = GLMObjective(get_loss("logistic"))
+    w = jnp.asarray(rng.normal(size=d))
+    v1, g1 = obj.calculate(w, batch)
+    v2, g2 = obj.calculate(w, padded)
+    assert float(v1) == pytest.approx(float(v2), rel=1e-12)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-12)
+
+
+def test_shard_batch_rejects_indivisible_rows(rng):
+    batch = dense_batch(rng.normal(size=(13, 3)), np.zeros(13))
+    with pytest.raises(ValueError, match="divisible"):
+        shard_batch(batch, make_mesh())

@@ -1,0 +1,167 @@
+"""End-to-end single-GLM training slice (ModelTraining analog).
+
+Mirrors reference integration tests: lambda-grid training with warm starts,
+per-task metric maps, best-model selection, optimizer/regularization
+factory rules.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import dense_batch
+from photon_ml_tpu.evaluation.model_evaluation import (
+    AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS,
+    ROOT_MEAN_SQUARED_ERROR,
+    evaluate_model,
+    select_best_model,
+)
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.ops.normalization import NormalizationContext, NormalizationType
+from photon_ml_tpu.optimize.config import (
+    GLMOptimizationConfiguration,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    TaskType,
+)
+from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.stat.summary import summarize
+from photon_ml_tpu.training import train_glm_grid
+
+
+def _binary_data(rng, n=600, d=8):
+    X = rng.normal(size=(n, d))
+    X[:, -1] = 1.0
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    return X, y
+
+
+def test_lambda_grid_descending_with_warm_start(rng):
+    X, y = _binary_data(rng)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    models = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION,
+                            regularization_weights=[0.1, 10.0, 1.0],
+                            tolerance=1e-9)
+    lams = [m.regularization_weight for m in models]
+    assert lams == [10.0, 1.0, 0.1]
+    # Heavier regularization => smaller coefficients.
+    norms = [float(jnp.linalg.norm(m.model.coefficients.means)) for m in models]
+    assert norms[0] < norms[1] < norms[2]
+    # All runs converged and every model validates.
+    for m in models:
+        assert m.model.validate_coefficients()
+        assert m.result.iterations > 0
+
+
+def test_metric_map_and_selection_logistic(rng):
+    X, y = _binary_data(rng)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    models = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION,
+                            regularization_weights=[1000.0, 1.0])
+    per_lambda = {m.regularization_weight: evaluate_model(m.model, batch)
+                  for m in models}
+    auc_light = per_lambda[1.0][AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS]
+    auc_heavy = per_lambda[1000.0][AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS]
+    assert auc_light > 0.7  # informative model
+    best = select_best_model(per_lambda, TaskType.LOGISTIC_REGRESSION)
+    assert per_lambda[best][AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] == \
+        max(auc_light, auc_heavy)
+
+
+def test_linear_regression_tron_with_normalization(rng):
+    n, d = 500, 6
+    X = rng.normal(size=(n, d)) * np.array([5.0, 0.2, 1.0, 10.0, 1.0, 1.0])
+    X[:, -1] = 1.0
+    w = rng.normal(size=d)
+    y = X @ w + 0.05 * rng.normal(size=n)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    norm = NormalizationContext.build(
+        NormalizationType.STANDARDIZATION, summarize(X), intercept_index=d - 1)
+    # float64 context for the f64 test batch
+    norm = NormalizationContext(
+        factors=norm.factors.astype(jnp.float64),
+        shifts=norm.shifts.astype(jnp.float64), intercept_index=d - 1)
+    models = train_glm_grid(batch, TaskType.LINEAR_REGRESSION,
+                            regularization_weights=[0.01],
+                            optimizer_type=OptimizerType.TRON,
+                            normalization=norm, max_iterations=50,
+                            tolerance=1e-12)
+    m = models[0].model
+    # De-normalized model must recover the generating coefficients.
+    np.testing.assert_allclose(np.asarray(m.coefficients.means), w, atol=5e-2)
+    rmse = evaluate_model(m, batch)[ROOT_MEAN_SQUARED_ERROR]
+    assert rmse < 0.1
+
+
+def test_poisson_elastic_net_owlqn_path(rng):
+    n, d = 400, 7
+    X = rng.normal(size=(n, d)) * 0.4
+    X[:, -1] = 1.0
+    w = np.zeros(d)
+    w[[0, 3, 6]] = [0.8, -0.5, 0.3]
+    y = rng.poisson(np.exp(X @ w)).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    models = train_glm_grid(
+        batch, TaskType.POISSON_REGRESSION,
+        regularization_weights=[30.0],
+        regularization_context=RegularizationContext(
+            RegularizationType.ELASTIC_NET, alpha=0.9),
+        max_iterations=200, tolerance=1e-10)
+    coef = np.asarray(models[0].model.coefficients.means)
+    assert np.all(np.isfinite(coef))
+    # Elastic net with strong L1 share should zero some of the true-zero coords.
+    assert np.sum(np.abs(coef[[1, 2, 4, 5]]) < 1e-6) >= 2
+
+
+def test_variance_computation(rng):
+    X, y = _binary_data(rng, n=300, d=5)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=50, tolerance=1e-8, regularization_weight=1.0,
+        regularization_context=RegularizationContext(RegularizationType.L2))
+    problem = GLMOptimizationProblem(cfg, TaskType.LOGISTIC_REGRESSION,
+                                     compute_variances=True)
+    model, _ = problem.run(batch)
+    v = np.asarray(model.coefficients.variances)
+    assert v.shape == (5,) and np.all(v > 0) and np.all(np.isfinite(v))
+
+
+def test_factory_rules():
+    # TRON + L1 refused at config construction (OptimizerFactory.scala:78-79).
+    with pytest.raises(ValueError, match="TRON"):
+        GLMOptimizationConfiguration(
+            optimizer_type=OptimizerType.TRON,
+            regularization_context=RegularizationContext(RegularizationType.L1))
+    # smoothed hinge + TRON refused at problem construction.
+    with pytest.raises(ValueError, match="twice-differentiable"):
+        GLMOptimizationProblem(
+            GLMOptimizationConfiguration(optimizer_type=OptimizerType.TRON),
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+
+
+def test_config_string_round_trip():
+    cfg = GLMOptimizationConfiguration.parse("50,1e-9,10.0,0.3,LBFGS,L2")
+    assert cfg.max_iterations == 50
+    assert cfg.tolerance == 1e-9
+    assert cfg.regularization_weight == 10.0
+    assert cfg.down_sampling_rate == 0.3
+    assert cfg.optimizer_type == OptimizerType.LBFGS
+    assert cfg.regularization_context.reg_type == RegularizationType.L2
+    assert GLMOptimizationConfiguration.parse(cfg.render()) == cfg
+    with pytest.raises(ValueError):
+        GLMOptimizationConfiguration.parse("1,2,3")
+    with pytest.raises(ValueError):
+        GLMOptimizationConfiguration.parse("50,1e-9,10.0,1.5,LBFGS,L2")
+
+
+def test_svm_classifier_predictions(rng):
+    X, y = _binary_data(rng)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    models = train_glm_grid(batch, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+                            regularization_weights=[1.0])
+    model = models[0].model
+    preds = np.asarray(model.predict_class(jnp.asarray(X)))
+    assert set(np.unique(preds)) <= {0, 1}
+    assert np.mean(preds == y) > 0.7
