@@ -1,0 +1,93 @@
+"""Headline benchmark: fused L-BFGS gradient-evaluation throughput.
+
+Measures value+gradient evaluations/sec of the logistic GLM objective (the
+innermost distributed kernel of every solver in the reference —
+DistributedGLMLossFunction.calculate -> ValueAndGradientAggregator
+treeAggregate, reference file photon-ml/src/main/scala/com/linkedin/photon/
+ml/function/ValueAndGradientAggregator.scala:235-250) on one chip, and
+compares against a NumPy single-process proxy of the reference's
+Breeze-on-CPU per-core work (BASELINE.json: "L-BFGS grad-evals/sec/chip",
+Spark-local-CPU comparison point).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_ROWS = 1 << 18  # 262144
+DIM = 2048
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, DIM)).astype(np.float32)
+    w_true = (rng.normal(size=DIM) / np.sqrt(DIM)).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.uniform(size=N_ROWS) < p).astype(np.float32)
+    w = rng.normal(size=DIM).astype(np.float32) * 0.01
+    return X, y, w
+
+
+def bench_numpy(X, y, w, iters=3):
+    # Reference-shaped CPU work: margin, pointwise loss derivative, X^T r.
+    def eval_once():
+        z = X @ w
+        p = 1.0 / (1.0 + np.exp(-z))
+        val = np.sum(np.logaddexp(0.0, z) - y * z)
+        g = X.T @ (p - y)
+        return val, g
+
+    eval_once()  # warm the caches
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v, g = eval_once()
+    dt = (time.perf_counter() - t0) / iters
+    return 1.0 / dt
+
+
+def bench_jax(X, y, w, iters=50):
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import DenseBatch
+    from photon_ml_tpu.ops.aggregators import GLMObjective
+    from photon_ml_tpu.ops.losses import get_loss
+
+    batch = DenseBatch(
+        X=jnp.asarray(X),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(N_ROWS, jnp.float32),
+        weights=jnp.ones(N_ROWS, jnp.float32),
+    )
+    obj = GLMObjective(loss=get_loss("logistic"), l2_lambda=0.0)
+    wj = jnp.asarray(w)
+
+    calc = jax.jit(lambda w, b: obj.calculate(w, b))
+    v, g = calc(wj, batch)
+    jax.block_until_ready((v, g))  # compile + warmup
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v, g = calc(wj, batch)
+    jax.block_until_ready((v, g))
+    dt = (time.perf_counter() - t0) / iters
+    return 1.0 / dt
+
+
+def main():
+    X, y, w = _data()
+    cpu_evals = bench_numpy(X, y, w)
+    tpu_evals = bench_jax(X, y, w)
+    print(json.dumps({
+        "metric": "logistic_grad_evals_per_sec",
+        "value": round(tpu_evals, 2),
+        "unit": f"evals/s (N={N_ROWS}, D={DIM}, f32)",
+        "vs_baseline": round(tpu_evals / cpu_evals, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -200,20 +200,28 @@ def ell_from_rows(
     )
 
 
-def pad_batch(batch: DenseBatch, target_rows: int) -> DenseBatch:
-    """Zero-pad a dense batch to ``target_rows`` rows (weights 0 => no-op rows).
+def pad_batch(batch: Batch, target_rows: int) -> Batch:
+    """Zero-pad a batch to ``target_rows`` rows (weights 0 => no-op rows).
 
     Used to make shard sizes uniform before placing a batch on a device mesh.
     """
-    n = batch.X.shape[0]
+    n = batch.labels.shape[0]
     if n == target_rows:
         return batch
     if n > target_rows:
         raise ValueError(f"batch has {n} rows > target {target_rows}")
     pad = target_rows - n
-    return DenseBatch(
-        X=jnp.pad(batch.X, ((0, pad), (0, 0))),
+    meta = dict(
         labels=jnp.pad(batch.labels, (0, pad)),
         offsets=jnp.pad(batch.offsets, (0, pad)),
         weights=jnp.pad(batch.weights, (0, pad)),
+    )
+    if isinstance(batch, DenseBatch):
+        return DenseBatch(X=jnp.pad(batch.X, ((0, pad), (0, 0))), **meta)
+    # ELL: padded rows point at column 0 with value 0 — inert in every sum.
+    return EllBatch(
+        indices=jnp.pad(batch.indices, ((0, pad), (0, 0))),
+        values=jnp.pad(batch.values, ((0, pad), (0, 0))),
+        dim=batch.dim,
+        **meta,
     )

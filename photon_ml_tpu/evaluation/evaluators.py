@@ -77,6 +77,8 @@ class EvaluatorSpec:
             head, id_type = t.split(":", 1)
             if head.upper() != "AUC":
                 raise ValueError(f"unknown sharded evaluator {s!r}")
+            if not id_type:
+                raise ValueError(f"sharded AUC requires an id type: {s!r}")
             return EvaluatorSpec(EvaluatorType.SHARDED_AUC, id_type=id_type)
         return EvaluatorSpec(EvaluatorType(t.upper()))
 
@@ -140,40 +142,15 @@ def sharded_auc(labels: Array, scores: Array, entity_ids: Array,
                 num_entities: int, weights: Array | None = None) -> Array:
     """Unweighted mean of per-entity AUCs over entities with both classes.
 
-    One lexsort by (entity, score) + segment reductions replaces the
-    reference's groupBy-entity / local-evaluator-per-entity loop
-    (ShardedEvaluator: group -> AreaUnderROCCurveLocalEvaluator per entity).
+    Delegates to the shared segment kernel (metrics.segment_auc_stats) —
+    global AUC is its num_entities=1 case, so tie/weight handling can never
+    diverge between the two paths.
     """
-    w = jnp.ones_like(scores) if weights is None else weights
-    n = scores.shape[0]
-    order = jnp.lexsort((scores, entity_ids))
-    e_s = entity_ids[order]
-    s_s = scores[order]
-    pos_s = labels[order] > 0.5
-    wp_s = jnp.where(pos_s, w[order], 0.0)
-    wn_s = jnp.where(pos_s, 0.0, w[order])
-
-    # Exclusive global cumsum of negative weight, made per-entity by
-    # subtracting the entity-start value (cumsum is nondecreasing, so the
-    # entity minimum IS the start value).
-    cum_n = jnp.concatenate([jnp.zeros(1, w.dtype), jnp.cumsum(wn_s)[:-1]])
-    ent_start = jax.ops.segment_min(cum_n, e_s, num_segments=num_entities)
-    n_below_in_entity = cum_n - ent_start[e_s]
-
-    # Tie groups within an entity.
-    new_group = jnp.concatenate(
-        [jnp.ones(1, bool), (e_s[1:] != e_s[:-1]) | (s_s[1:] != s_s[:-1])])
-    gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-    g_n = jax.ops.segment_sum(wn_s, gid, num_segments=n)
-    g_below = jax.ops.segment_min(n_below_in_entity, gid, num_segments=n)
-
-    contrib = wp_s * (g_below[gid] + 0.5 * g_n[gid])
-    num_e = jax.ops.segment_sum(contrib, e_s, num_segments=num_entities)
-    pos_e = jax.ops.segment_sum(wp_s, e_s, num_segments=num_entities)
-    neg_e = jax.ops.segment_sum(wn_s, e_s, num_segments=num_entities)
-
-    valid = (pos_e > 0.0) & (neg_e > 0.0)
-    auc_e = num_e / jnp.maximum(pos_e * neg_e, jnp.finfo(w.dtype).tiny)
+    num_e, pos_e, neg_e = metrics.segment_auc_stats(
+        labels, scores, weights, entity_ids, num_entities)
+    denom = pos_e * neg_e
+    valid = denom > 0.0
+    auc_e = num_e / jnp.where(valid, denom, 1.0)
     return jnp.sum(jnp.where(valid, auc_e, 0.0)) / jnp.maximum(
         jnp.sum(valid), 1)
 

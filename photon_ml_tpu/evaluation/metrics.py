@@ -47,45 +47,63 @@ def _wmean(x: Array, weights: Array | None) -> Array:
 # --- ROC AUC (exact, weighted, tie-aware) ----------------------------------
 
 
+def segment_auc_stats(labels: Array, scores: Array, weights: Array | None,
+                      entity_ids: Array, num_entities: int
+                      ) -> tuple[Array, Array, Array]:
+    """Per-entity Mann-Whitney numerator + class weights, one fused kernel.
+
+    Returns ``(num_e, pos_e, neg_e)`` per entity, where AUC_e =
+    num_e / (pos_e * neg_e) when both classes are present. Global AUC is the
+    ``num_entities=1`` case; per-entity sharded AUC passes real ids. One
+    lexsort by (entity, score) + segment reductions replaces the reference's
+    groupBy-entity / local-evaluator-per-entity loop (ShardedEvaluator ->
+    AreaUnderROCCurveLocalEvaluator per entity). Ties contribute half,
+    matching MLlib's curve integration.
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    n = scores.shape[0]
+    order = jnp.lexsort((scores, entity_ids))
+    e_s = entity_ids[order]
+    s_s = scores[order]
+    pos_s = labels[order] > 0.5
+    wp_s = jnp.where(pos_s, w[order], 0.0)
+    wn_s = jnp.where(pos_s, 0.0, w[order])
+
+    # Exclusive global cumsum of negative weight, made per-entity by
+    # subtracting the entity-start value (cumsum is nondecreasing, so the
+    # entity minimum IS the start value).
+    cum_n = jnp.concatenate([jnp.zeros(1, w.dtype), jnp.cumsum(wn_s)[:-1]])
+    ent_start = jax.ops.segment_min(cum_n, e_s, num_segments=num_entities)
+    n_below_in_entity = cum_n - ent_start[e_s]
+
+    # Tie groups within an entity.
+    new_group = jnp.concatenate(
+        [jnp.ones(1, bool), (e_s[1:] != e_s[:-1]) | (s_s[1:] != s_s[:-1])])
+    gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    g_n = jax.ops.segment_sum(wn_s, gid, num_segments=n)
+    g_below = jax.ops.segment_min(n_below_in_entity, gid, num_segments=n)
+
+    contrib = wp_s * (g_below[gid] + 0.5 * g_n[gid])
+    num_e = jax.ops.segment_sum(contrib, e_s, num_segments=num_entities)
+    pos_e = jax.ops.segment_sum(wp_s, e_s, num_segments=num_entities)
+    neg_e = jax.ops.segment_sum(wn_s, e_s, num_segments=num_entities)
+    return num_e, pos_e, neg_e
+
+
 def area_under_roc_curve(labels: Array, scores: Array,
                          weights: Array | None = None) -> Array:
     """P(score_pos > score_neg) + 0.5 P(tie), weighted.
 
     Exact rank statistic — equivalent to the trapezoidal area under the full
-    (unbinned) ROC curve. Ties contribute half, matching the Mann-Whitney
-    convention the reference inherits from MLlib's curve integration.
+    (unbinned) ROC curve.
     """
-    w = jnp.ones_like(scores) if weights is None else weights
-    pos = labels > 0.5
-    wp = jnp.where(pos, w, 0.0)
-    wn = jnp.where(pos, 0.0, w)
-
-    order = jnp.argsort(scores)
-    s = scores[order]
-    wp_s = wp[order]
-    wn_s = wn[order]
-
-    # Exclusive cumulative negative weight below each sorted position.
-    cum_n_below = jnp.concatenate([jnp.zeros(1, w.dtype),
-                                   jnp.cumsum(wn_s)[:-1]])
-
-    # Tie groups: positions with equal score share one group. For each
-    # element, the negative weight strictly below its group plus half of the
-    # negative weight tied with it.
-    new_group = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
-    group_id = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-    n = scores.shape[0]
-    group_n = jax.ops.segment_sum(wn_s, group_id, num_segments=n)
-    group_n_below = jax.ops.segment_min(cum_n_below, group_id, num_segments=n)
-
-    contrib = wp_s * (group_n_below[group_id] + 0.5 * group_n[group_id])
-    total_pos = jnp.sum(wp)
-    total_neg = jnp.sum(wn)
-    tiny = jnp.finfo(w.dtype).tiny
-    auc = jnp.sum(contrib) / jnp.maximum(total_pos * total_neg, tiny)
+    ids = jnp.zeros(scores.shape[0], jnp.int32)
+    num, pos, neg = segment_auc_stats(labels, scores, weights, ids, 1)
+    denom = pos[0] * neg[0]
+    auc = num[0] / jnp.where(denom > 0.0, denom, 1.0)
     # Single-class input has no ranking information: neutral 0.5 (keeps
     # best-model comparisons well-defined instead of NaN).
-    return jnp.where(total_pos * total_neg > 0.0, auc, 0.5)
+    return jnp.where(denom > 0.0, auc, 0.5)
 
 
 # --- PR AUC and peak F1 -----------------------------------------------------
@@ -107,9 +125,10 @@ def _pr_points(labels: Array, scores: Array, weights: Array | None):
     # A threshold is valid at the LAST element of each tie group (descending
     # order => cumulative counts include the full group there).
     is_boundary = jnp.concatenate([s[:-1] != s[1:], jnp.ones(1, bool)])
-    tiny = jnp.finfo(w.dtype).tiny
-    precision = cum_tp / jnp.maximum(cum_pred_pos, tiny)
-    recall = cum_tp / jnp.maximum(total_pos, tiny)
+    # where-guards, not finfo.tiny: TPU flushes tiny to zero, turning the
+    # zero-positive / zero-weight cases into 0/0 = NaN.
+    precision = cum_tp / jnp.where(cum_pred_pos > 0.0, cum_pred_pos, 1.0)
+    recall = cum_tp / jnp.where(total_pos > 0.0, total_pos, 1.0)
     return precision, recall, is_boundary, cum_tp, cum_pred_pos, total_pos
 
 
