@@ -1,0 +1,38 @@
+"""GAME: Generalized Additive Mixed Effects on TPU.
+
+The flagship subsystem (reference README.md:73-99): a coordinate-descent
+outer loop over a global fixed-effect GLM, per-entity random-effect GLMs
+(vmapped + entity-sharded), and optional factored random effects.
+"""
+
+from photon_ml_tpu.game.coordinate import (  # noqa: F401
+    FactoredRandomEffectCoordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import (  # noqa: F401
+    CoordinateDescentResult,
+    run_coordinate_descent,
+    training_loss_evaluator,
+)
+from photon_ml_tpu.game.dataset import (  # noqa: F401
+    FixedEffectDataConfiguration,
+    FixedEffectDataset,
+    GameDataset,
+    RandomEffectDataConfiguration,
+    RandomEffectDataset,
+    build_fixed_effect_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.game.models import (  # noqa: F401
+    FactoredRandomEffectModel,
+    FixedEffectModel,
+    GameModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+    RandomEffectModelInProjectedSpace,
+)
+from photon_ml_tpu.game.random_effect import (  # noqa: F401
+    RandomEffectOptimizationProblem,
+    score_random_effect,
+)

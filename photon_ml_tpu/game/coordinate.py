@@ -1,0 +1,313 @@
+"""GAME coordinates: per-coordinate update/score units for coordinate descent.
+
+TPU-native re-design of the reference's coordinate family
+(reference paths under photon-ml/src/main/scala/com/linkedin/photon/ml/
+algorithm/):
+
+- ``Coordinate`` (Coordinate.scala:26-82): updateModel(model, partialScore →
+  offsets), score(model), regularization value.
+- ``FixedEffectCoordinate`` (FixedEffectCoordinate.scala:34-165): optimize a
+  GLM on the offset-adjusted full batch via
+  DistributedOptimizationProblem.runWithSampling (down-sampling per update).
+- ``RandomEffectCoordinate`` (RandomEffectCoordinate.scala:99-199): per-entity
+  local solves (here: the vmapped block solver) + active/passive scoring.
+- ``RandomEffectCoordinateInProjectedSpace``
+  (RandomEffectCoordinateInProjectedSpace.scala:25-149): models live in
+  projected space — here that is the *native* representation; raw-space
+  conversion happens when the model is published.
+- ``FactoredRandomEffectCoordinate`` (FactoredRandomEffectCoordinate.scala:
+  39-257): alternate per-entity latent fits with a distributed fit of the
+  latent→raw projection on Kronecker-product features (:228-271) — the
+  Kronecker expansion is one einsum on TPU.
+
+Every coordinate's state is (model arrays, sample-axis score vector); the
+partial-score offset injection is a gather along the stored row ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import DenseBatch
+from photon_ml_tpu.game.dataset import (
+    FixedEffectDataset,
+    RandomEffectDataset,
+)
+from photon_ml_tpu.game.models import (
+    FactoredRandomEffectModel,
+    FixedEffectModel,
+    RandomEffectModelInProjectedSpace,
+)
+from photon_ml_tpu.game.random_effect import (
+    RandomEffectOptimizationProblem,
+    score_random_effect,
+)
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.optimize.common import OptimizationResult
+from photon_ml_tpu.optimize.config import TaskType
+from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.sampler.samplers import down_sample
+
+Array = jnp.ndarray
+
+_CLASSIFICATION_TASKS = (
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+)
+
+
+@dataclasses.dataclass
+class FixedEffectTracker:
+    """optimization/game/FixedEffectOptimizationTracker analog."""
+
+    result: OptimizationResult
+
+    def summary(self) -> str:
+        return (f"fixed effect: {self.result.convergence_reason.name}, "
+                f"{self.result.iterations} iterations")
+
+
+@dataclasses.dataclass
+class RandomEffectTracker:
+    """optimization/game/RandomEffectOptimizationTracker analog: iteration
+    counts across entities."""
+
+    iterations: np.ndarray  # [E]
+    final_values: np.ndarray  # [E]
+
+    def summary(self) -> str:
+        it = self.iterations
+        return (f"random effect: {len(it)} entities, iterations "
+                f"min/mean/max = {it.min()}/{it.mean():.1f}/{it.max()}")
+
+
+@dataclasses.dataclass
+class FactoredRandomEffectTracker:
+    inner: list[tuple[RandomEffectTracker, FixedEffectTracker]]
+
+    def summary(self) -> str:
+        return (f"factored random effect: {len(self.inner)} inner iterations")
+
+
+Tracker = Union[FixedEffectTracker, RandomEffectTracker,
+                FactoredRandomEffectTracker]
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FixedEffectCoordinate:
+    """Global GLM coordinate over the mesh-sharded sample batch."""
+
+    dataset: FixedEffectDataset
+    problem: GLMOptimizationProblem
+    seed: int = 0
+    _update_count: int = 0
+
+    @property
+    def num_samples(self) -> int:
+        return self.dataset.num_samples
+
+    def initial_state(self) -> Array:
+        """Zero coefficients in normalized space."""
+        return jnp.zeros(self.dataset.batch.num_features)
+
+    def update(self, coefs: Optional[Array], extra_scores: Array
+               ) -> tuple[Array, Tracker]:
+        """Re-optimize on the offset-adjusted batch
+        (FixedEffectCoordinate.updateModel :137-148 + runWithSampling)."""
+        batch = self.dataset.with_offsets(extra_scores)
+        rate = self.problem.config.down_sampling_rate
+        if rate < 1.0:
+            key = jax.random.PRNGKey(self.seed + self._update_count)
+            batch = down_sample(
+                batch, rate, key,
+                is_classification=self.problem.task in _CLASSIFICATION_TASKS)
+        self._update_count += 1
+        _, result = self.problem.run(batch, initial=coefs)
+        return result.coefficients, FixedEffectTracker(result)
+
+    def score(self, coefs: Array) -> Array:
+        """Sample-axis margins x.w (normalized-space coefficients are scored
+        through the normalization's effective-coefficient algebra)."""
+        w_eff, shift = self.problem.normalization.effective_coefficients(coefs)
+        zero_off = self.dataset.batch._replace(
+            offsets=jnp.zeros_like(self.dataset.base_offsets))
+        return zero_off.margins(w_eff, shift)
+
+    def regularization_value(self, coefs: Array) -> float:
+        return self.problem.regularization_value(coefs)
+
+    def publish(self, coefs: Array) -> FixedEffectModel:
+        means = self.problem.normalization.transform_model_coefficients(coefs)
+        model = GeneralizedLinearModel(Coefficients(means=means),
+                                       self.problem.task)
+        return FixedEffectModel(model=model,
+                                feature_shard_id=self.dataset.shard_id)
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RandomEffectCoordinate:
+    """Per-entity GLM coordinate, vmapped over the entity axis.
+
+    Combines the reference's RandomEffectCoordinate and its projected-space
+    wrapper: the dataset is already in each entity's reduced space, so the
+    coordinate state (``[E, D_red]``) is the projected model
+    (RandomEffectCoordinateInProjectedSpace.scala:25-149).
+    """
+
+    dataset: RandomEffectDataset
+    problem: RandomEffectOptimizationProblem
+
+    @property
+    def num_samples(self) -> int:
+        return self.dataset.num_samples
+
+    def initial_state(self) -> Array:
+        return jnp.zeros((self.dataset.num_entities, self.dataset.reduced_dim))
+
+    def update(self, coefs: Optional[Array], extra_scores: Array
+               ) -> tuple[Array, Tracker]:
+        offsets = self.dataset.base_offsets + self.dataset.gather_offsets(
+            extra_scores)
+        new_coefs, iters, values = self.problem.run(
+            self.dataset, offsets, initial=coefs)
+        tracker = RandomEffectTracker(np.asarray(iters), np.asarray(values))
+        return new_coefs, tracker
+
+    def score(self, coefs: Array) -> Array:
+        return score_random_effect(self.dataset, coefs)
+
+    def regularization_value(self, coefs: Array) -> float:
+        return self.problem.regularization_value(coefs)
+
+    def publish(self, coefs: Array) -> RandomEffectModelInProjectedSpace:
+        return RandomEffectModelInProjectedSpace(
+            random_effect_type=self.dataset.config.random_effect_type,
+            feature_shard_id=self.dataset.config.feature_shard_id,
+            entity_codes=self.dataset.entity_codes,
+            coefficients_projected=coefs,
+            projectors=self.dataset.projectors,
+            random_projector=self.dataset.random_projector,
+        )
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FactoredRandomEffectCoordinate:
+    """Alternating latent-space random effect + projection-matrix fit.
+
+    The dataset must be built with IDENTITY projection (raw-space blocks
+    ``[E, N, D]``). Each update runs ``num_inner_iterations`` of:
+
+    1. project actives into the current latent space
+       (``X_lat = X · Bᵀ``, one einsum) and solve per-entity latent
+       coefficients with the vmapped block solver
+       (FactoredRandomEffectCoordinate.scala:228-257's random-effect step);
+    2. refit B on Kronecker-product features ``c_e ⊗ x`` with a single
+       GLM whose coefficient vector is vec(B)
+       (kroneckerProductFeaturesAndCoefficients :271) — the expansion is an
+       einsum producing ``[E·N, K·D]``.
+    """
+
+    dataset: RandomEffectDataset  # identity-projected (raw blocks)
+    problem: RandomEffectOptimizationProblem  # latent per-entity fits
+    latent_problem: GLMOptimizationProblem  # projection-matrix fit
+    latent_dim: int
+    num_inner_iterations: int = 2
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.dataset.projectors is not None or \
+                self.dataset.random_projector is not None:
+            raise ValueError(
+                "factored coordinate needs an identity-projected dataset")
+
+    @property
+    def num_samples(self) -> int:
+        return self.dataset.num_samples
+
+    def initial_state(self) -> tuple[Array, Array]:
+        k = self.latent_dim
+        e = self.dataset.num_entities
+        d = self.dataset.reduced_dim
+        # Random projection init (MFOptimizationConfiguration analog).
+        b0 = jax.random.normal(jax.random.PRNGKey(self.seed), (k, d)) / \
+            jnp.sqrt(k)
+        return jnp.zeros((e, k)), b0
+
+    def update(self, state: Optional[tuple[Array, Array]],
+               extra_scores: Array) -> tuple[tuple[Array, Array], Tracker]:
+        coefs, B = state if state is not None else self.initial_state()
+        ds = self.dataset
+        offsets = ds.base_offsets + ds.gather_offsets(extra_scores)
+        inner: list = []
+        for _ in range(self.num_inner_iterations):
+            # (1) latent-space per-entity fits on projected blocks.
+            X_lat = jnp.einsum("end,kd->enk", ds.X, B,
+                               preferred_element_type=jnp.float32)
+            lat_ds = dataclasses.replace(ds, X=X_lat, projectors=None,
+                                         random_projector=None)
+            coefs, iters, values = self.problem.run(lat_ds, offsets,
+                                                    initial=coefs)
+            re_tracker = RandomEffectTracker(np.asarray(iters),
+                                             np.asarray(values))
+            # (2) projection-matrix fit on Kronecker features c_e ⊗ x.
+            e, n, d = ds.X.shape
+            k = self.latent_dim
+            kron = jnp.einsum("ek,end->enkd", coefs, ds.X,
+                              preferred_element_type=jnp.float32)
+            flat = DenseBatch(
+                X=kron.reshape(e * n, k * d),
+                labels=ds.labels.reshape(-1),
+                offsets=offsets.reshape(-1),
+                weights=ds.weights.reshape(-1),
+            )
+            _, result = self.latent_problem.run(
+                flat, initial=B.reshape(-1))
+            B = result.coefficients.reshape(k, d)
+            inner.append((re_tracker, FixedEffectTracker(result)))
+        return (coefs, B), FactoredRandomEffectTracker(inner)
+
+    def score(self, state: tuple[Array, Array]) -> Array:
+        coefs, B = state
+        X_lat = jnp.einsum("end,kd->enk", self.dataset.X, B,
+                           preferred_element_type=jnp.float32)
+        # Passive rows project through the same latent map for scoring.
+        lat_passive = (None if self.dataset.passive_X is None
+                       else self.dataset.passive_X @ B.T)
+        lat_ds = dataclasses.replace(self.dataset, X=X_lat,
+                                     passive_X=lat_passive,
+                                     projectors=None, random_projector=None)
+        return score_random_effect(lat_ds, coefs)
+
+    def regularization_value(self, state: tuple[Array, Array]) -> float:
+        coefs, B = state
+        return (self.problem.regularization_value(coefs)
+                + self.latent_problem.regularization_value(B.reshape(-1)))
+
+    def publish(self, state: tuple[Array, Array]) -> FactoredRandomEffectModel:
+        coefs, B = state
+        return FactoredRandomEffectModel(
+            random_effect_type=self.dataset.config.random_effect_type,
+            feature_shard_id=self.dataset.config.feature_shard_id,
+            entity_codes=self.dataset.entity_codes,
+            coefficients_latent=coefs,
+            projection=B,
+        )
+
+
+Coordinate = Union[FixedEffectCoordinate, RandomEffectCoordinate,
+                   FactoredRandomEffectCoordinate]

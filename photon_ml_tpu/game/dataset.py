@@ -1,0 +1,541 @@
+"""GAME data layer: columnar dataset, per-coordinate views, score exchange.
+
+TPU-native re-design of the reference's GAME data structures
+(reference paths under photon-ml/src/main/scala/com/linkedin/photon/ml/):
+
+- ``GameDatum`` (data/GameDatum.scala:33-54) — one row with response/offset/
+  weight, per-feature-shard sparse vectors, and an idType→entityId map. Here
+  the whole dataset is **columnar**: response/offset/weight arrays, one CSR
+  matrix per feature shard, and integer entity-code columns per id type.
+- ``FixedEffectDataSet`` (data/FixedEffectDataSet.scala:29-103) — an RDD of
+  rows for one shard. Here: a device batch (dense or ELL) whose rows ARE the
+  sample axis, sharded over the mesh ``data`` axis.
+- ``RandomEffectDataSet`` (data/RandomEffectDataSet.scala:40-317) — active
+  data grouped per entity (reservoir-capped), passive overflow, projection.
+  Here: padded entity-major blocks ``[E, N_max, D_red]`` plus sample-major
+  passive arrays; the sample↔entity layout exchange is a gather/scatter by
+  row id (the Spark-shuffle analog, SURVEY §5.7).
+- ``KeyValueScore`` (data/KeyValueScore.scala:32-95) — score vector keyed by
+  unique sample id. Here: a plain ``[N]`` array aligned to row order; the
+  outer-join ``+``/``-`` becomes elementwise add/sub.
+
+Ragged→static design (SURVEY §7 hard part 1): active rows per entity are
+capped (reservoir), entity blocks are padded to one ``N_max`` and reduced
+feature spaces padded to one ``D_red``; padded rows carry weight 0 and row id
+``N`` (scores scattered there land in a discard slot).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch, DenseBatch, ell_from_rows
+from photon_ml_tpu.projector.projectors import (
+    IndexMapProjectors,
+    ProjectorConfig,
+    ProjectorType,
+    RandomProjector,
+    build_index_map_projectors,
+    build_random_projector,
+)
+
+Array = jnp.ndarray
+
+# Densify a shard below this width; ELL above (mirrors the reference's
+# representation switch around 200k features, cli/game/training/Driver.scala:
+# 357-363 — ours trades dense MXU matmuls against gather/scatter cost).
+DENSE_FEATURE_THRESHOLD = 4096
+
+
+# ---------------------------------------------------------------------------
+# Columnar GAME dataset (host side)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """Columnar GAME dataset: the host-resident source of per-coordinate views.
+
+    ``feature_shards[shard]`` is a scipy CSR ``[N, D_shard]``;
+    ``id_columns[id_type]`` holds integer entity codes (`0..V-1`) with the
+    original ids in ``id_vocabs[id_type]`` (GameDatum.scala:33-54's
+    idTypeToValueMap, dictionary-encoded).
+    """
+
+    responses: np.ndarray  # [N] float
+    feature_shards: dict[str, sp.csr_matrix]
+    offsets: Optional[np.ndarray] = None  # [N]
+    weights: Optional[np.ndarray] = None  # [N]
+    id_columns: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    id_vocabs: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        n = len(self.responses)
+        self.responses = np.asarray(self.responses, dtype=np.float64)
+        if self.offsets is None:
+            self.offsets = np.zeros(n)
+        if self.weights is None:
+            self.weights = np.ones(n)
+        for name, mat in list(self.feature_shards.items()):
+            if not sp.issparse(mat):
+                self.feature_shards[name] = sp.csr_matrix(np.asarray(mat))
+            else:
+                self.feature_shards[name] = mat.tocsr()
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.responses)
+
+    def shard_dim(self, shard: str) -> int:
+        return self.feature_shards[shard].shape[1]
+
+    def encode_ids(self, id_type: str, raw_ids: np.ndarray) -> None:
+        """Dictionary-encode a raw id column (strings or ints) into codes."""
+        vocab, codes = np.unique(np.asarray(raw_ids), return_inverse=True)
+        self.id_columns[id_type] = codes.astype(np.int64)
+        self.id_vocabs[id_type] = vocab
+
+
+# ---------------------------------------------------------------------------
+# Scores (KeyValueScore analog)
+# ---------------------------------------------------------------------------
+
+
+def zero_scores(n: int) -> np.ndarray:
+    return np.zeros(n)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-effect view
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FixedEffectDataset:
+    """Device batch over the full sample axis for one feature shard.
+
+    Reference: data/FixedEffectDataSet.scala:29-103. ``batch`` rows align
+    with GameDataset row order, so coordinate-descent offset injection
+    (addScoresToOffsets, :55-74 analog) is a plain array swap — see
+    ``with_offsets``.
+    """
+
+    shard_id: str
+    batch: Batch
+    base_offsets: Array  # original data offsets (before CD score injection)
+
+    @property
+    def num_samples(self) -> int:
+        return int(self.batch.labels.shape[0])
+
+    def with_offsets(self, extra_scores: Array) -> Batch:
+        """Batch whose offsets = data offsets + other coordinates' scores."""
+        return self.batch._replace(offsets=self.base_offsets + extra_scores)
+
+
+def _csr_to_batch(
+    mat: sp.csr_matrix,
+    labels: np.ndarray,
+    offsets: np.ndarray,
+    weights: np.ndarray,
+    dtype=jnp.float32,
+    dense_threshold: int = DENSE_FEATURE_THRESHOLD,
+) -> Batch:
+    if mat.shape[1] <= dense_threshold:
+        return DenseBatch(
+            X=jnp.asarray(mat.toarray(), dtype),
+            labels=jnp.asarray(labels, jnp.float32),
+            offsets=jnp.asarray(offsets, jnp.float32),
+            weights=jnp.asarray(weights, jnp.float32),
+        )
+    rows = [
+        (mat.indices[mat.indptr[i]:mat.indptr[i + 1]],
+         mat.data[mat.indptr[i]:mat.indptr[i + 1]])
+        for i in range(mat.shape[0])
+    ]
+    return ell_from_rows(rows, mat.shape[1], labels, offsets, weights,
+                         dtype=dtype)
+
+
+def build_fixed_effect_dataset(
+    data: GameDataset,
+    shard_id: str,
+    dtype=jnp.float32,
+    dense_threshold: int = DENSE_FEATURE_THRESHOLD,
+) -> FixedEffectDataset:
+    mat = data.feature_shards[shard_id]
+    batch = _csr_to_batch(mat, data.responses, data.offsets, data.weights,
+                          dtype=dtype, dense_threshold=dense_threshold)
+    return FixedEffectDataset(shard_id=shard_id, batch=batch,
+                              base_offsets=batch.offsets)
+
+
+# ---------------------------------------------------------------------------
+# Load-balanced entity partitioning
+# ---------------------------------------------------------------------------
+
+
+def balanced_entity_order(counts: np.ndarray, num_bins: int,
+                          capacity: int = 10000) -> np.ndarray:
+    """Greedy bin-pack entities by sample count; return a permutation whose
+    contiguous ``num_bins`` slices are load-balanced.
+
+    Mirrors data/RandomEffectDataSetPartitioner.scala:31-108: the heaviest
+    ``capacity`` entities are placed greedily onto the lightest bin (min-heap
+    by assigned samples); the long tail is hashed. Two changes for the mesh
+    layout: bins become contiguous index ranges (sharding = slicing), and bin
+    cardinality is capped at ⌈E/num_bins⌉ so equal-size slices line up with
+    the bins (padded entity blocks all cost the same compute anyway — load
+    balance here equalizes *active sample mass* per shard for build/IO).
+    """
+    import heapq
+
+    e = len(counts)
+    if e == 0:
+        return np.zeros(0, dtype=np.int64)
+    order = np.argsort(-counts, kind="stable")
+    heavy = order[: min(capacity, e)]
+    tail = order[min(capacity, e):]
+    cap = -(-e // num_bins)
+    bins: list[list[int]] = [[] for _ in range(num_bins)]
+    heap = [(0, b) for b in range(num_bins)]
+    heapq.heapify(heap)
+    for ent in heavy:
+        spill = []
+        while True:
+            load, b = heapq.heappop(heap)
+            if len(bins[b]) < cap:
+                break
+            spill.append((load, b))
+        bins[b].append(int(ent))
+        heapq.heappush(heap, (load + int(counts[ent]), b))
+        for item in spill:
+            heapq.heappush(heap, item)
+    for ent in tail:
+        b = int(ent) % num_bins
+        if len(bins[b]) >= cap:
+            b = min(range(num_bins), key=lambda i: len(bins[i]))
+        bins[b].append(int(ent))
+    return np.concatenate([np.asarray(b, dtype=np.int64) for b in bins])
+
+
+# ---------------------------------------------------------------------------
+# Random-effect view
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfiguration:
+    """Per-coordinate data knobs (data/RandomEffectDataConfiguration.scala:80).
+
+    String format (parity with the reference's CLI):
+    ``idType,featureShardId,numPartitions[,activeBound[,passiveBound
+    [,numFeaturesToKeep[,projector]]]]`` with ``-`` / ``none`` meaning unset.
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    num_partitions: int = 1
+    num_active_data_points_upper_bound: Optional[int] = None
+    num_passive_data_points_lower_bound: Optional[int] = None
+    num_features_to_keep_upper_bound: Optional[int] = None
+    projector: ProjectorConfig = ProjectorConfig(ProjectorType.INDEX_MAP)
+
+    @staticmethod
+    def parse(s: str) -> "RandomEffectDataConfiguration":
+        parts = [p.strip() for p in s.split(",")]
+        if len(parts) < 3:
+            raise ValueError(
+                f"random-effect data config needs at least idType,shard,"
+                f"numPartitions: {s!r}")
+
+        def opt_int(i):
+            if i >= len(parts) or parts[i] in ("", "-", "none", "None"):
+                return None
+            return int(parts[i])
+
+        proj = ProjectorConfig(ProjectorType.INDEX_MAP)
+        if len(parts) > 6 and parts[6] not in ("", "-", "none"):
+            proj = ProjectorConfig.parse(parts[6])
+        return RandomEffectDataConfiguration(
+            random_effect_type=parts[0],
+            feature_shard_id=parts[1],
+            num_partitions=int(parts[2]),
+            num_active_data_points_upper_bound=opt_int(3),
+            num_passive_data_points_lower_bound=opt_int(4),
+            num_features_to_keep_upper_bound=opt_int(5),
+            projector=proj,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataConfiguration:
+    """data/FixedEffectDataConfiguration.scala:23 — ``shardId[,minPartitions]``."""
+
+    feature_shard_id: str
+    min_num_partitions: int = 1
+
+    @staticmethod
+    def parse(s: str) -> "FixedEffectDataConfiguration":
+        parts = [p.strip() for p in s.split(",")]
+        return FixedEffectDataConfiguration(
+            feature_shard_id=parts[0],
+            min_num_partitions=int(parts[1]) if len(parts) > 1 else 1,
+        )
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """Entity-major active blocks + sample-major passive rows for one coordinate.
+
+    Active data (trained on): padded dense blocks in each entity's reduced
+    feature space —
+      ``X [E, N_max, D_red]``, ``labels/offsets/weights [E, N_max]``,
+      ``row_ids [E, N_max]`` int32 (pad → ``num_samples``: scatters to a
+      discard slot).
+    Passive data (scored only, RandomEffectDataSet.scala:328+):
+      ``passive_X [P, D_red]`` already projected per its entity,
+      ``passive_entity [P]`` local entity index, ``passive_row_ids [P]``.
+
+    ``entity_codes`` maps local entity index → dataset entity code;
+    ``projectors`` maps reduced columns back to raw feature ids.
+    """
+
+    config: RandomEffectDataConfiguration
+    entity_codes: np.ndarray  # [E] codes into GameDataset vocab
+    X: Array  # [E, N_max, D_red]
+    labels: Array  # [E, N_max]
+    base_offsets: Array  # [E, N_max]
+    weights: Array  # [E, N_max] (0 = padding)
+    row_ids: Array  # [E, N_max] int32 (num_samples = discard)
+    num_samples: int  # N of the parent GameDataset
+    projectors: Optional[IndexMapProjectors] = None
+    random_projector: Optional[RandomProjector] = None
+    # passive side (may be empty)
+    passive_X: Optional[Array] = None  # [P, D_red]
+    passive_entity: Optional[Array] = None  # [P] int32
+    passive_row_ids: Optional[Array] = None  # [P] int32
+    passive_offsets: Optional[Array] = None  # [P]
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.X.shape[0])
+
+    @property
+    def max_rows_per_entity(self) -> int:
+        return int(self.X.shape[1])
+
+    @property
+    def reduced_dim(self) -> int:
+        return int(self.X.shape[2])
+
+    @property
+    def num_passive(self) -> int:
+        return 0 if self.passive_X is None else int(self.passive_X.shape[0])
+
+    def gather_offsets(self, scores: Array) -> Array:
+        """Entity-major view of a sample-major score vector (CD offset
+        injection — the all-to-all resharding analog of
+        RandomEffectDataSet.addScoresToOffsets :55-74)."""
+        padded = jnp.concatenate([scores, jnp.zeros(1, scores.dtype)])
+        return padded[self.row_ids]
+
+    def gather_passive_offsets(self, scores: Array) -> Array:
+        if self.passive_row_ids is None:
+            return jnp.zeros(0)
+        return scores[self.passive_row_ids]
+
+
+def _reservoir_cap(rng: np.random.Generator, rows: np.ndarray, cap: int
+                   ) -> tuple[np.ndarray, np.ndarray, float]:
+    """Split one entity's row ids into (active, passive) with weight rescale.
+
+    Mirrors RandomEffectDataSet.scala:254-317: keep a uniform sample of
+    ``cap`` rows as active, rescale their weights by count/cap so expected
+    total weight is preserved; the rest become passive.
+    """
+    n = len(rows)
+    if cap is None or n <= cap:
+        return rows, rows[:0], 1.0
+    keep = rng.choice(n, size=cap, replace=False)
+    mask = np.zeros(n, dtype=bool)
+    mask[keep] = True
+    return rows[mask], rows[~mask], n / cap
+
+
+def _select_features(mat: sp.csr_matrix, rows: np.ndarray, labels: np.ndarray,
+                     keep: Optional[int]) -> np.ndarray:
+    """Union of features in ``rows``, optionally top-``keep`` by |Pearson|.
+
+    Mirrors LocalDataSet.scala:202-248: rank features by absolute Pearson
+    correlation with the label (support count breaks ties implicitly through
+    the correlation of near-constant columns being 0).
+    """
+    sub = mat[rows]
+    present = np.unique(sub.indices) if sub.nnz else np.zeros(0, np.int64)
+    if keep is None or len(present) <= keep:
+        return present
+    sub = sub[:, present]
+    y = labels[rows].astype(np.float64)
+    Xd = np.asarray(sub.todense(), dtype=np.float64)
+    xm = Xd.mean(axis=0)
+    ym = y.mean()
+    cov = ((Xd - xm) * (y - ym)[:, None]).mean(axis=0)
+    sx = Xd.std(axis=0)
+    sy = y.std()
+    denom = sx * sy
+    corr = np.where(denom > 0, np.abs(cov) / np.where(denom > 0, denom, 1.0),
+                    0.0)
+    top = np.argsort(-corr, kind="stable")[:keep]
+    return np.sort(present[top])
+
+
+def build_random_effect_dataset(
+    data: GameDataset,
+    config: RandomEffectDataConfiguration,
+    seed: int = 0,
+    pad_rows_multiple: int = 8,
+    dtype=jnp.float32,
+    entity_axis_size: int = 1,
+) -> RandomEffectDataset:
+    """Group rows per entity, cap/split, project, pad into device blocks.
+
+    ``entity_axis_size``: the entity mesh-axis extent — E is padded to a
+    multiple so the blocks shard evenly; entities are pre-permuted by the
+    greedy load balancer (balanced_entity_order) so contiguous shards carry
+    similar sample mass.
+    """
+    id_type = config.random_effect_type
+    if id_type not in data.id_columns:
+        raise KeyError(f"id type {id_type!r} not in dataset (have "
+                       f"{list(data.id_columns)})")
+    codes = data.id_columns[id_type]
+    mat = data.feature_shards[config.feature_shard_id]
+    n, raw_dim = mat.shape
+    rng = np.random.default_rng(seed)
+
+    # --- group rows by entity (host): one argsort, contiguous slices.
+    order = np.argsort(codes, kind="stable")
+    sorted_codes = codes[order]
+    uniq, starts = np.unique(sorted_codes, return_index=True)
+    bounds = np.append(starts, n)
+    groups = {int(uniq[i]): order[bounds[i]:bounds[i + 1]]
+              for i in range(len(uniq))}
+
+    # --- active/passive split with reservoir cap + weight rescale.
+    cap = config.num_active_data_points_upper_bound
+    active: dict[int, tuple[np.ndarray, float]] = {}
+    passive_rows: list[np.ndarray] = []
+    passive_codes: list[np.ndarray] = []
+    for code, rows in groups.items():
+        act, pas, scale = _reservoir_cap(rng, rows, cap)
+        active[code] = (act, scale)
+        lo = config.num_passive_data_points_lower_bound
+        if len(pas) and (lo is None or len(pas) >= lo):
+            passive_rows.append(pas)
+            passive_codes.append(np.full(len(pas), code, dtype=np.int64))
+
+    # --- load-balanced entity ordering for contiguous sharding.
+    ent_codes = np.asarray(sorted(active), dtype=np.int64)
+    counts = np.asarray([len(active[int(c)][0]) for c in ent_codes])
+    perm = balanced_entity_order(counts, num_bins=max(1, entity_axis_size))
+    ent_codes = ent_codes[perm]
+
+    # --- per-entity feature space (projection).
+    proj_cfg = config.projector
+    projectors = None
+    random_projector = None
+    if proj_cfg.kind == ProjectorType.INDEX_MAP:
+        feats = [
+            _select_features(mat, active[int(c)][0], data.responses,
+                             config.num_features_to_keep_upper_bound)
+            for c in ent_codes
+        ]
+        projectors = build_index_map_projectors(feats, raw_dim)
+        d_red = projectors.max_reduced_dim
+    elif proj_cfg.kind == ProjectorType.RANDOM:
+        random_projector = build_random_projector(
+            raw_dim, proj_cfg.projected_dim, seed=proj_cfg.seed)
+        d_red = proj_cfg.projected_dim
+    else:  # IDENTITY
+        d_red = raw_dim
+
+    # --- pad E to the entity axis and N to a stable multiple.
+    e_real = len(ent_codes)
+    e_pad = max(1, -(-max(e_real, 1) // entity_axis_size) * entity_axis_size)
+    n_max = int(counts.max()) if e_real else 1
+    n_max = max(1, -(-n_max // pad_rows_multiple) * pad_rows_multiple)
+
+    X = np.zeros((e_pad, n_max, d_red), dtype=np.float32)
+    labels = np.zeros((e_pad, n_max), dtype=np.float32)
+    offsets = np.zeros((e_pad, n_max), dtype=np.float32)
+    weights = np.zeros((e_pad, n_max), dtype=np.float32)
+    row_ids = np.full((e_pad, n_max), n, dtype=np.int32)
+
+    for e_i, code in enumerate(ent_codes):
+        rows, scale = active[int(code)]
+        k = len(rows)
+        sub = mat[rows]
+        if projectors is not None:
+            cols = projectors.raw_indices[e_i]
+            valid = cols < raw_dim
+            dense = np.zeros((k, d_red), dtype=np.float32)
+            if valid.any():
+                dense[:, valid] = np.asarray(
+                    sub[:, cols[valid]].todense(), dtype=np.float32)
+            X[e_i, :k] = dense
+        elif random_projector is not None:
+            X[e_i, :k] = (sub @ random_projector.matrix).astype(np.float32)
+        else:
+            X[e_i, :k] = np.asarray(sub.todense(), dtype=np.float32)
+        labels[e_i, :k] = data.responses[rows]
+        offsets[e_i, :k] = data.offsets[rows]
+        weights[e_i, :k] = data.weights[rows] * scale
+        row_ids[e_i, :k] = rows
+
+    # --- passive side (sample-major, already projected per entity).
+    p_X = p_ent = p_rows = p_off = None
+    if passive_rows:
+        pr = np.concatenate(passive_rows)
+        pc = np.concatenate(passive_codes)
+        code_to_local = {int(c): i for i, c in enumerate(ent_codes)}
+        local = np.asarray([code_to_local[int(c)] for c in pc], dtype=np.int32)
+        sub = mat[pr]
+        if projectors is not None:
+            dense = np.zeros((len(pr), d_red), dtype=np.float32)
+            for j in range(len(pr)):
+                r = sub[j]
+                dense[j] = projectors.project_row(
+                    int(local[j]), r.indices, r.data)
+            p_X = jnp.asarray(dense)
+        elif random_projector is not None:
+            p_X = jnp.asarray((sub @ random_projector.matrix)
+                              .astype(np.float32))
+        else:
+            p_X = jnp.asarray(np.asarray(sub.todense(), dtype=np.float32))
+        p_ent = jnp.asarray(local)
+        p_rows = jnp.asarray(pr.astype(np.int32))
+        p_off = jnp.asarray(data.offsets[pr].astype(np.float32))
+
+    return RandomEffectDataset(
+        config=config,
+        entity_codes=ent_codes,
+        X=jnp.asarray(X, dtype),
+        labels=jnp.asarray(labels),
+        base_offsets=jnp.asarray(offsets),
+        weights=jnp.asarray(weights),
+        row_ids=jnp.asarray(row_ids),
+        num_samples=n,
+        projectors=projectors,
+        random_projector=random_projector,
+        passive_X=p_X,
+        passive_entity=p_ent,
+        passive_row_ids=p_rows,
+        passive_offsets=p_off,
+    )

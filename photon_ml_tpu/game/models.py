@@ -1,0 +1,242 @@
+"""GAME model family: fixed-effect, random-effect, factored, MF, composite.
+
+TPU-native re-design of the reference's model layer
+(reference paths under photon-ml/src/main/scala/com/linkedin/photon/ml/model/):
+
+- ``DatumScoringModel.score`` (DatumScoringModel.scala:33) — score an RDD of
+  GameDatum. Here every model scores a :class:`GameDataset` into a plain
+  ``[N]`` sample-major array.
+- ``GAMEModel`` (GAMEModel.scala:29-114) — coordinateId → model map; total
+  score = Σ sub-scores.
+- ``FixedEffectModel`` (FixedEffectModel.scala:29-103) — broadcast GLM + its
+  feature shard. Broadcasting disappears: coefficients live in HBM.
+- ``RandomEffectModel`` (RandomEffectModel.scala:33-165) — RDD[(entityId,
+  GLM)]; scoring cogroups data with models. Here: a stacked coefficient block
+  ``[E, D]`` + the entity vocabulary; scoring is a gather.
+- ``RandomEffectModelInProjectedSpace`` — coefficients kept in each entity's
+  reduced space with the projector retained for raw-space conversion.
+- ``MatrixFactorizationModel`` (MatrixFactorizationModel.scala:50-179) — row/
+  col latent factors; score = dot of the latent vectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Protocol, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import scipy.sparse as sp
+
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.optimize.config import TaskType
+from photon_ml_tpu.projector.projectors import (
+    IndexMapProjectors,
+    RandomProjector,
+)
+
+Array = jnp.ndarray
+
+
+class DatumScoringModel(Protocol):
+    """model/DatumScoringModel.scala:33 analog."""
+
+    def score(self, data: GameDataset) -> Array: ...
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """GLM over one feature shard (model/FixedEffectModel.scala:29-103)."""
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str
+
+    def score(self, data: GameDataset) -> Array:
+        mat = data.feature_shards[self.feature_shard_id]
+        means = np.asarray(self.model.coefficients.means)
+        # margin = x.w, on host via CSR for the full pass (scoring is
+        # bandwidth-bound once; training uses the device batches).
+        return jnp.asarray(mat @ means)
+
+    @property
+    def coefficients(self) -> Coefficients:
+        return self.model.coefficients
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity coefficient block in RAW shard space.
+
+    ``coefficients[e]`` scores rows of entity ``entity_codes[e]``; rows whose
+    entity is unseen (cold start) get 0 from this coordinate — matching the
+    reference's cogroup semantics (RandomEffectModel.scala:137-165: no model ⇒
+    no score contribution).
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    entity_codes: np.ndarray  # [E] codes into the dataset vocab
+    coefficients: Array  # [E, D_raw] (dense; raw space)
+
+    def _lookup(self, codes: np.ndarray) -> np.ndarray:
+        """Map dataset entity codes → local row in the coefficient block
+        (or E, a zero discard row) — vectorized binary search."""
+        e = len(self.entity_codes)
+        if e == 0:
+            return np.full(len(codes), 0, dtype=np.int64)
+        order = np.argsort(self.entity_codes, kind="stable")
+        sorted_codes = self.entity_codes[order]
+        pos = np.clip(np.searchsorted(sorted_codes, codes), 0, e - 1)
+        found = sorted_codes[pos] == codes
+        return np.where(found, order[pos], e)
+
+    def score(self, data: GameDataset) -> Array:
+        codes = data.id_columns[self.random_effect_type]
+        local = self._lookup(codes)  # [N] in [0, E]
+        mat = data.feature_shards[self.feature_shard_id]
+        coefs = np.vstack([np.asarray(self.coefficients),
+                           np.zeros((1, self.coefficients.shape[1]),
+                                    dtype=np.asarray(self.coefficients).dtype)])
+        w_rows = coefs[local]  # [N, D]
+        # rowwise sparse-dense dot: Σ_j x_ij w_ij
+        prod = mat.multiply(w_rows).sum(axis=1)
+        return jnp.asarray(np.asarray(prod).ravel())
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModelInProjectedSpace:
+    """Coefficients in per-entity reduced space + the projector to raw space.
+
+    Reference: model/RandomEffectModelInProjectedSpace.scala — models stay
+    projected for training; conversion to raw space happens for scoring/
+    publishing (toRandomEffectModel analog: :meth:`to_raw`).
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    entity_codes: np.ndarray
+    coefficients_projected: Array  # [E, D_red]
+    projectors: Optional[IndexMapProjectors] = None
+    random_projector: Optional[RandomProjector] = None
+
+    def to_raw(self) -> RandomEffectModel:
+        if self.projectors is not None:
+            dense = self.projectors.scatter_coefficients(
+                np.asarray(self.coefficients_projected)).dense()
+        elif self.random_projector is not None:
+            dense = self.random_projector.project_back(
+                np.asarray(self.coefficients_projected))
+        else:
+            dense = np.asarray(self.coefficients_projected)
+        return RandomEffectModel(
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            entity_codes=self.entity_codes,
+            coefficients=jnp.asarray(dense),
+        )
+
+    def score(self, data: GameDataset) -> Array:
+        return self.to_raw().score(data)
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationModel:
+    """Latent row/col factors; score = rowFactor . colFactor.
+
+    Reference: model/MatrixFactorizationModel.scala:50,141 joins row and col
+    factor RDDs by the datum's two entity ids; here both factor tables are
+    dense blocks indexed by dictionary codes (unseen ids score 0).
+    """
+
+    row_effect_type: str
+    col_effect_type: str
+    row_factors: Array  # [R, K]
+    col_factors: Array  # [C, K]
+
+    @property
+    def num_latent_factors(self) -> int:
+        return int(self.row_factors.shape[1])
+
+    def score(self, data: GameDataset) -> Array:
+        r_codes = np.asarray(data.id_columns[self.row_effect_type])
+        c_codes = np.asarray(data.id_columns[self.col_effect_type])
+        rf = np.vstack([np.asarray(self.row_factors),
+                        np.zeros((1, self.num_latent_factors), np.float32)])
+        cf = np.vstack([np.asarray(self.col_factors),
+                        np.zeros((1, self.num_latent_factors), np.float32)])
+        r = np.where(r_codes < len(self.row_factors), r_codes,
+                     len(self.row_factors))
+        c = np.where(c_codes < len(self.col_factors), c_codes,
+                     len(self.col_factors))
+        return jnp.asarray(np.sum(rf[r] * cf[c], axis=-1))
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectModel:
+    """Per-entity models in latent space + the shared projection matrix.
+
+    Reference: model/FactoredRandomEffectModel.scala — random effect solved in
+    a learned latent space, with the projection matrix itself trained by the
+    factored coordinate (algorithm/FactoredRandomEffectCoordinate.scala).
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    entity_codes: np.ndarray
+    coefficients_latent: Array  # [E, K]
+    projection: Array  # [K, D_raw] latent → raw
+
+    def to_raw(self) -> RandomEffectModel:
+        dense = np.asarray(self.coefficients_latent) @ np.asarray(self.projection)
+        return RandomEffectModel(
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            entity_codes=self.entity_codes,
+            coefficients=jnp.asarray(dense),
+        )
+
+    def score(self, data: GameDataset) -> Array:
+        return self.to_raw().score(data)
+
+
+CoordinateModel = Union[
+    FixedEffectModel,
+    RandomEffectModel,
+    RandomEffectModelInProjectedSpace,
+    FactoredRandomEffectModel,
+    MatrixFactorizationModel,
+]
+
+
+@dataclasses.dataclass
+class GameModel:
+    """coordinateId → model; total score = Σ coordinate scores
+    (model/GAMEModel.scala:29-114)."""
+
+    models: dict[str, CoordinateModel]
+
+    def score(self, data: GameDataset) -> Array:
+        total = jnp.zeros(data.num_samples)
+        for m in self.models.values():
+            total = total + m.score(data)
+        return total
+
+    def get(self, coordinate_id: str) -> Optional[CoordinateModel]:
+        return self.models.get(coordinate_id)
+
+    def updated(self, coordinate_id: str, model: CoordinateModel
+                ) -> "GameModel":
+        out = dict(self.models)
+        out[coordinate_id] = model
+        return GameModel(out)
+
+    @property
+    def coordinate_ids(self) -> list[str]:
+        return list(self.models)

@@ -1,0 +1,439 @@
+"""Self-contained Avro: binary codec + object container file read/write.
+
+The reference stores training data, models, and scores as Avro container
+files (reference: photon-avro-schemas/src/main/avro/*.avsc — 7 schemas;
+read via photon-ml/src/main/scala/com/linkedin/photon/ml/avro/AvroUtils.scala:
+54-310). To interoperate without a JVM or external Avro dependency, this
+module implements the subset of the Avro 1.x specification those schemas
+exercise:
+
+- primitives: null, boolean, int, long, float, double, bytes, string
+- complex: record, enum, array, map, union, fixed
+- container files with ``null`` and ``deflate`` codecs
+
+Encoding follows the spec: zig-zag varint ints/longs, little-endian IEEE
+floats, length-prefixed bytes/strings, block-encoded arrays/maps, union =
+branch index + value. This is host-side IO — no TPU concern — but it is the
+parity surface that lets reference-produced data and models flow in and out.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Iterable, Iterator, Optional
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+DEFAULT_SYNC_INTERVAL = 16_000  # records per block (approximate)
+
+PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes",
+              "string"}
+
+
+# ---------------------------------------------------------------------------
+# Schema handling
+# ---------------------------------------------------------------------------
+
+
+def parse_schema(schema: Any) -> Any:
+    """Normalize a schema (JSON string or python structure) and resolve
+    named-type references into a lookup-friendly form."""
+    if isinstance(schema, str):
+        try:
+            schema = json.loads(schema)
+        except json.JSONDecodeError:
+            # bare primitive name like "string"
+            schema = schema.strip('"')
+    return schema
+
+
+def _names_index(schema: Any, index: Optional[dict] = None) -> dict:
+    """Collect named types (records/enums/fixed) for reference resolution."""
+    if index is None:
+        index = {}
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed"):
+            name = schema["name"]
+            ns = schema.get("namespace")
+            full = f"{ns}.{name}" if ns and "." not in name else name
+            index[full] = schema
+            index[name] = schema
+        if t == "record":
+            for f in schema.get("fields", []):
+                _names_index(f["type"], index)
+        elif t == "array":
+            _names_index(schema["items"], index)
+        elif t == "map":
+            _names_index(schema["values"], index)
+    elif isinstance(schema, list):
+        for s in schema:
+            _names_index(s, index)
+    return index
+
+
+# ---------------------------------------------------------------------------
+# Binary encoder / decoder
+# ---------------------------------------------------------------------------
+
+
+class BinaryEncoder:
+    def __init__(self, out: io.BytesIO):
+        self.out = out
+
+    def write_long(self, n: int) -> None:
+        n = (n << 1) ^ (n >> 63)  # zig-zag
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                self.out.write(bytes((b | 0x80,)))
+            else:
+                self.out.write(bytes((b,)))
+                break
+
+    def write_int(self, n: int) -> None:
+        self.write_long(n)
+
+    def write_boolean(self, b: bool) -> None:
+        self.out.write(b"\x01" if b else b"\x00")
+
+    def write_float(self, x: float) -> None:
+        self.out.write(struct.pack("<f", x))
+
+    def write_double(self, x: float) -> None:
+        self.out.write(struct.pack("<d", x))
+
+    def write_bytes(self, b: bytes) -> None:
+        self.write_long(len(b))
+        self.out.write(b)
+
+    def write_string(self, s: str) -> None:
+        self.write_bytes(s.encode("utf-8"))
+
+
+class BinaryDecoder:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def read_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # un-zig-zag
+
+    def read_boolean(self) -> bool:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b != 0
+
+    def read_float(self) -> float:
+        v = struct.unpack_from("<f", self.buf, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def read_double(self) -> float:
+        v = struct.unpack_from("<d", self.buf, self.pos)[0]
+        self.pos += 8
+        return v
+
+    def read_bytes(self) -> bytes:
+        n = self.read_long()
+        v = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return v
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+    @property
+    def eof(self) -> bool:
+        return self.pos >= len(self.buf)
+
+
+# ---------------------------------------------------------------------------
+# Datum read/write against a schema
+# ---------------------------------------------------------------------------
+
+
+def _schema_type(schema: Any) -> str:
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    return schema["type"]
+
+
+def _union_branch(schema: list, datum: Any, names: dict) -> int:
+    """Pick the union branch for a datum (null-vs-value covers the reference
+    schemas; beyond that, match by python type / record fields)."""
+    for i, s in enumerate(schema):
+        t = _schema_type(parse_schema(s) if isinstance(s, str) else s)
+        if datum is None and t == "null":
+            return i
+        if datum is not None and t != "null":
+            if t == "string" and isinstance(datum, str):
+                return i
+            if t in ("int", "long") and isinstance(datum, int) \
+                    and not isinstance(datum, bool):
+                return i
+            if t in ("float", "double") and isinstance(datum, (int, float)) \
+                    and not isinstance(datum, bool):
+                return i
+            if t == "boolean" and isinstance(datum, bool):
+                return i
+            if t == "bytes" and isinstance(datum, bytes):
+                return i
+            if t in ("record", "map") and isinstance(datum, dict):
+                return i
+            if t == "array" and isinstance(datum, (list, tuple)):
+                return i
+            if t == "enum" and isinstance(datum, str):
+                return i
+    # fallback: first non-null branch for non-null datum
+    for i, s in enumerate(schema):
+        if _schema_type(s if not isinstance(s, str) else s) != "null":
+            if datum is not None:
+                return i
+    return 0
+
+
+def write_datum(enc: BinaryEncoder, schema: Any, datum: Any,
+                names: dict) -> None:
+    if isinstance(schema, str) and schema not in PRIMITIVES:
+        schema = names[schema]  # named-type reference
+    t = _schema_type(schema)
+    if t == "null":
+        return
+    if t == "boolean":
+        enc.write_boolean(bool(datum))
+    elif t == "int" or t == "long":
+        enc.write_long(int(datum))
+    elif t == "float":
+        enc.write_float(float(datum))
+    elif t == "double":
+        enc.write_double(float(datum))
+    elif t == "bytes":
+        enc.write_bytes(bytes(datum))
+    elif t == "string":
+        enc.write_string(str(datum))
+    elif t == "union":
+        branches = schema if isinstance(schema, list) else schema["type"]
+        i = _union_branch(branches, datum, names)
+        enc.write_long(i)
+        write_datum(enc, branches[i], datum, names)
+    elif t == "record":
+        for f in schema["fields"]:
+            name = f["name"]
+            if name in datum:
+                value = datum[name]
+            elif "default" in f:
+                value = f["default"]
+            else:
+                raise ValueError(f"missing field {name!r} with no default")
+            write_datum(enc, f["type"], value, names)
+    elif t == "array":
+        items = list(datum)
+        if items:
+            enc.write_long(len(items))
+            for item in items:
+                write_datum(enc, schema["items"], item, names)
+        enc.write_long(0)
+    elif t == "map":
+        if datum:
+            enc.write_long(len(datum))
+            for k, v in datum.items():
+                enc.write_string(str(k))
+                write_datum(enc, schema["values"], v, names)
+        enc.write_long(0)
+    elif t == "enum":
+        enc.write_long(schema["symbols"].index(datum))
+    elif t == "fixed":
+        enc.out.write(bytes(datum))
+    else:
+        raise ValueError(f"unsupported schema type {t!r}")
+
+
+def read_datum(dec: BinaryDecoder, schema: Any, names: dict) -> Any:
+    if isinstance(schema, str) and schema not in PRIMITIVES:
+        schema = names[schema]
+    t = _schema_type(schema)
+    if t == "null":
+        return None
+    if t == "boolean":
+        return dec.read_boolean()
+    if t == "int" or t == "long":
+        return dec.read_long()
+    if t == "float":
+        return dec.read_float()
+    if t == "double":
+        return dec.read_double()
+    if t == "bytes":
+        return dec.read_bytes()
+    if t == "string":
+        return dec.read_string()
+    if t == "union":
+        branches = schema if isinstance(schema, list) else schema["type"]
+        i = dec.read_long()
+        return read_datum(dec, branches[i], names)
+    if t == "record":
+        return {f["name"]: read_datum(dec, f["type"], names)
+                for f in schema["fields"]}
+    if t == "array":
+        out = []
+        while True:
+            count = dec.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                dec.read_long()  # block byte size, unused
+                count = -count
+            for _ in range(count):
+                out.append(read_datum(dec, schema["items"], names))
+        return out
+    if t == "map":
+        out = {}
+        while True:
+            count = dec.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                dec.read_long()
+                count = -count
+            for _ in range(count):
+                k = dec.read_string()
+                out[k] = read_datum(dec, schema["values"], names)
+        return out
+    if t == "enum":
+        return schema["symbols"][dec.read_long()]
+    if t == "fixed":
+        n = schema["size"]
+        v = dec.buf[dec.pos:dec.pos + n]
+        dec.pos += n
+        return v
+    raise ValueError(f"unsupported schema type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Object container files
+# ---------------------------------------------------------------------------
+
+
+def write_container(path: str, schema: Any, records: Iterable[dict],
+                    codec: str = "deflate",
+                    sync_interval: int = DEFAULT_SYNC_INTERVAL) -> None:
+    """Write an Avro object container file (spec: header + data blocks)."""
+    schema = parse_schema(schema)
+    names = _names_index(schema)
+    sync = os.urandom(SYNC_SIZE)
+
+    with open(path, "wb") as fh:
+        fh.write(MAGIC)
+        header = io.BytesIO()
+        enc = BinaryEncoder(header)
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        enc.write_long(len(meta))
+        for k, v in meta.items():
+            enc.write_string(k)
+            enc.write_bytes(v)
+        enc.write_long(0)
+        fh.write(header.getvalue())
+        fh.write(sync)
+
+        block = io.BytesIO()
+        benc = BinaryEncoder(block)
+        count = 0
+
+        def flush():
+            nonlocal block, benc, count
+            if count == 0:
+                return
+            raw = block.getvalue()
+            if codec == "deflate":
+                raw = zlib.compress(raw)[2:-1]  # raw deflate, no zlib header
+            head = io.BytesIO()
+            henc = BinaryEncoder(head)
+            henc.write_long(count)
+            henc.write_long(len(raw))
+            fh.write(head.getvalue())
+            fh.write(raw)
+            fh.write(sync)
+            block = io.BytesIO()
+            benc = BinaryEncoder(block)
+            count = 0
+
+        for rec in records:
+            write_datum(benc, schema, rec, names)
+            count += 1
+            if count >= sync_interval:
+                flush()
+        flush()
+
+
+def read_container(path: str) -> tuple[Any, list[Any]]:
+    """Read an Avro object container file → (schema, records)."""
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    if buf[:4] != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    dec = BinaryDecoder(buf, 4)
+    meta = {}
+    while True:
+        count = dec.read_long()
+        if count == 0:
+            break
+        if count < 0:
+            dec.read_long()
+            count = -count
+        for _ in range(count):
+            k = dec.read_string()
+            v = dec.read_bytes()
+            meta[k] = v
+    schema = parse_schema(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    names = _names_index(schema)
+    sync = buf[dec.pos:dec.pos + SYNC_SIZE]
+    dec.pos += SYNC_SIZE
+
+    records: list[Any] = []
+    while dec.pos < len(buf):
+        count = dec.read_long()
+        size = dec.read_long()
+        data = buf[dec.pos:dec.pos + size]
+        dec.pos += size
+        if codec == "deflate":
+            data = zlib.decompress(data, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported codec {codec!r}")
+        bdec = BinaryDecoder(data)
+        for _ in range(count):
+            records.append(read_datum(bdec, schema, names))
+        assert buf[dec.pos:dec.pos + SYNC_SIZE] == sync, \
+            f"{path}: sync marker mismatch (corrupt block)"
+        dec.pos += SYNC_SIZE
+    return schema, records
+
+
+def read_directory(path: str) -> tuple[Any, list[Any]]:
+    """Read all ``*.avro`` files under a directory (the reference's
+    partitioned-output layout: part-*.avro shards)."""
+    schema = None
+    records: list[Any] = []
+    for name in sorted(os.listdir(path)):
+        if name.endswith(".avro"):
+            s, recs = read_container(os.path.join(path, name))
+            schema = schema or s
+            records.extend(recs)
+    return schema, records

@@ -1,0 +1,119 @@
+"""Feature index maps: (name, term) feature keys ↔ dense column indices.
+
+Re-design of the reference's index-map stack
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/util/ —
+IndexMap.scala:23-47 trait, DefaultIndexMap + DefaultIndexMapLoader.scala:
+25-43 in-heap broadcast map, PalDBIndexMap.scala:43-160 off-heap partitioned
+store for huge feature spaces; feature key = name + "\\u0001" + term,
+Utils.scala:56; intercept key "(INTERCEPT)\\u0001" from io/GLMSuite.scala:
+382-384).
+
+On TPU the index map is purely host-side prep (SURVEY §2.1): we keep one
+dict-based map with an optional *partitioned on-disk* representation (JSON
+shards, the PalDB analog — same hash-partitioned layout, no JVM store) for
+feature spaces too large to rebuild per run (FeatureIndexingJob analog in
+io/feature_index_job.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Iterator, Optional
+
+DELIMITER = ""
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""
+INTERCEPT_KEY = INTERCEPT_NAME + DELIMITER + INTERCEPT_TERM
+
+
+def feature_key(name: str, term: str = "") -> str:
+    """util/Utils.scala:56 getFeatureKey."""
+    return f"{name}{DELIMITER}{term}"
+
+
+def split_feature_key(key: str) -> tuple[str, str]:
+    """util/Utils.scala:66,80 getFeatureName/TermFromKey."""
+    name, _, term = key.partition(DELIMITER)
+    return name, term
+
+
+class IndexMap:
+    """Bidirectional (featureKey ↔ index) map (util/IndexMap.scala:23-47)."""
+
+    def __init__(self, key_to_index: dict[str, int]):
+        self._fwd = dict(key_to_index)
+        self._rev: Optional[dict[int, str]] = None
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._fwd
+
+    def index_of(self, key: str) -> int:
+        """-1 when absent (IndexMap.getIndex convention)."""
+        return self._fwd.get(key, -1)
+
+    def key_of(self, index: int) -> Optional[str]:
+        if self._rev is None:
+            self._rev = {v: k for k, v in self._fwd.items()}
+        return self._rev.get(index)
+
+    def items(self) -> Iterator[tuple[str, int]]:
+        return iter(self._fwd.items())
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        i = self.index_of(INTERCEPT_KEY)
+        return None if i < 0 else i
+
+    # -- builders (DefaultIndexMapLoader analog) ---------------------------
+
+    @staticmethod
+    def from_keys(keys: Iterable[str], add_intercept: bool = False
+                  ) -> "IndexMap":
+        uniq = sorted(set(keys))
+        if add_intercept and INTERCEPT_KEY not in uniq:
+            uniq.append(INTERCEPT_KEY)
+        return IndexMap({k: i for i, k in enumerate(uniq)})
+
+    @staticmethod
+    def from_name_terms(pairs: Iterable[tuple[str, str]],
+                        add_intercept: bool = False) -> "IndexMap":
+        return IndexMap.from_keys(
+            (feature_key(n, t) for n, t in pairs), add_intercept)
+
+    @staticmethod
+    def identity(dim: int) -> "IndexMap":
+        """IdentityIndexMapLoader analog: key i ↔ index i (LibSVM inputs)."""
+        return IndexMap({str(i): i for i in range(dim)})
+
+    # -- partitioned on-disk store (PalDB analog) --------------------------
+
+    def save(self, directory: str, num_partitions: int = 1,
+             namespace: str = "global") -> None:
+        """Hash-partitioned JSON shards (util/PalDBIndexMap layout analog:
+        one store per partition, global index = local * partitions + id)."""
+        os.makedirs(directory, exist_ok=True)
+        parts: list[dict[str, int]] = [dict() for _ in range(num_partitions)]
+        for k, v in self._fwd.items():
+            parts[hash(k) % num_partitions][k] = v
+        for p, d in enumerate(parts):
+            with open(os.path.join(
+                    directory, f"{namespace}-index-map-{p}.json"), "w") as fh:
+                json.dump(d, fh)
+        with open(os.path.join(directory, f"{namespace}-meta.json"), "w") as fh:
+            json.dump({"numPartitions": num_partitions,
+                       "size": len(self._fwd)}, fh)
+
+    @staticmethod
+    def load(directory: str, namespace: str = "global") -> "IndexMap":
+        with open(os.path.join(directory, f"{namespace}-meta.json")) as fh:
+            meta = json.load(fh)
+        fwd: dict[str, int] = {}
+        for p in range(meta["numPartitions"]):
+            with open(os.path.join(
+                    directory, f"{namespace}-index-map-{p}.json")) as fh:
+                fwd.update(json.load(fh))
+        return IndexMap(fwd)

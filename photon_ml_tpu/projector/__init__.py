@@ -1,0 +1,10 @@
+"""Per-entity dimension reduction (reference projector/ package)."""
+
+from photon_ml_tpu.projector.projectors import (  # noqa: F401
+    IndexMapProjectors,
+    ProjectorConfig,
+    ProjectorType,
+    RandomProjector,
+    build_index_map_projectors,
+    build_random_projector,
+)

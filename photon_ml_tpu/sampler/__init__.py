@@ -1,0 +1,7 @@
+"""Down-samplers (reference sampler/ package)."""
+
+from photon_ml_tpu.sampler.samplers import (  # noqa: F401
+    binary_classification_down_sample,
+    default_down_sample,
+    down_sample,
+)

@@ -1,0 +1,67 @@
+"""Down-samplers for the fixed-effect coordinate, shapes kept static.
+
+TPU-native re-design of the reference's samplers
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/sampler/ —
+DefaultDownSampler.scala:37 uniform sampling; BinaryClassificationDownSampler
+.scala:36-61 keeps all positives, samples negatives at rate r and reweights
+them by 1/r; applied per coordinate-descent update by
+optimization/DistributedOptimizationProblem.scala:112-124).
+
+Where the reference materializes a smaller RDD, we keep the batch shape
+static (XLA recompiles on shape change) and instead *mask via weights*:
+dropped rows get weight 0, kept rows have their weight scaled by 1/r — the
+estimator is identical in expectation and every kernel reuses its compiled
+form (SURVEY §2.2 "Down-sampling for the global coordinate").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch
+
+Array = jnp.ndarray
+
+
+@partial(jax.jit, static_argnames=("rate",))
+def _uniform_mask(key: Array, weights: Array, rate: float) -> Array:
+    keep = jax.random.uniform(key, weights.shape) < rate
+    return jnp.where(keep, weights / rate, 0.0)
+
+
+@partial(jax.jit, static_argnames=("rate",))
+def _negative_mask(key: Array, weights: Array, labels: Array,
+                   rate: float) -> Array:
+    keep = jax.random.uniform(key, weights.shape) < rate
+    is_pos = labels > 0.5
+    return jnp.where(is_pos, weights, jnp.where(keep, weights / rate, 0.0))
+
+
+def default_down_sample(batch: Batch, rate: float, key: Array) -> Batch:
+    """Uniform down-sampling with 1/rate reweighting (DefaultDownSampler)."""
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0,1), got {rate}")
+    return batch._replace(weights=_uniform_mask(key, batch.weights, rate))
+
+
+def binary_classification_down_sample(batch: Batch, rate: float,
+                                      key: Array) -> Batch:
+    """Keep positives, sample negatives at ``rate`` with 1/rate reweighting
+    (BinaryClassificationDownSampler.scala:36-61)."""
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0,1), got {rate}")
+    return batch._replace(
+        weights=_negative_mask(key, batch.weights, batch.labels, rate))
+
+
+def down_sample(batch: Batch, rate: float, key: Array,
+                is_classification: bool) -> Batch:
+    """Sampler dispatch (DownSampler factory analog): rate >= 1 is a no-op."""
+    if rate >= 1.0:
+        return batch
+    if is_classification:
+        return binary_classification_down_sample(batch, rate, key)
+    return default_down_sample(batch, rate, key)
