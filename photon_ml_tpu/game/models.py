@@ -48,6 +48,32 @@ class DatumScoringModel(Protocol):
     def score(self, data: GameDataset) -> Array: ...
 
 
+def _match(keys: np.ndarray, queries: np.ndarray) -> np.ndarray:
+    """Row in ``keys`` for each query (len(keys) where absent)."""
+    e = len(keys)
+    if e == 0 or len(queries) == 0:
+        return np.full(len(queries), e, dtype=np.int64)
+    order = np.argsort(keys, kind="stable")
+    sorted_keys = keys[order]
+    pos = np.clip(np.searchsorted(sorted_keys, queries), 0, e - 1)
+    found = sorted_keys[pos] == queries
+    return np.where(found, order[pos], e)
+
+
+def _codes_via_ids(ids: np.ndarray, vocab: np.ndarray,
+                   codes: np.ndarray) -> np.ndarray:
+    """Match dataset rows (dictionary ``codes`` into ``vocab``) against a
+    model's raw ``ids``: returns the model row per sample, len(ids) where the
+    entity has no model. Both sides are compared as python strings — casting
+    to the vocab's fixed-width unicode dtype would silently truncate longer
+    model ids into false matches."""
+    ids_s = np.asarray([str(x) for x in np.asarray(ids).ravel()],
+                       dtype=object)
+    vocab_s = np.asarray([str(x) for x in np.asarray(vocab).ravel()],
+                         dtype=object)
+    return _match(ids_s, vocab_s[np.asarray(codes)])
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -84,22 +110,24 @@ class RandomEffectModel:
     feature_shard_id: str
     entity_codes: np.ndarray  # [E] codes into the dataset vocab
     coefficients: Array  # [E, D_raw] (dense; raw space)
+    # Raw entity id per block row (strings/ints). Set on models loaded from
+    # disk so they can score datasets whose dictionary encoding differs from
+    # the one they were trained against (the reference keys models by raw
+    # entityId, model/RandomEffectModel.scala:33).
+    entity_ids: Optional[np.ndarray] = None
 
-    def _lookup(self, codes: np.ndarray) -> np.ndarray:
+    def _lookup(self, codes: np.ndarray, data: "GameDataset") -> np.ndarray:
         """Map dataset entity codes → local row in the coefficient block
         (or E, a zero discard row) — vectorized binary search."""
-        e = len(self.entity_codes)
-        if e == 0:
-            return np.full(len(codes), 0, dtype=np.int64)
-        order = np.argsort(self.entity_codes, kind="stable")
-        sorted_codes = self.entity_codes[order]
-        pos = np.clip(np.searchsorted(sorted_codes, codes), 0, e - 1)
-        found = sorted_codes[pos] == codes
-        return np.where(found, order[pos], e)
+        if self.entity_ids is not None:
+            # Standalone model: match by raw id through the dataset vocab.
+            vocab = data.id_vocabs[self.random_effect_type]
+            return _codes_via_ids(self.entity_ids, vocab, codes)
+        return _match(self.entity_codes, codes)
 
     def score(self, data: GameDataset) -> Array:
         codes = data.id_columns[self.random_effect_type]
-        local = self._lookup(codes)  # [N] in [0, E]
+        local = self._lookup(codes, data)  # [N] in [0, E]
         mat = data.feature_shards[self.feature_shard_id]
         coefs = np.vstack([np.asarray(self.coefficients),
                            np.zeros((1, self.coefficients.shape[1]),
@@ -159,6 +187,10 @@ class MatrixFactorizationModel:
     col_effect_type: str
     row_factors: Array  # [R, K]
     col_factors: Array  # [C, K]
+    # Raw ids per factor row (set on models loaded from disk; None means the
+    # factors are aligned to the scoring dataset's dictionary codes).
+    row_ids: Optional[np.ndarray] = None
+    col_ids: Optional[np.ndarray] = None
 
     @property
     def num_latent_factors(self) -> int:
@@ -167,6 +199,14 @@ class MatrixFactorizationModel:
     def score(self, data: GameDataset) -> Array:
         r_codes = np.asarray(data.id_columns[self.row_effect_type])
         c_codes = np.asarray(data.id_columns[self.col_effect_type])
+        if self.row_ids is not None:
+            r_codes = _codes_via_ids(self.row_ids,
+                                     data.id_vocabs[self.row_effect_type],
+                                     r_codes)
+        if self.col_ids is not None:
+            c_codes = _codes_via_ids(self.col_ids,
+                                     data.id_vocabs[self.col_effect_type],
+                                     c_codes)
         rf = np.vstack([np.asarray(self.row_factors),
                         np.zeros((1, self.num_latent_factors), np.float32)])
         cf = np.vstack([np.asarray(self.col_factors),

@@ -43,10 +43,12 @@ def parse_schema(schema: Any) -> Any:
     """Normalize a schema (JSON string or python structure) and resolve
     named-type references into a lookup-friendly form."""
     if isinstance(schema, str):
+        if schema in PRIMITIVES:  # "null" would json-parse to None
+            return schema
         try:
             schema = json.loads(schema)
         except json.JSONDecodeError:
-            # bare primitive name like "string"
+            # bare named-type reference like "NameTermValueAvro"
             schema = schema.strip('"')
     return schema
 
@@ -179,7 +181,9 @@ def _union_branch(schema: list, datum: Any, names: dict) -> int:
     """Pick the union branch for a datum (null-vs-value covers the reference
     schemas; beyond that, match by python type / record fields)."""
     for i, s in enumerate(schema):
-        t = _schema_type(parse_schema(s) if isinstance(s, str) else s)
+        if isinstance(s, str) and s not in PRIMITIVES:
+            s = names.get(s, s)  # resolve named-type reference
+        t = _schema_type(s)
         if datum is None and t == "null":
             return i
         if datum is not None and t != "null":

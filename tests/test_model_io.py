@@ -1,0 +1,233 @@
+"""Round-trip tests for GAME/GLM model serialization (io/model_io.py).
+
+Mirrors the reference's ModelProcessingUtilsTest contract: save → load must
+reproduce scores and coefficients (integTest/.../avro/ModelProcessingUtilsTest
+in the reference repo).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.io.model_io import (
+    glm_to_record,
+    load_game_model,
+    load_matrix_factorization_model,
+    load_scored_items,
+    read_models_text,
+    record_to_glm,
+    save_game_model,
+    save_matrix_factorization_model,
+    save_scored_items,
+    write_models_text,
+)
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.optimize.config import TaskType
+
+
+def _index_map(dim, prefix="f"):
+    return IndexMap.from_keys([feature_key(f"{prefix}{i}") for i in range(dim)])
+
+
+def _game_dataset(rng, n=40, d_global=6, d_user=4, n_users=5):
+    Xg = sp.csr_matrix(rng.normal(size=(n, d_global)))
+    Xu = sp.csr_matrix(rng.normal(size=(n, d_user)))
+    ds = GameDataset(
+        responses=rng.uniform(size=n),
+        feature_shards={"global": Xg, "user": Xu},
+    )
+    ds.encode_ids("userId", rng.integers(0, n_users, size=n).astype(str))
+    return ds
+
+
+def test_glm_record_round_trip():
+    imap = _index_map(5)
+    means = jnp.asarray([0.0, 1.5, -2.0, 0.0, 3.25])
+    glm = GeneralizedLinearModel(Coefficients(means),
+                                 TaskType.LOGISTIC_REGRESSION)
+    rec = glm_to_record("fixed-effect", glm, imap)
+    # sparse: only the 3 nonzeros serialized
+    assert len(rec["means"]) == 3
+    assert rec["modelClass"].endswith("LogisticRegressionModel")
+    glm2, _ = record_to_glm(rec, imap)
+    np.testing.assert_allclose(np.asarray(glm2.coefficients.means),
+                               np.asarray(means), rtol=1e-6)
+    assert glm2.task == TaskType.LOGISTIC_REGRESSION
+
+
+def test_glm_record_compact_index_when_no_map():
+    imap = _index_map(6)
+    means = jnp.asarray([0.0, 1.0, 0.0, 2.0, 0.0, -1.0])
+    glm = GeneralizedLinearModel(Coefficients(means), TaskType.LINEAR_REGRESSION)
+    rec = glm_to_record("m", glm, imap)
+    glm2, compact = record_to_glm(rec)  # no index map → compact rebuild
+    assert len(compact) == 3
+    assert sorted(np.asarray(glm2.coefficients.means).tolist()) == [-1.0, 1.0, 2.0]
+
+
+def test_game_model_round_trip_scores(tmp_path):
+    rng = np.random.default_rng(0)
+    ds = _game_dataset(rng)
+    imaps = {"global": _index_map(6, "g"), "user": _index_map(4, "u")}
+
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(rng.normal(size=6), jnp.float32)),
+            TaskType.LOGISTIC_REGRESSION),
+        "global")
+    user_vocab = ds.id_vocabs["userId"]
+    re = RandomEffectModel(
+        random_effect_type="userId",
+        feature_shard_id="user",
+        entity_codes=np.arange(len(user_vocab)),
+        coefficients=jnp.asarray(
+            rng.normal(size=(len(user_vocab), 4)), jnp.float32))
+    gm = GameModel({"fixed": fixed, "per-user": re})
+    want = np.asarray(gm.score(ds))
+
+    out = str(tmp_path / "gameModel")
+    save_game_model(gm, out, imaps,
+                    entity_vocabs={"userId": user_vocab},
+                    task=TaskType.LOGISTIC_REGRESSION)
+    gm2, imaps2 = load_game_model(out, imaps)
+    got = np.asarray(gm2.score(ds))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert set(gm2.models) == {"fixed", "per-user"}
+    loaded_fixed = gm2.models["fixed"]
+    assert loaded_fixed.model.task == TaskType.LOGISTIC_REGRESSION
+
+
+def test_game_model_load_without_index_maps(tmp_path):
+    rng = np.random.default_rng(1)
+    ds = _game_dataset(rng)
+    imaps = {"global": _index_map(6, "g"), "user": _index_map(4, "u")}
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(rng.normal(size=6), jnp.float32)),
+            TaskType.LINEAR_REGRESSION),
+        "global")
+    gm = GameModel({"fixed": fixed})
+    want = np.asarray(gm.score(ds))
+
+    out = str(tmp_path / "gameModel")
+    save_game_model(gm, out, imaps)
+    gm2, imaps2 = load_game_model(out)  # compact rebuilt index
+    assert "global" in imaps2
+    # scoring against a dataset in the ORIGINAL index space requires the
+    # original maps; with compact maps only coefficient multiset must match
+    orig = np.sort(np.asarray(fixed.model.coefficients.means))
+    loaded = np.sort(np.asarray(gm2.models["fixed"].model.coefficients.means))
+    np.testing.assert_allclose(loaded, orig[np.abs(orig) > 0], rtol=1e-6)
+
+
+def test_random_effect_partitioned_output(tmp_path):
+    rng = np.random.default_rng(2)
+    ds = _game_dataset(rng, n_users=7)
+    imaps = {"user": _index_map(4, "u")}
+    vocab = ds.id_vocabs["userId"]
+    re = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(len(vocab)),
+        coefficients=jnp.asarray(rng.normal(size=(len(vocab), 4)), jnp.float32))
+    gm = GameModel({"per-user": re})
+    want = np.asarray(gm.score(ds))
+    out = str(tmp_path / "m")
+    save_game_model(gm, out, imaps, entity_vocabs={"userId": vocab},
+                    num_output_files=3)
+    import os
+    parts = os.listdir(os.path.join(out, "random-effect", "per-user",
+                                    "coefficients"))
+    assert len([p for p in parts if p.endswith(".avro")]) == 3
+    gm2, _ = load_game_model(out, imaps)
+    np.testing.assert_allclose(np.asarray(gm2.score(ds)), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_matrix_factorization_round_trip(tmp_path):
+    rng = np.random.default_rng(3)
+    ds = _game_dataset(rng)
+    ds.encode_ids("itemId", rng.integers(0, 4, size=ds.num_samples).astype(str))
+    users, items = ds.id_vocabs["userId"], ds.id_vocabs["itemId"]
+    mf = MatrixFactorizationModel(
+        row_effect_type="userId", col_effect_type="itemId",
+        row_factors=jnp.asarray(rng.normal(size=(len(users), 3)), jnp.float32),
+        col_factors=jnp.asarray(rng.normal(size=(len(items), 3)), jnp.float32))
+    want = np.asarray(mf.score(ds))
+    out = str(tmp_path / "mf")
+    save_matrix_factorization_model(
+        mf, out, entity_vocabs={"userId": users, "itemId": items})
+    mf2 = load_matrix_factorization_model(out, "userId", "itemId")
+    np.testing.assert_allclose(np.asarray(mf2.score(ds)), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scored_items_round_trip(tmp_path):
+    scores = np.asarray([0.25, -1.5, 3.0])
+    path = str(tmp_path / "scores" / "part-00000.avro")
+    save_scored_items(path, scores, "my-model", uids=["a", "b", "c"],
+                      labels=np.asarray([1.0, 0.0, 1.0]))
+    recs = load_scored_items(path)
+    assert [r["predictionScore"] for r in recs] == [0.25, -1.5, 3.0]
+    assert [r["uid"] for r in recs] == ["a", "b", "c"]
+    assert recs[0]["modelId"] == "my-model"
+
+
+def test_text_models_round_trip(tmp_path):
+    imap = _index_map(4)
+    glm = GeneralizedLinearModel(
+        Coefficients(jnp.asarray([0.5, -0.25, 0.0, 2.0])),
+        TaskType.LINEAR_REGRESSION)
+    out = str(tmp_path / "text")
+    write_models_text(out, [(10.0, glm)], imap)
+    loaded = read_models_text(out, imap)
+    assert len(loaded) == 1
+    lam, glm2 = loaded[0]
+    assert lam == 10.0
+    np.testing.assert_allclose(np.asarray(glm2.coefficients.means),
+                               np.asarray(glm.coefficients.means), rtol=1e-6)
+
+
+def test_entity_id_no_unicode_truncation(tmp_path):
+    """A model id longer than the dataset vocab's fixed unicode width must
+    NOT silently truncate into a false match (code-review regression)."""
+    rng = np.random.default_rng(4)
+    n = 10
+    Xu = sp.csr_matrix(np.ones((n, 2)))
+    ds = GameDataset(responses=np.zeros(n), feature_shards={"user": Xu})
+    ds.encode_ids("userId", np.asarray(["alice", "bob"] * 5))  # vocab <U5
+    re = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(1),
+        coefficients=jnp.asarray([[100.0, 100.0]], jnp.float32),
+        entity_ids=np.asarray(["alice2"], dtype=object))  # longer than <U5
+    scores = np.asarray(re.score(ds))
+    np.testing.assert_array_equal(scores, np.zeros(n))
+
+
+def test_fixed_effect_variances_round_trip(tmp_path):
+    rng = np.random.default_rng(5)
+    ds = _game_dataset(rng)
+    imaps = {"global": _index_map(6, "g")}
+    coefs = Coefficients(
+        means=jnp.asarray(rng.normal(size=6), jnp.float32),
+        variances=jnp.asarray(np.abs(rng.normal(size=6)) + 0.1, jnp.float32))
+    gm = GameModel({"fixed": FixedEffectModel(
+        GeneralizedLinearModel(coefs, TaskType.POISSON_REGRESSION), "global")})
+    out = str(tmp_path / "m")
+    save_game_model(gm, out, imaps)
+    gm2, _ = load_game_model(out, imaps)
+    loaded = gm2.models["fixed"].model.coefficients
+    assert loaded.variances is not None
+    np.testing.assert_allclose(np.asarray(loaded.variances),
+                               np.asarray(coefs.variances), rtol=1e-6)
+    assert gm2.models["fixed"].model.task == TaskType.POISSON_REGRESSION
