@@ -74,6 +74,7 @@ class GameDataset:
     weights: Optional[np.ndarray] = None  # [N]
     id_columns: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
     id_vocabs: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    uids: Optional[np.ndarray] = None  # [N] raw uid strings when present
 
     def __post_init__(self):
         n = len(self.responses)

@@ -430,6 +430,16 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
     return schema, records
 
 
+def read_records(path: str) -> list[Any]:
+    """Records from a container file or a directory of part files —
+    whichever ``path`` is."""
+    if os.path.isdir(path):
+        _, records = read_directory(path)
+    else:
+        _, records = read_container(path)
+    return records
+
+
 def read_directory(path: str) -> tuple[Any, list[Any]]:
     """Read all ``*.avro`` files under a directory (the reference's
     partitioned-output layout: part-*.avro shards)."""

@@ -1,0 +1,452 @@
+"""Input data formats: Avro / LibSVM → columnar datasets, GAME ingestion.
+
+Re-design of the reference's ingestion stack (reference paths under
+photon-ml/src/main/scala/com/linkedin/photon/ml/):
+
+- ``InputDataFormat`` family (io/InputDataFormat.scala:26-50,
+  io/InputFormatFactory.scala:24-40): pluggable AVRO vs LIBSVM loaders for
+  the legacy single-GLM path. Output here is columnar (CSR features +
+  label/offset/weight arrays) instead of an RDD of LabeledPoint — the TPU
+  batch layouts in data/batch.py consume these directly.
+- ``GLMSuite`` (io/GLMSuite.scala:98-260): avro → LabeledPoint with default
+  index-map build, selected-features filter, intercept injection, and the
+  JSON box-constraint map (wildcard semantics, :207-260).
+- ``FieldNames`` (avro/FieldNames.scala:23-29): TRAINING_EXAMPLE uses
+  "label" (avro/TrainingExampleFieldNames.scala:26),
+  RESPONSE_PREDICTION uses "response" (avro/ResponsePredictionFieldNames
+  .scala:26) — selected by the legacy ``--format`` flag.
+- GAME ingestion (avro/data/DataProcessingUtils.scala:57-215): per record,
+  one sparse vector per feature *shard* (a union of feature *sections* =
+  record fields), response/offset/weight, id columns read from top-level
+  fields or metadataMap, intercept appended when the shard's index map
+  carries the intercept key.
+- ``NameAndTermFeatureSetContainer`` (avro/data/NameAndTermFeatureSet
+  Container.scala:38-127): per-section (name, term) sets → index maps;
+  text-file save/load (``name\\tterm`` lines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.io.avro import read_records as _read_records
+from photon_ml_tpu.io.index_map import (
+    DELIMITER,
+    INTERCEPT_KEY,
+    IndexMap,
+    feature_key,
+)
+
+WILDCARD = "*"  # io/GLMSuite.scala:377
+
+# Avro field names (avro/AvroFieldNames.scala:21-28).
+NAME, TERM, VALUE = "name", "term", "value"
+RESPONSE, OFFSET, WEIGHT, UID = "response", "offset", "weight", "uid"
+META_DATA_MAP = "metadataMap"
+
+
+class InputFormatType(enum.Enum):
+    """io/InputFormatType.scala analog."""
+
+    AVRO = "AVRO"
+    LIBSVM = "LIBSVM"
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldNames:
+    """avro/FieldNames.scala:23-29 analog."""
+
+    features: str = "features"
+    response: str = "label"
+    offset: str = "offset"
+    weight: str = "weight"
+
+
+TRAINING_EXAMPLE_FIELD_NAMES = FieldNames(response="label")
+RESPONSE_PREDICTION_FIELD_NAMES = FieldNames(response="response")
+
+
+@dataclasses.dataclass
+class LabeledData:
+    """Columnar legacy dataset (the RDD[LabeledPoint] analog)."""
+
+    features: sp.csr_matrix  # [N, D]
+    labels: np.ndarray  # [N]
+    offsets: np.ndarray  # [N]
+    weights: np.ndarray  # [N]
+    index_map: IndexMap
+
+    @property
+    def num_samples(self) -> int:
+        return self.features.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.features.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# Legacy Avro → LabeledData (GLMSuite analog)
+# ---------------------------------------------------------------------------
+
+
+def load_selected_features(path: str) -> set[str]:
+    """Selected-features avro file → set of feature keys
+    (io/GLMSuite.scala:141-149)."""
+    return {feature_key(r[NAME], r.get(TERM) or "")
+            for r in _read_records(path)}
+
+
+def build_index_map_from_records(
+        records: Iterable[dict],
+        field_names: FieldNames = TRAINING_EXAMPLE_FIELD_NAMES,
+        selected_features: Optional[set[str]] = None,
+        add_intercept: bool = True) -> IndexMap:
+    """Default index-map build: distinct feature keys in appearance-sorted
+    order + optional intercept (io/GLMSuite.scala:159-205)."""
+    keys: set[str] = set()
+    for rec in records:
+        for f in rec.get(field_names.features) or []:
+            key = feature_key(f[NAME], f.get(TERM) or "")
+            if not selected_features or key in selected_features:
+                keys.add(key)
+    return IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
+
+
+def load_labeled_points_avro(
+        path: str,
+        field_names: FieldNames = TRAINING_EXAMPLE_FIELD_NAMES,
+        index_map: Optional[IndexMap] = None,
+        selected_features_file: Optional[str] = None,
+        add_intercept: bool = True) -> LabeledData:
+    """Legacy avro ingestion (io/GLMSuite.scala:98-137 + toLabeledPoints):
+    per record sparse features via the index map, intercept column set to 1
+    when the map carries the intercept key, offset/weight defaults 0/1."""
+    records = _read_records(path)
+    selected = (load_selected_features(selected_features_file)
+                if selected_features_file else None)
+    if index_map is None:
+        index_map = build_index_map_from_records(
+            records, field_names, selected, add_intercept)
+
+    n, d = len(records), len(index_map)
+    labels = np.zeros(n)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    rows, cols, vals = [], [], []
+    intercept_idx = index_map.intercept_index
+    for i, rec in enumerate(records):
+        labels[i] = float(rec[field_names.response])
+        if rec.get(field_names.offset) is not None:
+            offsets[i] = float(rec[field_names.offset])
+        if rec.get(field_names.weight) is not None:
+            weights[i] = float(rec[field_names.weight])
+        seen = set()
+        for f in rec.get(field_names.features) or []:
+            key = feature_key(f[NAME], f.get(TERM) or "")
+            if key not in index_map:
+                continue
+            j = index_map.index_of(key)
+            if j in seen:
+                raise ValueError(f"Duplicate feature {key!r} in record {i}")
+            seen.add(j)
+            rows.append(i)
+            cols.append(j)
+            vals.append(float(f[VALUE]))
+        if intercept_idx is not None:
+            rows.append(i)
+            cols.append(intercept_idx)
+            vals.append(1.0)
+    features = sp.csr_matrix(
+        (np.asarray(vals), (np.asarray(rows, np.int64),
+                            np.asarray(cols, np.int64))),
+        shape=(n, d))
+    return LabeledData(features, labels, offsets, weights, index_map)
+
+
+# ---------------------------------------------------------------------------
+# LibSVM (io/LibSVMInputDataFormat.scala:31-77)
+# ---------------------------------------------------------------------------
+
+
+def load_libsvm(path: str, feature_dimension: int,
+                use_intercept: bool = True, zero_based: bool = False,
+                delim: str = " ", idx_value_delim: str = ":") -> LabeledData:
+    """LibSVM text → LabeledData. Labels are binarized (>0 → 1) like the
+    reference; the intercept occupies the LAST column when enabled
+    (IdentityIndexMapLoader semantics)."""
+    true_dim = feature_dimension + 1 if use_intercept else feature_dimension
+    labels_list: list[float] = []
+    rows, cols, vals = [], [], []
+    paths = ([os.path.join(path, p) for p in sorted(os.listdir(path))]
+             if os.path.isdir(path) else [path])
+    i = 0
+    for p in paths:
+        with open(p) as fh:
+            for line in fh:
+                ts = line.split(delim)
+                if not ts or not ts[0].strip():
+                    continue
+                label = float(ts[0])
+                labels_list.append(1.0 if label > 0 else 0.0)
+                for item in ts[1:]:
+                    item = item.strip()
+                    if not item:
+                        continue
+                    idx_s, val_s = item.split(idx_value_delim)
+                    idx = int(idx_s) - (0 if zero_based else 1)
+                    if not 0 <= idx < feature_dimension:
+                        raise ValueError(
+                            f"feature index {idx_s} out of range for "
+                            f"feature_dimension={feature_dimension} "
+                            f"(zero_based={zero_based})")
+                    rows.append(i)
+                    cols.append(idx)
+                    vals.append(float(val_s))
+                if use_intercept:
+                    rows.append(i)
+                    cols.append(true_dim - 1)
+                    vals.append(1.0)
+                i += 1
+    n = len(labels_list)
+    features = sp.csr_matrix(
+        (np.asarray(vals), (np.asarray(rows, np.int64),
+                            np.asarray(cols, np.int64))),
+        shape=(n, true_dim))
+    if use_intercept:
+        # Identity map with the intercept in the LAST column
+        # (IdentityIndexMapLoader semantics, util/IdentityIndexMapLoader).
+        keys = {str(i): i for i in range(feature_dimension)}
+        keys[INTERCEPT_KEY] = feature_dimension
+        index_map = IndexMap(keys)
+    else:
+        index_map = IndexMap.identity(true_dim)
+    return LabeledData(features, np.asarray(labels_list), np.zeros(n),
+                       np.ones(n), index_map)
+
+
+# ---------------------------------------------------------------------------
+# Box-constraint map (io/GLMSuite.scala:207-260)
+# ---------------------------------------------------------------------------
+
+
+def parse_constraint_map(constraint_string: Optional[str],
+                         index_map: IndexMap
+                         ) -> Optional[dict[int, tuple[float, float]]]:
+    """JSON list of {name, term, lowerBound?, upperBound?} → per-index box
+    bounds with the reference's wildcard rules: (*,*) applies to every
+    non-intercept feature and must be the sole entry; (name,*) applies to
+    all terms of ``name``; no wildcard names with concrete terms."""
+    if not constraint_string:
+        return None
+    parsed = json.loads(constraint_string)
+    out: dict[int, tuple[float, float]] = {}
+    for entry in parsed:
+        name = entry["name"]
+        term = entry["term"]
+        lo = float(entry.get("lowerBound", -np.inf))
+        hi = float(entry.get("upperBound", np.inf))
+        if not (np.isfinite(lo) or np.isfinite(hi)):
+            raise ValueError(
+                f"constraint for ({name}, {term}) has -Inf/+Inf bounds")
+        if lo >= hi:
+            raise ValueError(
+                f"lower bound {lo} >= upper bound {hi} for ({name}, {term})")
+        if name == WILDCARD:
+            if term != WILDCARD:
+                raise ValueError(
+                    "wildcard name requires wildcard term")
+            if out:
+                raise ValueError(
+                    "(*, *) constraint must be the only constraint")
+            for key, idx in index_map.items():
+                if key != INTERCEPT_KEY:
+                    out[idx] = (lo, hi)
+        elif term == WILDCARD:
+            prefix = name + DELIMITER
+            for key, idx in index_map.items():
+                if key.startswith(prefix):
+                    if idx in out:
+                        raise ValueError(
+                            f"conflicting bounds for feature {key!r}")
+                    out[idx] = (lo, hi)
+        else:
+            key = feature_key(name, term)
+            if key in index_map:
+                idx = index_map.index_of(key)
+                if idx in out:
+                    raise ValueError(
+                        f"conflicting bounds for feature {key!r}")
+                out[idx] = (lo, hi)
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# GAME ingestion (avro/data/DataProcessingUtils.scala:57-215)
+# ---------------------------------------------------------------------------
+
+
+def _id_from_record(rec: dict, id_type: str) -> str:
+    """Top-level field first, then metadataMap
+    (DataProcessingUtils.scala:91-115)."""
+    v = rec.get(id_type)
+    if v is None or v == "":
+        meta = rec.get(META_DATA_MAP) or {}
+        v = meta.get(id_type)
+        if v is None:
+            raise ValueError(
+                f"Cannot find id in either record field {id_type!r} or in "
+                f"metadataMap with key #{id_type!r}")
+    return str(v)
+
+
+def load_game_dataset_avro(
+        path: str,
+        feature_shard_sections: dict[str, Sequence[str]],
+        index_maps: dict[str, IndexMap],
+        id_types: Sequence[str] = (),
+        response_required: bool = True) -> GameDataset:
+    """Avro records → columnar :class:`GameDataset`: one CSR per feature
+    shard (union of that shard's sections, intercept appended when the
+    shard's index map has the intercept key), response/offset/weight
+    columns, dictionary-encoded id columns, uids kept when present."""
+    records = _read_records(path)
+    n = len(records)
+    responses = np.full(n, np.nan)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    uids: Optional[list] = [] if any(
+        r.get(UID) is not None for r in records) else None
+
+    shard_builders = {
+        shard: ([], [], []) for shard in feature_shard_sections}
+    id_values: dict[str, list] = {t: [] for t in id_types}
+
+    for i, rec in enumerate(records):
+        if rec.get(RESPONSE) is not None:
+            responses[i] = float(rec[RESPONSE])
+        elif response_required:
+            raise ValueError(f"record {i} has no response field")
+        if rec.get(OFFSET) is not None:
+            offsets[i] = float(rec[OFFSET])
+        if rec.get(WEIGHT) is not None:
+            weights[i] = float(rec[WEIGHT])
+        if uids is not None:
+            uids.append("" if rec.get(UID) is None else str(rec[UID]))
+        for t in id_types:
+            id_values[t].append(_id_from_record(rec, t))
+        for shard, sections in feature_shard_sections.items():
+            imap = index_maps[shard]
+            rows, cols, vals = shard_builders[shard]
+            seen = set()
+            for section in sections:
+                entries = rec.get(section)
+                if entries is None:
+                    raise ValueError(
+                        f"record {i}: feature section {section!r} is not a "
+                        f"list (or is null)")
+                for f in entries:
+                    key = feature_key(f[NAME], f.get(TERM) or "")
+                    if key not in imap:
+                        continue
+                    j = imap.index_of(key)
+                    if j in seen:
+                        raise ValueError(
+                            f"Duplicate feature {key!r} in record {i} for "
+                            f"shard {shard!r}")
+                    seen.add(j)
+                    rows.append(i)
+                    cols.append(j)
+                    vals.append(float(f[VALUE]))
+            if imap.intercept_index is not None:
+                rows.append(i)
+                cols.append(imap.intercept_index)
+                vals.append(1.0)
+
+    shards = {}
+    for shard, (rows, cols, vals) in shard_builders.items():
+        d = len(index_maps[shard])
+        shards[shard] = sp.csr_matrix(
+            (np.asarray(vals), (np.asarray(rows, np.int64),
+                                np.asarray(cols, np.int64))),
+            shape=(n, d))
+
+    ds = GameDataset(responses=responses, feature_shards=shards,
+                     offsets=offsets, weights=weights)
+    for t in id_types:
+        ds.encode_ids(t, np.asarray(id_values[t], dtype=object))
+    if uids is not None:
+        ds.uids = np.asarray(uids, dtype=object)
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# NameAndTermFeatureSetContainer
+# ---------------------------------------------------------------------------
+
+
+class NameAndTermFeatureSets:
+    """Per-section (name, term) sets → index maps; text save/load
+    (avro/data/NameAndTermFeatureSetContainer.scala:38-127)."""
+
+    def __init__(self, sets: dict[str, set[tuple[str, str]]]):
+        self.sets = sets
+
+    @staticmethod
+    def from_records(records: Iterable[dict],
+                     section_keys: Sequence[str]) -> "NameAndTermFeatureSets":
+        sets: dict[str, set[tuple[str, str]]] = {
+            k: set() for k in section_keys}
+        for rec in records:
+            for k in section_keys:
+                for f in rec.get(k) or []:
+                    sets[k].add((f[NAME], f.get(TERM) or ""))
+        return NameAndTermFeatureSets(sets)
+
+    def index_map(self, section_keys: Sequence[str],
+                  add_intercept: bool) -> IndexMap:
+        """Union of the sections' features → one map
+        (getFeatureNameAndTermToIndexMap :46-58)."""
+        pairs = set()
+        for k in section_keys:
+            pairs |= self.sets.get(k, set())
+        return IndexMap.from_name_terms(sorted(pairs),
+                                        add_intercept=add_intercept)
+
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        for section, pairs in self.sets.items():
+            with open(os.path.join(directory, section), "w") as fh:
+                for name, term in sorted(pairs):
+                    fh.write(f"{name}\t{term}\n")
+
+    @staticmethod
+    def load(directory: str,
+             section_keys: Sequence[str]) -> "NameAndTermFeatureSets":
+        sets: dict[str, set[tuple[str, str]]] = {}
+        for section in section_keys:
+            pairs = set()
+            with open(os.path.join(directory, section)) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    if not line:
+                        continue
+                    parts = line.split("\t")
+                    if len(parts) == 1:
+                        pairs.add((parts[0], ""))
+                    elif len(parts) == 2:
+                        pairs.add((parts[0], parts[1]))
+                    else:
+                        raise ValueError(
+                            f"Unexpected entry {line!r}: expected 1 or 2 "
+                            f"tab-separated tokens, found {len(parts)}")
+            sets[section] = pairs
+        return NameAndTermFeatureSets(sets)

@@ -1,0 +1,69 @@
+"""Feature indexing job: build partitioned on-disk index maps from data.
+
+Re-design of the reference's ``FeatureIndexingJob``
+(reference: photon-ml/src/main/scala/com/linkedin/photon/ml/
+FeatureIndexingJob.scala:90-204): scan input avro for distinct (name, term)
+feature keys — per feature shard, from that shard's feature sections — and
+write a partitioned index-map store that later runs load instead of
+rebuilding (the PalDB off-heap store analog; here hash-partitioned JSON
+shards, util/PalDBIndexMap.scala:43-160).
+
+Used when the feature space is too large to rebuild per run; plain
+``IndexMap.from_keys`` covers the in-heap default path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from photon_ml_tpu.io.avro import read_records as _read_records
+from photon_ml_tpu.io.data_format import NAME, TERM, FieldNames
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+
+
+def build_feature_index(
+        input_path: str,
+        output_dir: str,
+        feature_shard_sections: Optional[dict[str, Sequence[str]]] = None,
+        field_names: Optional[FieldNames] = None,
+        add_intercept: bool = True,
+        num_partitions: int = 1) -> dict[str, IndexMap]:
+    """Scan data → distinct feature keys → partitioned index-map stores.
+
+    Two modes, matching the reference's legacy vs GAME usage:
+    - ``field_names`` set: one map over the legacy ``features`` field,
+      saved under namespace "global" (FeatureIndexingJob.scala:145-176).
+    - ``feature_shard_sections`` set: one map per feature shard over the
+      union of its sections, saved under the shard id as namespace
+      (the GAME per-shard feature-list layout).
+    """
+    records = _read_records(input_path)
+    out: dict[str, IndexMap] = {}
+
+    if field_names is not None:
+        keys = set()
+        for rec in records:
+            for f in rec.get(field_names.features) or []:
+                keys.add(feature_key(f[NAME], f.get(TERM) or ""))
+        imap = IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
+        imap.save(output_dir, num_partitions, namespace="global")
+        out["global"] = imap
+
+    for shard, sections in (feature_shard_sections or {}).items():
+        keys = set()
+        for rec in records:
+            for section in sections:
+                for f in rec.get(section) or []:
+                    keys.add(feature_key(f[NAME], f.get(TERM) or ""))
+        imap = IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
+        imap.save(output_dir, num_partitions, namespace=shard)
+        out[shard] = imap
+
+    return out
+
+
+def load_feature_index(directory: str, namespaces: Sequence[str]
+                       ) -> dict[str, IndexMap]:
+    """Load previously built stores (PalDBIndexMapLoader analog)."""
+    return {ns: IndexMap.load(directory, namespace=ns) for ns in namespaces}

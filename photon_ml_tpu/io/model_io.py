@@ -35,7 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.io import schemas
-from photon_ml_tpu.io.avro import read_directory, write_container
+from photon_ml_tpu.io.avro import (
+    read_directory,
+    read_records,
+    write_container,
+)
 from photon_ml_tpu.io.index_map import IndexMap, feature_key, split_feature_key
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.optimize.config import TaskType
@@ -112,6 +116,8 @@ def record_to_glm(record: dict, index_map: Optional[IndexMap] = None,
     load-without-index contract)."""
     if index_map is None:
         keys = [feature_key(f["name"], f["term"]) for f in record["means"]]
+        keys += [feature_key(f["name"], f["term"])
+                 for f in record.get("variances") or []]
         index_map = IndexMap.from_keys(keys)
     means = np.zeros(len(index_map))
     for f in record["means"]:
@@ -179,7 +185,7 @@ def save_game_model(model, output_dir: str,
             out = os.path.join(output_dir, FIXED_EFFECT, name)
             os.makedirs(os.path.join(out, COEFFICIENTS), exist_ok=True)
             _write_id_info(os.path.join(out, ID_INFO), [sub.feature_shard_id])
-            glm = sub.model.with_coefficients(sub.coefficients)
+            glm = sub.model
             record = glm_to_record(FIXED_EFFECT, glm,
                                    index_maps[sub.feature_shard_id])
             write_container(
@@ -217,8 +223,14 @@ def save_game_model(model, output_dir: str,
                     schemas.BAYESIAN_LINEAR_MODEL,
                     [records[i] for i in idxs])
         elif isinstance(sub, MatrixFactorizationModel):
-            save_matrix_factorization_model(
-                sub, os.path.join(output_dir, name), entity_vocabs)
+            # The reference's saveGameModelsToHDFS handles only fixed/random
+            # coordinates (ModelProcessingUtils.scala:53-88 match) — MF has
+            # its own save path with no id-info marker, so a GAME-directory
+            # load could not find it again. Refuse rather than lose it.
+            raise TypeError(
+                f"coordinate '{name}': MatrixFactorizationModel is saved "
+                f"separately via save_matrix_factorization_model(), not in "
+                f"the GAME model directory")
         else:
             raise TypeError(f"cannot serialize coordinate model {type(sub)}")
 
@@ -305,6 +317,10 @@ def save_matrix_factorization_model(
         arr = np.asarray(factors, np.float64)
         if ids is None:
             vocab = (entity_vocabs or {}).get(effect_type)
+            if vocab is not None and len(vocab) < len(arr):
+                raise ValueError(
+                    f"entity vocab for '{effect_type}' has {len(vocab)} "
+                    f"entries but the factor table has {len(arr)} rows")
             ids = (np.asarray(vocab)[:len(arr)] if vocab is not None
                    else np.arange(len(arr)))
         records = [{"effectId": str(ids[i]),
@@ -366,13 +382,7 @@ def save_scored_items(path: str, scores: np.ndarray, model_id: str,
 
 
 def load_scored_items(path: str) -> list[dict]:
-    from photon_ml_tpu.io.avro import read_container
-
-    if os.path.isdir(path):
-        _, records = read_directory(path)
-    else:
-        _, records = read_container(path)
-    return records
+    return read_records(path)
 
 
 # ---------------------------------------------------------------------------

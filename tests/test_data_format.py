@@ -1,0 +1,247 @@
+"""Ingestion tests: avro → LabeledData / GameDataset, LibSVM, constraints.
+
+Mirrors the reference's GLMSuiteIntegTest / DataProcessingUtilsTest coverage
+(reference photon-ml test suites) on in-memory-written avro fixtures.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import write_container
+from photon_ml_tpu.io.data_format import (
+    NameAndTermFeatureSets,
+    RESPONSE_PREDICTION_FIELD_NAMES,
+    TRAINING_EXAMPLE_FIELD_NAMES,
+    build_index_map_from_records,
+    load_game_dataset_avro,
+    load_labeled_points_avro,
+    load_libsvm,
+    parse_constraint_map,
+)
+from photon_ml_tpu.io.index_map import INTERCEPT_KEY, IndexMap, feature_key
+
+
+def _write_training_avro(path, records):
+    write_container(path, schemas.TRAINING_EXAMPLE, records)
+
+
+def _feat(name, term, value):
+    return {"name": name, "term": term, "value": value}
+
+
+def test_legacy_avro_round_trip(tmp_path):
+    records = [
+        {"uid": "r0", "label": 1.0,
+         "features": [_feat("age", "", 0.5), _feat("height", "cm", 1.7)],
+         "metadataMap": None, "weight": 2.0, "offset": 0.25},
+        {"uid": "r1", "label": 0.0,
+         "features": [_feat("age", "", -1.0)],
+         "metadataMap": None, "weight": None, "offset": None},
+    ]
+    path = str(tmp_path / "train.avro")
+    _write_training_avro(path, records)
+
+    data = load_labeled_points_avro(path)
+    assert data.num_samples == 2
+    # 2 features + intercept
+    assert data.dim == 3
+    assert data.index_map.intercept_index is not None
+    np.testing.assert_allclose(data.labels, [1.0, 0.0])
+    np.testing.assert_allclose(data.weights, [2.0, 1.0])
+    np.testing.assert_allclose(data.offsets, [0.25, 0.0])
+    X = data.features.toarray()
+    age = data.index_map.index_of(feature_key("age"))
+    height = data.index_map.index_of(feature_key("height", "cm"))
+    icp = data.index_map.intercept_index
+    assert X[0, age] == 0.5 and X[0, height] == 1.7 and X[0, icp] == 1.0
+    assert X[1, age] == -1.0 and X[1, height] == 0.0 and X[1, icp] == 1.0
+
+
+def test_legacy_avro_selected_features_and_response_field(tmp_path):
+    records = [
+        {"uid": None, "response": 3.0,
+         "features": [_feat("a", "", 1.0), _feat("b", "", 2.0)],
+         "metadataMap": None, "weight": None, "offset": None},
+    ]
+    path = str(tmp_path / "train.avro")
+    write_container(path, schemas.RESPONSE_PREDICTION, records)
+    sel_path = str(tmp_path / "selected.avro")
+    write_container(sel_path, schemas.NAME_TERM_VALUE,
+                    [{"name": "a", "term": "", "value": 1.0}])
+
+    data = load_labeled_points_avro(
+        path, RESPONSE_PREDICTION_FIELD_NAMES,
+        selected_features_file=sel_path, add_intercept=False)
+    assert data.dim == 1
+    assert data.labels[0] == 3.0
+    assert data.features.toarray()[0, 0] == 1.0
+
+
+def test_duplicate_feature_raises(tmp_path):
+    records = [{"uid": None, "label": 1.0,
+                "features": [_feat("a", "", 1.0), _feat("a", "", 2.0)],
+                "metadataMap": None, "weight": None, "offset": None}]
+    path = str(tmp_path / "train.avro")
+    _write_training_avro(path, records)
+    with pytest.raises(ValueError, match="Duplicate feature"):
+        load_labeled_points_avro(path)
+
+
+def test_libsvm_load(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as fh:
+        fh.write("+1 1:0.5 3:1.5\n")
+        fh.write("-1 2:2.0\n")
+    data = load_libsvm(path, feature_dimension=3)
+    assert data.dim == 4  # + intercept last
+    np.testing.assert_allclose(data.labels, [1.0, 0.0])
+    X = data.features.toarray()
+    np.testing.assert_allclose(X[0], [0.5, 0.0, 1.5, 1.0])
+    np.testing.assert_allclose(X[1], [0.0, 2.0, 0.0, 1.0])
+    assert data.index_map.intercept_index == 3
+
+
+def test_constraint_map_wildcards():
+    imap = IndexMap.from_keys(
+        [feature_key("a", "t1"), feature_key("a", "t2"), feature_key("b")],
+        add_intercept=True)
+    # (name, *) applies to all of a's terms
+    cmap = parse_constraint_map(
+        '[{"name": "a", "term": "*", "lowerBound": -1.0, "upperBound": 1.0}]',
+        imap)
+    assert set(cmap) == {imap.index_of(feature_key("a", "t1")),
+                         imap.index_of(feature_key("a", "t2"))}
+    # (*, *) applies to everything but the intercept
+    cmap = parse_constraint_map(
+        '[{"name": "*", "term": "*", "lowerBound": 0.0}]', imap)
+    assert len(cmap) == 3
+    assert imap.intercept_index not in cmap
+    # (*, *) plus anything else is an error
+    with pytest.raises(ValueError):
+        parse_constraint_map(
+            '[{"name": "*", "term": "*", "lowerBound": 0.0},'
+            ' {"name": "b", "term": "", "upperBound": 2.0}]', imap)
+    # unbounded both sides is an error
+    with pytest.raises(ValueError):
+        parse_constraint_map('[{"name": "b", "term": ""}]', imap)
+
+
+def _game_records():
+    return [
+        {"uid": "u0", "response": 1.0, "offset": 0.5, "weight": 2.0,
+         "metadataMap": {"userId": "alice"},
+         "globalFeatures": [_feat("g1", "", 1.0)],
+         "userFeatures": [_feat("u1", "", 3.0)]},
+        {"uid": "u1", "response": 0.0, "offset": None, "weight": None,
+         "metadataMap": {"userId": "bob"},
+         "globalFeatures": [_feat("g2", "", 2.0)],
+         "userFeatures": []},
+    ]
+
+
+_GAME_SCHEMA = {
+    "name": "GameRecord", "type": "record", "namespace": "test",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+        {"name": "globalFeatures",
+         "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "userFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+    ],
+}
+
+
+def test_game_dataset_ingestion(tmp_path):
+    path = str(tmp_path / "game.avro")
+    write_container(path, _GAME_SCHEMA, _game_records())
+    imaps = {
+        "global": IndexMap.from_keys(
+            [feature_key("g1"), feature_key("g2")], add_intercept=True),
+        "user": IndexMap.from_keys([feature_key("u1")]),
+    }
+    ds = load_game_dataset_avro(
+        path,
+        feature_shard_sections={"global": ["globalFeatures"],
+                                "user": ["userFeatures"]},
+        index_maps=imaps,
+        id_types=["userId"])
+    assert ds.num_samples == 2
+    np.testing.assert_allclose(ds.responses, [1.0, 0.0])
+    np.testing.assert_allclose(ds.offsets, [0.5, 0.0])
+    np.testing.assert_allclose(ds.weights, [2.0, 1.0])
+    Xg = ds.feature_shards["global"].toarray()
+    icp = imaps["global"].intercept_index
+    assert Xg[0, imaps["global"].index_of(feature_key("g1"))] == 1.0
+    assert Xg[0, icp] == 1.0 and Xg[1, icp] == 1.0
+    Xu = ds.feature_shards["user"].toarray()
+    assert Xu.shape == (2, 1)
+    assert Xu[0, 0] == 3.0 and Xu[1, 0] == 0.0
+    # ids decoded through metadataMap
+    vocab = ds.id_vocabs["userId"]
+    assert sorted(vocab.tolist()) == ["alice", "bob"]
+    assert list(ds.uids) == ["u0", "u1"]
+
+
+def test_game_dataset_missing_id_raises(tmp_path):
+    path = str(tmp_path / "game.avro")
+    write_container(path, _GAME_SCHEMA, _game_records())
+    with pytest.raises(ValueError, match="Cannot find id"):
+        load_game_dataset_avro(
+            path, feature_shard_sections={"user": ["userFeatures"]},
+            index_maps={"user": IndexMap.from_keys([feature_key("u1")])},
+            id_types=["itemId"])
+
+
+def test_name_term_feature_sets_round_trip(tmp_path):
+    records = _game_records()
+    sets = NameAndTermFeatureSets.from_records(
+        records, ["globalFeatures", "userFeatures"])
+    assert sets.sets["globalFeatures"] == {("g1", ""), ("g2", "")}
+    imap = sets.index_map(["globalFeatures", "userFeatures"],
+                          add_intercept=True)
+    assert len(imap) == 4  # g1 g2 u1 + intercept
+    out = str(tmp_path / "feature-lists")
+    sets.save(out)
+    loaded = NameAndTermFeatureSets.load(
+        out, ["globalFeatures", "userFeatures"])
+    assert loaded.sets == sets.sets
+
+
+def test_build_index_map_from_records():
+    records = [
+        {"label": 1.0, "features": [_feat("b", "", 1.0), _feat("a", "", 1.0)]},
+        {"label": 0.0, "features": [_feat("c", "x", 1.0)]},
+    ]
+    imap = build_index_map_from_records(records, TRAINING_EXAMPLE_FIELD_NAMES)
+    assert len(imap) == 4
+    assert INTERCEPT_KEY in imap
+
+
+def test_feature_index_job(tmp_path):
+    from photon_ml_tpu.io.avro import write_container
+    from photon_ml_tpu.io.feature_index_job import (
+        build_feature_index,
+        load_feature_index,
+    )
+
+    path = str(tmp_path / "game.avro")
+    write_container(path, _GAME_SCHEMA, _game_records())
+    out = str(tmp_path / "index")
+    built = build_feature_index(
+        path, out,
+        feature_shard_sections={"global": ["globalFeatures"],
+                                "user": ["userFeatures"]},
+        num_partitions=2)
+    assert len(built["global"]) == 3  # g1, g2 + intercept
+    loaded = load_feature_index(out, ["global", "user"])
+    assert dict(loaded["global"].items()) == dict(built["global"].items())
+    assert dict(loaded["user"].items()) == dict(built["user"].items())
