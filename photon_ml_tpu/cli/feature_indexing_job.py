@@ -1,0 +1,55 @@
+"""Standalone feature-indexing CLI (reference: photon-ml/src/main/scala/
+com/linkedin/photon/ml/FeatureIndexingJob.scala:176-204): scan input data
+for distinct features and write partitioned index-map stores for later runs
+(the PalDB off-heap map build)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from photon_ml_tpu.cli.game_training_driver import _parse_section_keys_map
+from photon_ml_tpu.io.data_format import (
+    RESPONSE_PREDICTION_FIELD_NAMES,
+    TRAINING_EXAMPLE_FIELD_NAMES,
+)
+from photon_ml_tpu.io.feature_index_job import build_feature_index
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="feature-indexing-job")
+    p.add_argument("--input-paths", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--num-partitions", type=int, default=1)
+    p.add_argument("--add-intercept", default="true")
+    p.add_argument("--feature-shard-id-to-feature-section-keys-map",
+                   default="", help="GAME mode: per-shard section keys")
+    p.add_argument("--format", default="TRAINING_EXAMPLE",
+                   choices=["TRAINING_EXAMPLE", "RESPONSE_PREDICTION"],
+                   help="legacy mode: which field naming to scan")
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    ns = parse_args(argv if argv is not None else sys.argv[1:])
+    add_intercept = str(ns.add_intercept).lower() in ("true", "1")
+    shard_sections = _parse_section_keys_map(
+        ns.feature_shard_id_to_feature_section_keys_map) or None
+    field_names = None
+    if shard_sections is None:
+        field_names = (TRAINING_EXAMPLE_FIELD_NAMES
+                       if ns.format == "TRAINING_EXAMPLE"
+                       else RESPONSE_PREDICTION_FIELD_NAMES)
+    built = build_feature_index(
+        ns.input_paths, ns.output_dir,
+        feature_shard_sections=shard_sections,
+        field_names=field_names,
+        add_intercept=add_intercept,
+        num_partitions=ns.num_partitions)
+    for ns_name, imap in built.items():
+        print(f"{ns_name}: {len(imap)} features")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""GAME scoring driver: load model → score dataset → save scores → evaluate.
+
+Re-design of the reference's scoring pipeline (reference: photon-ml/src/
+main/scala/com/linkedin/photon/ml/cli/game/scoring/Driver.scala:45-246):
+prepareFeatureMaps → prepareGameDataSet (response optional) →
+scoreGameDataSet (load model, Σ coordinate scores) → saveScoresToHDFS
+(ScoringResultAvro) → optional evaluation when responses are present.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.evaluation.evaluators import EvaluatorSpec, evaluate
+from photon_ml_tpu.io.data_format import (
+    NameAndTermFeatureSets,
+    load_game_dataset_avro,
+)
+from photon_ml_tpu.io.model_io import load_game_model, save_scored_items
+from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
+
+from photon_ml_tpu.cli.game_training_driver import (
+    _parse_key_value_map,
+    _parse_section_keys_map,
+)
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="game-scoring",
+                                description="GAME scoring on TPU")
+    p.add_argument("--input-data-dirs", required=True)
+    p.add_argument("--game-model-input-dir", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-name-and-term-set-path")
+    p.add_argument("--feature-shard-id-to-feature-section-keys-map",
+                   required=True)
+    p.add_argument("--feature-shard-id-to-intercept-map", default="")
+    p.add_argument("--random-effect-id-set", default="",
+                   help="comma-separated id types present in the data")
+    p.add_argument("--evaluator-type", default="")
+    p.add_argument("--model-id", default="")
+    p.add_argument("--delete-output-dir-if-exists", default="false")
+    p.add_argument("--application-name", default="game-scoring")
+    return p.parse_args(argv)
+
+
+class GameScoringDriver:
+    """cli/game/scoring/Driver.scala analog."""
+
+    def __init__(self, ns: argparse.Namespace,
+                 logger: Optional[PhotonLogger] = None):
+        self.ns = ns
+        self.logger = logger or PhotonLogger(
+            os.path.join(ns.output_dir, "game-scoring.log"), echo=False)
+        self.section_keys = _parse_section_keys_map(
+            ns.feature_shard_id_to_feature_section_keys_map)
+        self.intercept_map = {
+            k: v.strip().lower() in ("true", "1")
+            for k, v in _parse_key_value_map(
+                ns.feature_shard_id_to_intercept_map).items()}
+        self.evaluators = [EvaluatorSpec.parse(x)
+                           for x in ns.evaluator_type.split(",")
+                           if x.strip()]
+
+    def run(self) -> np.ndarray:
+        ns = self.ns
+        if os.path.isdir(ns.output_dir) and os.listdir(ns.output_dir):
+            if str(ns.delete_output_dir_if_exists).lower() in ("true", "1"):
+                import shutil
+                shutil.rmtree(ns.output_dir)
+        os.makedirs(ns.output_dir, exist_ok=True)
+
+        # Feature maps: from the feature lists when given, else from the
+        # model files themselves (loadGameModelFromHDFS's no-index path).
+        index_maps = {}
+        all_sections = sorted({s for secs in self.section_keys.values()
+                               for s in secs})
+        if ns.feature_name_and_term_set_path:
+            sets = NameAndTermFeatureSets.load(
+                ns.feature_name_and_term_set_path, all_sections)
+            for shard, sections in self.section_keys.items():
+                index_maps[shard] = sets.index_map(
+                    sections,
+                    add_intercept=self.intercept_map.get(shard, True))
+
+        with timed_phase("loadModel", self.logger):
+            model, index_maps = load_game_model(
+                ns.game_model_input_dir, index_maps or None)
+        self.logger.info(f"model coordinates: {model.coordinate_ids}")
+
+        id_types = sorted(
+            {x.strip() for x in ns.random_effect_id_set.split(",")
+             if x.strip()}
+            | {e.id_type for e in self.evaluators if e.id_type})
+        with timed_phase("prepareGameDataSet", self.logger):
+            data = load_game_dataset_avro(
+                ns.input_data_dirs, self.section_keys, index_maps,
+                id_types=id_types, response_required=False)
+        self.logger.info(f"scoring {data.num_samples} samples")
+
+        with timed_phase("scoreGameDataSet", self.logger):
+            scores = np.asarray(model.score(data))
+
+        save_scored_items(
+            os.path.join(ns.output_dir, "scores", "part-00000.avro"),
+            scores, ns.model_id or "game-model",
+            uids=(data.uids if data.uids is not None else None),
+            labels=(data.responses
+                    if np.isfinite(data.responses).any() else None),
+            weights=data.weights)
+
+        if self.evaluators and np.isfinite(data.responses).all():
+            labels = jnp.asarray(data.responses)
+            weights = jnp.asarray(data.weights)
+            for spec in self.evaluators:
+                entity_ids = num_entities = None
+                if spec.id_type:
+                    entity_ids = jnp.asarray(data.id_columns[spec.id_type])
+                    num_entities = len(data.id_vocabs[spec.id_type])
+                value = evaluate(spec, jnp.asarray(scores), labels, weights,
+                                 entity_ids=entity_ids,
+                                 num_entities=num_entities)
+                self.logger.info(f"evaluation {spec.name}: {value:.6f}")
+        return scores
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    ns = parse_args(argv if argv is not None else sys.argv[1:])
+    driver = GameScoringDriver(ns)
+    try:
+        driver.run()
+    except Exception as e:
+        driver.logger.error(f"GAME scoring failed: {e}")
+        raise
+    finally:
+        driver.logger.close()
+
+
+if __name__ == "__main__":
+    main()

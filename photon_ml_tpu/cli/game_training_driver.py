@@ -1,0 +1,402 @@
+"""GAME training driver: datasets → coordinates → CD grid → best model.
+
+Re-design of the reference's GAME training pipeline (reference:
+photon-ml/src/main/scala/com/linkedin/photon/ml/cli/game/training/
+Driver.scala:66-757 + Params.scala:38-426 + cli/game/GAMEDriver.scala):
+
+    prepareFeatureMaps → prepareGameDataSet → prepareTrainingDataSet →
+    prepare evaluators → train (grid of coordinate-descent runs) →
+    selectBestModel → saveModelToHDFS
+
+Flag names and composite string formats match the reference CLI:
+- ``--fixed-effect-data-configurations``: ``coordId:shardId,minPartitions``
+  per coordinate, ``|``-separated.
+- ``--random-effect-data-configurations``: ``coordId:<reConfig>`` with the
+  reference's 7-field config string (data/RandomEffectDataConfiguration
+  .scala:80).
+- ``--fixed/random-effect-optimization-configurations``: grid points
+  separated by ``;``, coordinates by ``|``, each
+  ``coordId:maxIter,tol,lambda,downSamplingRate,OPTIMIZER,REG``
+  (optimization/GLMOptimizationConfiguration.scala:41-87).
+- ``--factored-random-effect-optimization-configurations``:
+  ``coordId:reCfg:latentCfg:mfCfg`` with mfCfg = ``maxIters,numFactors``.
+- ``--feature-shard-id-to-feature-section-keys-map``:
+  ``shardId:sec1,sec2|shard2:...``; intercept map likewise with booleans.
+
+Training runs every grid combination of fixed/random opt configs and keeps
+the model that wins the first validation evaluator (Driver.scala:557-592
+selectBestModel), then saves ALL/BEST/NONE per ``--model-output-mode``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.evaluation.evaluators import EvaluatorSpec, evaluate
+from photon_ml_tpu.game.coordinate import (
+    FactoredRandomEffectCoordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import (
+    CoordinateDescentResult,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.game.dataset import (
+    FixedEffectDataConfiguration,
+    GameDataset,
+    RandomEffectDataConfiguration,
+    build_fixed_effect_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem
+from photon_ml_tpu.io.data_format import (
+    NameAndTermFeatureSets,
+    load_game_dataset_avro,
+)
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.model_io import save_game_model
+from photon_ml_tpu.optimize.config import (
+    GLMOptimizationConfiguration,
+    MFOptimizationConfiguration,
+    TaskType,
+)
+from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
+
+
+class ModelOutputMode:
+    """io/ModelOutputMode.scala: ALL / BEST / NONE."""
+
+    ALL = "ALL"
+    BEST = "BEST"
+    NONE = "NONE"
+
+
+def _parse_key_value_map(s: str) -> dict[str, str]:
+    """``key1:v|key2:v`` → dict (Params.scala:316-371 line format)."""
+    out = {}
+    for line in s.split("|"):
+        if not line.strip():
+            continue
+        key, _, value = line.partition(":")
+        out[key.strip()] = value.strip()
+    return out
+
+
+def _parse_section_keys_map(s: str) -> dict[str, list[str]]:
+    return {k: [x.strip() for x in v.split(",") if x.strip()]
+            for k, v in _parse_key_value_map(s).items()}
+
+
+def _parse_opt_config_grid(s: str) -> list[dict[str,
+                                               GLMOptimizationConfiguration]]:
+    """``;``-separated grid points of ``|``-separated ``coord:cfg``."""
+    grid = []
+    for point in s.split(";"):
+        if not point.strip():
+            continue
+        grid.append({k: GLMOptimizationConfiguration.parse(v)
+                     for k, v in _parse_key_value_map(point).items()})
+    return grid
+
+
+def _parse_factored_grid(s: str) -> list[dict]:
+    """``coordId:reCfg:latentCfg:mfCfg`` per coordinate."""
+    grid = []
+    for point in s.split(";"):
+        if not point.strip():
+            continue
+        configs = {}
+        for line in point.split("|"):
+            if not line.strip():
+                continue
+            parts = [p.strip() for p in line.split(":")]
+            if len(parts) != 4:
+                raise ValueError(
+                    f"factored config needs coordId:reCfg:latentCfg:mfCfg, "
+                    f"got {line!r}")
+            key, s1, s2, s3 = parts
+            configs[key] = (GLMOptimizationConfiguration.parse(s1),
+                            GLMOptimizationConfiguration.parse(s2),
+                            MFOptimizationConfiguration.parse(s3))
+        grid.append(configs)
+    return grid
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="game-training",
+                                description="GAME training on TPU")
+    p.add_argument("--train-input-dirs", required=True)
+    p.add_argument("--validate-input-dirs")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task-type", required=True,
+                   choices=[t.name for t in TaskType])
+    p.add_argument("--feature-name-and-term-set-path")
+    p.add_argument("--feature-shard-id-to-feature-section-keys-map",
+                   required=True)
+    p.add_argument("--feature-shard-id-to-intercept-map", default="")
+    p.add_argument("--updating-sequence", required=True)
+    p.add_argument("--num-iterations", type=int, default=1)
+    p.add_argument("--fixed-effect-data-configurations", default="")
+    p.add_argument("--fixed-effect-optimization-configurations", default="")
+    p.add_argument("--random-effect-data-configurations", default="")
+    p.add_argument("--random-effect-optimization-configurations", default="")
+    p.add_argument("--factored-random-effect-optimization-configurations",
+                   default="")
+    p.add_argument("--evaluator-type", default="")
+    p.add_argument("--model-output-mode", default=ModelOutputMode.ALL,
+                   choices=[ModelOutputMode.ALL, ModelOutputMode.BEST,
+                            ModelOutputMode.NONE])
+    p.add_argument("--num-output-files-for-random-effect-model", type=int,
+                   default=1)
+    p.add_argument("--compute-variance", default="false")
+    p.add_argument("--delete-output-dir-if-exists", default="false")
+    p.add_argument("--application-name", default="game-training")
+    return p.parse_args(argv)
+
+
+class GameTrainingDriver:
+    """cli/game/training/Driver.scala analog."""
+
+    def __init__(self, ns: argparse.Namespace,
+                 logger: Optional[PhotonLogger] = None):
+        self.ns = ns
+        self.task = TaskType[ns.task_type]
+        self.logger = logger or PhotonLogger(
+            os.path.join(ns.output_dir, "game-training.log"), echo=False)
+        self.section_keys = _parse_section_keys_map(
+            ns.feature_shard_id_to_feature_section_keys_map)
+        self.intercept_map = {
+            k: v.strip().lower() in ("true", "1")
+            for k, v in _parse_key_value_map(
+                ns.feature_shard_id_to_intercept_map).items()}
+        self.updating_sequence = [
+            x.strip() for x in ns.updating_sequence.split(",") if x.strip()]
+        self.fixed_data_configs = {
+            k: FixedEffectDataConfiguration.parse(v)
+            for k, v in _parse_key_value_map(
+                ns.fixed_effect_data_configurations).items()}
+        self.random_data_configs = {
+            k: RandomEffectDataConfiguration.parse(v)
+            for k, v in _parse_key_value_map(
+                ns.random_effect_data_configurations).items()}
+        self.fixed_opt_grid = _parse_opt_config_grid(
+            ns.fixed_effect_optimization_configurations) or [{}]
+        self.random_opt_grid = _parse_opt_config_grid(
+            ns.random_effect_optimization_configurations) or [{}]
+        self.factored_grid = _parse_factored_grid(
+            ns.factored_random_effect_optimization_configurations) or [{}]
+        self.evaluators = [EvaluatorSpec.parse(x)
+                           for x in ns.evaluator_type.split(",") if x.strip()]
+
+        self.index_maps: dict[str, IndexMap] = {}
+        self.train_data: Optional[GameDataset] = None
+        self.validate_data: Optional[GameDataset] = None
+
+    # -- pipeline ----------------------------------------------------------
+
+    def prepare_feature_maps(self) -> None:
+        """GAMEDriver.prepareFeatureMaps: per-shard index maps from the
+        feature name-and-term sets (default in-heap path)."""
+        all_sections = sorted({s for secs in self.section_keys.values()
+                               for s in secs})
+        if self.ns.feature_name_and_term_set_path:
+            sets = NameAndTermFeatureSets.load(
+                self.ns.feature_name_and_term_set_path, all_sections)
+        else:
+            from photon_ml_tpu.io.avro import read_records
+            sets = NameAndTermFeatureSets.from_records(
+                read_records(self.ns.train_input_dirs), all_sections)
+        for shard, sections in self.section_keys.items():
+            self.index_maps[shard] = sets.index_map(
+                sections, add_intercept=self.intercept_map.get(shard, True))
+        self.logger.info(
+            f"feature maps: "
+            f"{ {k: len(v) for k, v in self.index_maps.items()} }")
+
+    def _id_types(self) -> list[str]:
+        id_types = {cfg.random_effect_type
+                    for cfg in self.random_data_configs.values()}
+        id_types |= {e.id_type for e in self.evaluators if e.id_type}
+        return sorted(id_types)
+
+    def prepare_game_dataset(self) -> None:
+        self.train_data = load_game_dataset_avro(
+            self.ns.train_input_dirs, self.section_keys, self.index_maps,
+            id_types=self._id_types(), response_required=True)
+        self.logger.info(
+            f"train dataset: {self.train_data.num_samples} samples")
+        if self.ns.validate_input_dirs:
+            self.validate_data = load_game_dataset_avro(
+                self.ns.validate_input_dirs, self.section_keys,
+                self.index_maps, id_types=self._id_types(),
+                response_required=True)
+
+    def _build_coordinates(self, fixed_cfgs, random_cfgs, factored_cfgs
+                           ) -> dict:
+        """Driver.train :352-533: one coordinate per updating-sequence entry
+        with this grid point's optimization configs."""
+        coords = {}
+        compute_variance = (
+            str(self.ns.compute_variance).lower() in ("true", "1"))
+        for cid in self.updating_sequence:
+            if cid in self.fixed_data_configs:
+                data_cfg = self.fixed_data_configs[cid]
+                opt_cfg = fixed_cfgs.get(
+                    cid, GLMOptimizationConfiguration())
+                ds = build_fixed_effect_dataset(
+                    self.train_data, data_cfg.feature_shard_id)
+                coords[cid] = FixedEffectCoordinate(
+                    dataset=ds,
+                    problem=GLMOptimizationProblem(
+                        config=opt_cfg, task=self.task,
+                        compute_variances=compute_variance))
+            elif cid in self.random_data_configs and cid in factored_cfgs:
+                data_cfg = self.random_data_configs[cid]
+                re_cfg, latent_cfg, mf_cfg = factored_cfgs[cid]
+                ds = build_random_effect_dataset(self.train_data, data_cfg)
+                coords[cid] = FactoredRandomEffectCoordinate(
+                    dataset=ds,
+                    problem=RandomEffectOptimizationProblem(
+                        config=re_cfg, task=self.task),
+                    latent_problem=GLMOptimizationProblem(
+                        config=latent_cfg, task=self.task),
+                    latent_dim=mf_cfg.num_factors,
+                    num_inner_iterations=mf_cfg.max_number_iterations)
+            elif cid in self.random_data_configs:
+                data_cfg = self.random_data_configs[cid]
+                opt_cfg = random_cfgs.get(
+                    cid, GLMOptimizationConfiguration())
+                ds = build_random_effect_dataset(self.train_data, data_cfg)
+                coords[cid] = RandomEffectCoordinate(
+                    dataset=ds,
+                    problem=RandomEffectOptimizationProblem(
+                        config=opt_cfg, task=self.task))
+            else:
+                raise ValueError(
+                    f"coordinate {cid!r} in updating sequence has no data "
+                    f"configuration")
+        return coords
+
+    def _validation_evaluator(self):
+        if self.validate_data is None or not self.evaluators:
+            return None, None
+        vd = self.validate_data
+        labels = jnp.asarray(vd.responses)
+        weights = jnp.asarray(vd.weights)
+
+        def evaluator(scores):
+            out = {}
+            for spec in self.evaluators:
+                entity_ids = None
+                num_entities = None
+                if spec.id_type:
+                    entity_ids = jnp.asarray(vd.id_columns[spec.id_type])
+                    num_entities = len(vd.id_vocabs[spec.id_type])
+                out[spec.name] = evaluate(
+                    spec, scores, labels, weights,
+                    entity_ids=entity_ids, num_entities=num_entities)
+            return out
+
+        return evaluator, self.evaluators[0]
+
+    def train(self) -> tuple:
+        """Grid over opt-config combinations; each runs coordinate descent
+        (Driver.train :324-350)."""
+        evaluator, first_spec = self._validation_evaluator()
+        best = None  # (metric, result, combo_desc)
+        results = []
+        combos = list(itertools.product(
+            self.fixed_opt_grid, self.random_opt_grid, self.factored_grid))
+        for gi, (f_cfgs, r_cfgs, fac_cfgs) in enumerate(combos):
+            desc = (f"grid[{gi}]: fixed={ {k: v.render() for k, v in f_cfgs.items()} } "
+                    f"random={ {k: v.render() for k, v in r_cfgs.items()} }")
+            self.logger.info(desc)
+            with timed_phase(f"train {desc}", self.logger):
+                coords = self._build_coordinates(f_cfgs, r_cfgs, fac_cfgs)
+                result = run_coordinate_descent(
+                    coords, self.ns.num_iterations, self.task,
+                    jnp.asarray(self.train_data.responses),
+                    jnp.asarray(self.train_data.weights),
+                    jnp.asarray(self.train_data.offsets),
+                    validation_data=self.validate_data,
+                    validation_evaluator=evaluator,
+                    validation_metric=(first_spec.name if first_spec
+                                       else None),
+                    higher_is_better=(first_spec.better_than(1.0, 0.0)
+                                      if first_spec else True),
+                    logger=self.logger)
+            results.append((desc, result))
+            metric = result.best_metric
+            if metric is not None:
+                if best is None or (first_spec.better_than(metric, best[0])):
+                    best = (metric, result, desc)
+        if best is None and results:
+            # no validation: lowest training objective wins
+            best_result = min(
+                results, key=lambda dr: dr[1].states[-1].objective)
+            best = (None, best_result[1], best_result[0])
+        return best, results
+
+    def run(self) -> CoordinateDescentResult:
+        ns = self.ns
+        if os.path.isdir(ns.output_dir) and os.listdir(ns.output_dir):
+            if str(ns.delete_output_dir_if_exists).lower() in ("true", "1"):
+                import shutil
+                shutil.rmtree(ns.output_dir)
+            elif os.path.exists(os.path.join(ns.output_dir, "best")):
+                raise FileExistsError(
+                    f"output dir {ns.output_dir} is not empty")
+        os.makedirs(ns.output_dir, exist_ok=True)
+        with timed_phase("prepareFeatureMaps", self.logger):
+            self.prepare_feature_maps()
+        with timed_phase("prepareGameDataSet", self.logger):
+            self.prepare_game_dataset()
+        best, results = self.train()
+        _, best_result, best_desc = best
+        self.logger.info(f"best model: {best_desc}")
+
+        if ns.model_output_mode != ModelOutputMode.NONE:
+            entity_vocabs = dict(self.train_data.id_vocabs)
+            model = (best_result.best_model if best_result.best_model
+                     is not None else best_result.model)
+            save_game_model(
+                model, os.path.join(ns.output_dir, "best"),
+                self.index_maps, entity_vocabs=entity_vocabs,
+                num_output_files=ns.num_output_files_for_random_effect_model,
+                task=self.task)
+            if ns.model_output_mode == ModelOutputMode.ALL:
+                for gi, (_, result) in enumerate(results):
+                    save_game_model(
+                        result.model,
+                        os.path.join(ns.output_dir, "output", f"grid-{gi}"),
+                        self.index_maps, entity_vocabs=entity_vocabs,
+                        num_output_files=(
+                            ns.num_output_files_for_random_effect_model),
+                        task=self.task)
+        return best_result
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    ns = parse_args(argv if argv is not None else sys.argv[1:])
+    driver = GameTrainingDriver(ns)
+    try:
+        driver.run()
+    except Exception as e:
+        driver.logger.error(f"GAME training failed: {e}")
+        raise
+    finally:
+        driver.logger.close()
+
+
+if __name__ == "__main__":
+    main()

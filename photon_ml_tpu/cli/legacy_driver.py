@@ -1,0 +1,567 @@
+"""Legacy single-GLM training driver: the staged end-to-end pipeline.
+
+Re-design of the reference's legacy driver (reference: photon-ml/src/main/
+scala/com/linkedin/photon/ml/Driver.scala:142-638 + DriverStage.scala +
+PhotonMLCmdLineParser.scala / Params.scala / OptionNames.scala):
+
+    preprocess → train → validate → diagnose → write models
+
+- Stage machine with completion assertions (Driver.run :142-202).
+- Flags keep the reference's names (OptionNames.scala:21-57) via argparse.
+- preprocess (:267): load avro/libsvm, sanity-check rows, feature summary
+  → NormalizationContext.
+- train (:294): λ-grid with warm starts (ModelTraining.scala:103-215).
+- validate (:404): per-λ metric maps + best-model selection
+  (Evaluation.scala, ModelSelection.scala).
+- diagnose (:525): fitting/bootstrap/HL/importance/independence →
+  HTML + text report (:618-638).
+- output: TSV text models (util/IOUtils.writeModelsInText) + summaries.
+
+The Spark-specific flags (kryo, tree-aggregate-depth, min-partitions) are
+accepted for CLI compatibility and ignored — XLA collectives replace the
+treeAggregate machinery (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import dense_batch
+from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_ml_tpu.diagnostics import diagnostics as diag
+from photon_ml_tpu.diagnostics.reporting import render_html, render_text
+from photon_ml_tpu.diagnostics.transformers import build_diagnostic_document
+from photon_ml_tpu.evaluation.model_evaluation import (
+    evaluate_model,
+    select_best_model,
+)
+from photon_ml_tpu.io.data_format import (
+    InputFormatType,
+    LabeledData,
+    RESPONSE_PREDICTION_FIELD_NAMES,
+    TRAINING_EXAMPLE_FIELD_NAMES,
+    load_labeled_points_avro,
+    load_libsvm,
+    parse_constraint_map,
+)
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.io.model_io import write_models_text
+from photon_ml_tpu.ops.normalization import (
+    NormalizationContext,
+    NormalizationType,
+)
+from photon_ml_tpu.optimize.common import BoxConstraints
+from photon_ml_tpu.optimize.config import (
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    TaskType,
+)
+from photon_ml_tpu.stat.summary import summarize
+from photon_ml_tpu.training import TrainedModel, train_glm_grid
+from photon_ml_tpu.utils.events import (
+    EventEmitter,
+    PhotonOptimizationLogEvent,
+    PhotonSetupEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
+
+
+class DriverStage:
+    """DriverStage.scala analog: ordered pipeline stages."""
+
+    INIT = ("INIT", 0)
+    PREPROCESSED = ("PREPROCESSED", 1)
+    TRAINED = ("TRAINED", 2)
+    VALIDATED = ("VALIDATED", 3)
+    DIAGNOSED = ("DIAGNOSED", 4)
+
+
+class DiagnosticMode:
+    """diagnostics/DiagnosticMode.scala: NONE / TRAIN / VALIDATE / ALL."""
+
+    NONE = "NONE"
+    TRAIN = "TRAIN"
+    VALIDATE = "VALIDATE"
+    ALL = "ALL"
+
+
+@dataclasses.dataclass
+class LegacyParams:
+    """Params.scala:40-195 analog (typed, validated)."""
+
+    training_data_directory: str
+    output_directory: str
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+    validating_data_directory: Optional[str] = None
+    job_name: str = "photon-ml-tpu"
+    regularization_weights: Sequence[float] = (10.0,)
+    intercept: bool = True
+    num_iterations: int = 80
+    convergence_tolerance: float = 1e-6
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    regularization_type: RegularizationType = RegularizationType.L2
+    elastic_net_alpha: float = 0.5
+    format: str = "TRAINING_EXAMPLE"  # or RESPONSE_PREDICTION
+    input_file_format: InputFormatType = InputFormatType.AVRO
+    feature_dimension: int = -1  # libsvm only
+    normalization_type: NormalizationType = NormalizationType.NONE
+    coefficient_box_constraints: Optional[str] = None
+    data_validation_type: DataValidationType = \
+        DataValidationType.VALIDATE_DISABLED
+    diagnostic_mode: str = DiagnosticMode.NONE
+    selected_features_file: Optional[str] = None
+    summarization_output_dir: Optional[str] = None
+    validate_per_iteration: bool = False
+    compute_variance: bool = False
+    delete_output_dirs_if_exist: bool = False
+    event_listeners: Sequence[str] = ()
+    offheap_indexmap_dir: Optional[str] = None
+
+    def validate(self) -> None:
+        """Params.validate :201 analog."""
+        errors = []
+        if (self.regularization_type == RegularizationType.L1
+                and self.optimizer == OptimizerType.TRON):
+            errors.append("TRON cannot be used with L1 regularization")
+        if (self.diagnostic_mode in (DiagnosticMode.VALIDATE,
+                                     DiagnosticMode.ALL)
+                and not self.validating_data_directory):
+            errors.append(
+                f"Diagnostic mode cannot be {self.diagnostic_mode} when the "
+                f"validate directory is not specified")
+        if (self.input_file_format == InputFormatType.LIBSVM
+                and self.feature_dimension <= 0):
+            errors.append("LIBSVM input requires --feature-dimension")
+        if not 0.0 <= self.elastic_net_alpha <= 1.0:
+            errors.append("elastic-net-alpha must be in [0, 1]")
+        if errors:
+            raise ValueError("; ".join(errors))
+
+
+def parse_args(argv: Sequence[str]) -> LegacyParams:
+    """PhotonMLCmdLineParser.parseFromCommandLine :66 analog — flag names
+    match OptionNames.scala:21-57."""
+    p = argparse.ArgumentParser(prog="photon-ml-tpu",
+                                description="Train GLMs on TPU")
+    p.add_argument("--training-data-directory", required=True)
+    p.add_argument("--validating-data-directory")
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--job-name", default="photon-ml-tpu")
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.name for t in TaskType])
+    p.add_argument("--regularization-weights", default="10",
+                   help="comma-separated lambda grid")
+    p.add_argument("--intercept", default="true")
+    p.add_argument("--num-iterations", type=int, default=80)
+    p.add_argument("--convergence-tolerance", type=float, default=1e-6)
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.name for o in OptimizerType])
+    p.add_argument("--regularization-type", default="L2",
+                   choices=[r.name for r in RegularizationType])
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--format", default="TRAINING_EXAMPLE",
+                   choices=["TRAINING_EXAMPLE", "RESPONSE_PREDICTION"])
+    p.add_argument("--input-file-format", default="AVRO",
+                   choices=["AVRO", "LIBSVM"])
+    p.add_argument("--feature-dimension", type=int, default=-1)
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--coefficient-box-constraints")
+    p.add_argument("--data-validation-type", default="VALIDATE_DISABLED",
+                   choices=[v.name for v in DataValidationType])
+    p.add_argument("--diagnostic-mode", default="NONE",
+                   choices=["NONE", "TRAIN", "VALIDATE", "ALL"])
+    p.add_argument("--selected-features-file")
+    p.add_argument("--summarization-output-dir")
+    p.add_argument("--validate-per-iteration", default="false")
+    p.add_argument("--coefficient-variance", dest="compute_variance",
+                   default="false")
+    p.add_argument("--delete-output-dirs-if-exist", default="false")
+    p.add_argument("--event-listeners", default="")
+    p.add_argument("--offheap-indexmap-dir")
+    # Spark-era flags: accepted, ignored (XLA replaces them).
+    p.add_argument("--kryo", default="true", help=argparse.SUPPRESS)
+    p.add_argument("--min-partitions", type=int, default=1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--tree-aggregate-depth", type=int, default=1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--optimization-tracker", default="true",
+                   help=argparse.SUPPRESS)
+    ns = p.parse_args(argv)
+
+    def as_bool(x: str) -> bool:
+        return str(x).strip().lower() in ("true", "1", "yes")
+
+    params = LegacyParams(
+        training_data_directory=ns.training_data_directory,
+        validating_data_directory=ns.validating_data_directory,
+        output_directory=ns.output_directory,
+        job_name=ns.job_name,
+        task=TaskType[ns.task],
+        regularization_weights=[float(x) for x in
+                                ns.regularization_weights.split(",") if x],
+        intercept=as_bool(ns.intercept),
+        num_iterations=ns.num_iterations,
+        convergence_tolerance=ns.convergence_tolerance,
+        optimizer=OptimizerType[ns.optimizer],
+        regularization_type=RegularizationType[ns.regularization_type],
+        elastic_net_alpha=ns.elastic_net_alpha,
+        format=ns.format,
+        input_file_format=InputFormatType[ns.input_file_format],
+        feature_dimension=ns.feature_dimension,
+        normalization_type=NormalizationType[ns.normalization_type],
+        coefficient_box_constraints=ns.coefficient_box_constraints,
+        data_validation_type=DataValidationType[ns.data_validation_type],
+        diagnostic_mode=ns.diagnostic_mode,
+        selected_features_file=ns.selected_features_file,
+        summarization_output_dir=ns.summarization_output_dir,
+        validate_per_iteration=as_bool(ns.validate_per_iteration),
+        compute_variance=as_bool(ns.compute_variance),
+        delete_output_dirs_if_exist=as_bool(ns.delete_output_dirs_if_exist),
+        event_listeners=[x for x in ns.event_listeners.split(",") if x],
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+    )
+    params.validate()
+    return params
+
+
+class LegacyDriver(EventEmitter):
+    """Driver.scala:142-638 analog."""
+
+    def __init__(self, params: LegacyParams,
+                 logger: Optional[PhotonLogger] = None):
+        super().__init__()
+        self.params = params
+        self.stage = DriverStage.INIT
+        self.stage_history: list[tuple[str, int]] = []
+        self.logger = logger or PhotonLogger(
+            os.path.join(params.output_directory, "photon.log"), echo=False)
+        for name in params.event_listeners:
+            self.register_listener_by_name(name)
+
+        self.train_data: Optional[LabeledData] = None
+        self.validate_data: Optional[LabeledData] = None
+        self.summary = None
+        self.normalization = NormalizationContext.identity()
+        self.box: Optional[BoxConstraints] = None
+        self.models: list[TrainedModel] = []
+        self.per_lambda_metrics: dict[float, dict[str, float]] = {}
+        self.best_lambda: Optional[float] = None
+
+    # -- stages ------------------------------------------------------------
+
+    def _assert_stage(self, expected: tuple[str, int]) -> None:
+        if self.stage != expected:
+            raise RuntimeError(
+                f"expected driver stage {expected[0]}, got {self.stage[0]}")
+
+    def _advance(self, stage: tuple[str, int]) -> None:
+        self.stage_history.append(self.stage)
+        self.stage = stage
+
+    def _load(self, path: str) -> LabeledData:
+        p = self.params
+        if p.input_file_format == InputFormatType.LIBSVM:
+            return load_libsvm(path, p.feature_dimension,
+                               use_intercept=p.intercept)
+        field_names = (TRAINING_EXAMPLE_FIELD_NAMES
+                       if p.format == "TRAINING_EXAMPLE"
+                       else RESPONSE_PREDICTION_FIELD_NAMES)
+        index_map = (self.train_data.index_map
+                     if self.train_data is not None else None)
+        return load_labeled_points_avro(
+            path, field_names, index_map=index_map,
+            selected_features_file=p.selected_features_file,
+            add_intercept=p.intercept)
+
+    def preprocess(self) -> None:
+        """Driver.preprocess :267: load, sanity-check, summarize."""
+        self._assert_stage(DriverStage.INIT)
+        p = self.params
+        with timed_phase("preprocess", self.logger):
+            self.train_data = self._load(p.training_data_directory)
+            ok = sanity_check_data(
+                self.train_data.labels, self.train_data.offsets,
+                self.train_data.features, p.task, p.data_validation_type,
+                logger=self.logger)
+            if not ok:
+                raise ValueError("training data failed validation")
+            if p.validating_data_directory:
+                self.validate_data = self._load(p.validating_data_directory)
+                if not sanity_check_data(
+                        self.validate_data.labels, self.validate_data.offsets,
+                        self.validate_data.features, p.task,
+                        p.data_validation_type, logger=self.logger):
+                    raise ValueError("validation data failed validation")
+
+            self.summary = summarize(self.train_data.features.toarray())
+            if p.summarization_output_dir:
+                self._write_summary(p.summarization_output_dir)
+            self.normalization = NormalizationContext.build(
+                p.normalization_type, self.summary,
+                intercept_index=self.train_data.index_map.intercept_index)
+            self.box = BoxConstraints.from_map(
+                self.train_data.dim,
+                parse_constraint_map(p.coefficient_box_constraints,
+                                     self.train_data.index_map))
+        self._advance(DriverStage.PREPROCESSED)
+
+    def _write_summary(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        s = self.summary
+        imap = self.train_data.index_map
+        rows = []
+        for key, idx in imap.items():
+            rows.append({
+                "featureName": key.split("\x01")[0],
+                "featureTerm": (key.split("\x01")[1]
+                                if "\x01" in key else ""),
+                "metrics": {
+                    "mean": float(s.mean[idx]),
+                    "variance": float(s.variance[idx]),
+                    "min": float(s.min[idx]),
+                    "max": float(s.max[idx]),
+                    "meanAbs": float(s.mean_abs[idx]),
+                },
+            })
+        from photon_ml_tpu.io import schemas
+        from photon_ml_tpu.io.avro import write_container
+        write_container(os.path.join(out_dir, "part-00000.avro"),
+                        schemas.FEATURE_SUMMARIZATION_RESULT, rows)
+
+    def _batch(self, data: LabeledData):
+        return dense_batch(data.features.toarray(), data.labels,
+                           data.offsets, data.weights)
+
+    def train(self) -> None:
+        """Driver.train :294 → ModelTraining.trainGeneralizedLinearModel."""
+        self._assert_stage(DriverStage.PREPROCESSED)
+        p = self.params
+        self.send_event(TrainingStartEvent(time.time()))
+        with timed_phase("train", self.logger):
+            batch = self._batch(self.train_data)
+            self.models = train_glm_grid(
+                batch, p.task, p.regularization_weights,
+                optimizer_type=p.optimizer,
+                regularization_context=RegularizationContext(
+                    p.regularization_type, p.elastic_net_alpha),
+                max_iterations=p.num_iterations,
+                tolerance=p.convergence_tolerance,
+                normalization=self.normalization,
+                box=self.box,
+                compute_variances=p.compute_variance)
+            for tm in self.models:
+                self.logger.info(
+                    f"lambda={tm.regularization_weight:g} "
+                    f"iters={tm.result.iterations} "
+                    f"reason={tm.result.convergence_reason}")
+        self.send_event(TrainingFinishEvent(time.time()))
+        self._advance(DriverStage.TRAINED)
+
+    def validate(self) -> None:
+        """Driver.validate :404: per-λ metrics + best-model selection."""
+        self._assert_stage(DriverStage.TRAINED)
+        p = self.params
+        if self.validate_data is None:
+            self._advance(DriverStage.VALIDATED)
+            return
+        with timed_phase("validate", self.logger):
+            batch = self._batch(self.validate_data)
+            for tm in self.models:
+                metrics = evaluate_model(tm.model, batch)
+                self.per_lambda_metrics[tm.regularization_weight] = metrics
+                self.logger.info(
+                    f"lambda={tm.regularization_weight:g} metrics={metrics}")
+                self.send_event(PhotonOptimizationLogEvent(
+                    tm.regularization_weight, tm.result, metrics))
+            self.best_lambda = select_best_model(self.per_lambda_metrics,
+                                                 p.task)
+            self.logger.info(f"best lambda: {self.best_lambda:g}")
+        self._advance(DriverStage.VALIDATED)
+
+    def diagnose(self) -> None:
+        """Driver.diagnose :525 → HTML/text report :618-638."""
+        if self.stage == DriverStage.TRAINED:
+            self._advance(DriverStage.VALIDATED)
+        self._assert_stage(DriverStage.VALIDATED)
+        p = self.params
+        if p.diagnostic_mode == DiagnosticMode.NONE:
+            self._advance(DriverStage.DIAGNOSED)
+            return
+        with timed_phase("diagnose", self.logger):
+            train_batch = self._batch(self.train_data)
+            do_train = p.diagnostic_mode in (DiagnosticMode.TRAIN,
+                                             DiagnosticMode.ALL)
+            do_validate = p.diagnostic_mode in (DiagnosticMode.VALIDATE,
+                                                DiagnosticMode.ALL)
+            fitting = bootstrap = None
+            if do_train:
+                fitting = self._fitting_diagnostic()
+                bootstrap = self._bootstrap_diagnostic()
+            hl = independence = None
+            importance = []
+            if do_validate and self.validate_data is not None:
+                best = self._best_model()
+                vbatch = self._batch(self.validate_data)
+                margins = np.asarray(best.model.compute_score(
+                    vbatch.X, vbatch.offsets))
+                predictions = np.asarray(best.model.mean(jnp.asarray(margins)))
+                if p.task == TaskType.LOGISTIC_REGRESSION:
+                    hl = diag.hosmer_lemeshow(self.validate_data.labels,
+                                              predictions)
+                independence = diag.prediction_error_independence(
+                    self.validate_data.labels, predictions)
+                w = np.asarray(best.model.coefficients.means)
+                importance = [
+                    diag.feature_importance(
+                        w, self.train_data.index_map,
+                        np.asarray(self.summary.mean_abs),
+                        "expected magnitude"),
+                    diag.feature_importance(
+                        w, self.train_data.index_map,
+                        np.asarray(self.summary.variance), "variance"),
+                ]
+            doc = build_diagnostic_document(
+                f"Diagnostics: {p.job_name}", hl=hl,
+                importance=importance or None,
+                independence=independence, fitting=fitting,
+                bootstrap=bootstrap, index_map=self.train_data.index_map,
+                preamble=json.dumps(
+                    {"task": p.task.name,
+                     "optimizer": p.optimizer.name,
+                     "lambdas": list(p.regularization_weights)}))
+            os.makedirs(p.output_directory, exist_ok=True)
+            with open(os.path.join(p.output_directory,
+                                   "diagnostic-report.html"), "w") as fh:
+                fh.write(render_html(doc))
+            with open(os.path.join(p.output_directory,
+                                   "diagnostic-report.txt"), "w") as fh:
+                fh.write(render_text(doc))
+        self._advance(DriverStage.DIAGNOSED)
+
+    def _model_factory(self, with_metrics_on_train: bool):
+        """(row_indices, warm_start) → per-λ results, for fitting/bootstrap
+        diagnostics (the reference's modelFactory closures)."""
+        p = self.params
+        data = self.train_data
+
+        def factory(idx: np.ndarray, warm_start: dict):
+            sub = dense_batch(data.features[idx].toarray(),
+                              data.labels[idx], data.offsets[idx],
+                              data.weights[idx])
+            models = train_glm_grid(
+                sub, p.task, p.regularization_weights,
+                optimizer_type=p.optimizer,
+                regularization_context=RegularizationContext(
+                    p.regularization_type, p.elastic_net_alpha),
+                max_iterations=p.num_iterations,
+                tolerance=p.convergence_tolerance,
+                normalization=self.normalization, box=self.box)
+            out = {}
+            full = self._batch(data)
+            for tm in models:
+                train_metrics = evaluate_model(tm.model, sub)
+                test_metrics = evaluate_model(tm.model, full)
+                coef = np.asarray(tm.model.coefficients.means)
+                if with_metrics_on_train:
+                    out[tm.regularization_weight] = (
+                        coef, train_metrics, test_metrics)
+                else:
+                    out[tm.regularization_weight] = (coef, test_metrics)
+            return out
+
+        return factory
+
+    def _fitting_diagnostic(self):
+        return diag.fitting_diagnostic(
+            self.train_data.num_samples, self.train_data.dim,
+            self._model_factory(with_metrics_on_train=True))
+
+    def _bootstrap_diagnostic(self):
+        try:
+            return diag.bootstrap_training(
+                self.train_data.num_samples, 4, 0.75,
+                self._model_factory(with_metrics_on_train=False))
+        except ValueError:
+            return None
+
+    def _best_model(self) -> TrainedModel:
+        if self.best_lambda is not None:
+            for tm in self.models:
+                if tm.regularization_weight == self.best_lambda:
+                    return tm
+        return self.models[-1]
+
+    def output(self) -> None:
+        """Write TSV models (Driver :196-197 writeModelsInText)."""
+        p = self.params
+        out = os.path.join(p.output_directory, "output")
+        write_models_text(
+            out, [(tm.regularization_weight, tm.model)
+                  for tm in self.models],
+            self.train_data.index_map)
+        if self.best_lambda is not None:
+            best_dir = os.path.join(p.output_directory, "best")
+            write_models_text(
+                best_dir, [(self.best_lambda, self._best_model().model)],
+                self.train_data.index_map)
+        with open(os.path.join(p.output_directory, "metrics.json"),
+                  "w") as fh:
+            json.dump({str(k): v
+                       for k, v in self.per_lambda_metrics.items()}, fh,
+                      indent=2)
+
+    def run(self) -> None:
+        """Driver.run :142-202."""
+        p = self.params
+        if os.path.exists(p.output_directory) and os.listdir(
+                p.output_directory):
+            if p.delete_output_dirs_if_exist:
+                import shutil
+                shutil.rmtree(p.output_directory)
+            elif os.path.exists(os.path.join(p.output_directory,
+                                             "output")):
+                raise FileExistsError(
+                    f"output directory {p.output_directory} is not empty")
+        os.makedirs(p.output_directory, exist_ok=True)
+        self.send_event(PhotonSetupEvent(
+            log_dir=p.output_directory,
+            input_path=p.training_data_directory,
+            params_summary=str(dataclasses.asdict(p))))
+        self.preprocess()
+        self.train()
+        self.validate()
+        self.diagnose()
+        self.output()
+        self.logger.info(
+            f"stages completed: "
+            f"{[s[0] for s in self.stage_history + [self.stage]]}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    params = parse_args(argv if argv is not None else sys.argv[1:])
+    driver = LegacyDriver(params)
+    try:
+        driver.run()
+    except Exception as e:
+        driver.logger.error(f"driver failed: {e}")
+        raise
+    finally:
+        driver.logger.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -116,7 +116,8 @@ def build_index_map_from_records(
     for rec in records:
         for f in rec.get(field_names.features) or []:
             key = feature_key(f[NAME], f.get(TERM) or "")
-            if not selected_features or key in selected_features:
+            # None = no filtering; an empty SET means "select nothing"
+            if selected_features is None or key in selected_features:
                 keys.add(key)
     return IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
 
@@ -152,6 +153,10 @@ def load_labeled_points_avro(
         seen = set()
         for f in rec.get(field_names.features) or []:
             key = feature_key(f[NAME], f.get(TERM) or "")
+            # selected-features filter applies even with a caller-provided
+            # index map (GLMSuite's selected-feature semantics)
+            if selected is not None and key not in selected:
+                continue
             if key not in index_map:
                 continue
             j = index_map.index_of(key)
@@ -186,15 +191,19 @@ def load_libsvm(path: str, feature_dimension: int,
     true_dim = feature_dimension + 1 if use_intercept else feature_dimension
     labels_list: list[float] = []
     rows, cols, vals = [], [], []
-    paths = ([os.path.join(path, p) for p in sorted(os.listdir(path))]
+    # Skip hidden/underscore-prefixed files (_SUCCESS, .crc checksums) the
+    # way the avro directory reader filters to *.avro.
+    paths = ([os.path.join(path, p) for p in sorted(os.listdir(path))
+              if not p.startswith((".", "_"))]
              if os.path.isdir(path) else [path])
     i = 0
     for p in paths:
         with open(p) as fh:
             for line in fh:
-                ts = line.split(delim)
-                if not ts or not ts[0].strip():
+                line = line.strip()
+                if not line:
                     continue
+                ts = line.split(delim)
                 label = float(ts[0])
                 labels_list.append(1.0 if label > 0 else 0.0)
                 for item in ts[1:]:
@@ -304,7 +313,7 @@ def _id_from_record(rec: dict, id_type: str) -> str:
         if v is None:
             raise ValueError(
                 f"Cannot find id in either record field {id_type!r} or in "
-                f"metadataMap with key #{id_type!r}")
+                f"metadataMap with key {id_type!r}")
     return str(v)
 
 

@@ -136,3 +136,24 @@ class GLMOptimizationConfiguration:
 
     def with_regularization_weight(self, w: float) -> "GLMOptimizationConfiguration":
         return dataclasses.replace(self, regularization_weight=w)
+
+
+@dataclasses.dataclass(frozen=True)
+class MFOptimizationConfiguration:
+    """Matrix-factorization config for factored random effects
+    (reference: optimization/game/MFOptimizationConfiguration.scala:20-42;
+    string format ``maxNumberIterations,numFactors``)."""
+
+    max_number_iterations: int
+    num_factors: int
+
+    @staticmethod
+    def parse(s: str) -> "MFOptimizationConfiguration":
+        parts = [p.strip() for p in s.split(",")]
+        if len(parts) != 2:
+            raise ValueError(
+                f"expected 'maxNumberIterations,numFactors', got {s!r}")
+        return MFOptimizationConfiguration(int(parts[0]), int(parts[1]))
+
+    def render(self) -> str:
+        return f"{self.max_number_iterations},{self.num_factors}"

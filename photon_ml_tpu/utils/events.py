@@ -1,0 +1,85 @@
+"""Training event bus: emitter + listeners with typed event classes.
+
+Re-design of the reference's event system (reference: photon-ml/src/main/
+scala/com/linkedin/photon/ml/event/): ``EventEmitter`` trait mixed into the
+legacy driver (Driver.scala:110-119 registers listeners by class name from
+``--event-listeners``), ``Event`` case classes (Event.scala:27-66):
+PhotonSetupEvent, TrainingStartEvent, TrainingFinishEvent,
+PhotonOptimizationLogEvent (carrying per-model trackers + metrics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """event/Event.scala base."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonSetupEvent(Event):
+    log_dir: str
+    input_path: str
+    params_summary: str
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingStartEvent(Event):
+    timestamp: float
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFinishEvent(Event):
+    timestamp: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonOptimizationLogEvent(Event):
+    """Per-model optimization record (Event.scala:60-66): the regularization
+    weight, the optimizer state history, and validation metrics if any."""
+
+    regularization_weight: float
+    states: Any  # OptimizationResult / tracker
+    metrics: Optional[dict[str, float]] = None
+
+
+EventListener = Callable[[Event], None]
+
+
+class EventEmitter:
+    """event/EventEmitter.scala analog: registration + locked dispatch."""
+
+    def __init__(self):
+        self._listeners: list[EventListener] = []
+        self._lock = threading.Lock()
+
+    def register_listener(self, listener: EventListener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def register_listener_by_name(self, qualified_name: str) -> None:
+        """Instantiate a listener from ``module.Class`` / ``module.func``
+        (the reference's --event-listeners class-name injection,
+        Driver.scala:110-118)."""
+        module_name, _, attr = qualified_name.rpartition(".")
+        if not module_name:
+            raise ValueError(
+                f"listener name {qualified_name!r} must be module-qualified")
+        obj = getattr(importlib.import_module(module_name), attr)
+        listener = obj() if isinstance(obj, type) else obj
+        self.register_listener(listener)
+
+    def send_event(self, event: Event) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            listener(event)
+
+    def clear_listeners(self) -> None:
+        with self._lock:
+            self._listeners.clear()

@@ -245,3 +245,44 @@ def test_feature_index_job(tmp_path):
     loaded = load_feature_index(out, ["global", "user"])
     assert dict(loaded["global"].items()) == dict(built["global"].items())
     assert dict(loaded["user"].items()) == dict(built["user"].items())
+
+
+def test_libsvm_leading_space_and_junk_files(tmp_path):
+    d = tmp_path / "libsvm-dir"
+    d.mkdir()
+    with open(d / "part-00000", "w") as fh:
+        fh.write(" +1 1:0.5\n")  # leading space must not drop the row
+        fh.write("-1 2:1.0\n")
+    (d / "_SUCCESS").write_text("")
+    (d / ".part-00000.crc").write_bytes(b"\x00\x01binary")
+    data = load_libsvm(str(d), feature_dimension=2)
+    assert data.num_samples == 2
+    np.testing.assert_allclose(data.labels, [1.0, 0.0])
+
+
+def test_libsvm_out_of_range_index_raises(tmp_path):
+    path = str(tmp_path / "x.libsvm")
+    with open(path, "w") as fh:
+        fh.write("+1 4:9.0\n")
+    with pytest.raises(ValueError, match="out of range"):
+        load_libsvm(path, feature_dimension=3)
+
+
+def test_selected_features_respected_with_index_map(tmp_path):
+    records = [
+        {"uid": None, "label": 1.0,
+         "features": [_feat("a", "", 1.0), _feat("b", "", 2.0)],
+         "metadataMap": None, "weight": None, "offset": None},
+    ]
+    path = str(tmp_path / "train.avro")
+    _write_training_avro(path, records)
+    sel_path = str(tmp_path / "selected.avro")
+    write_container(sel_path, schemas.NAME_TERM_VALUE,
+                    [{"name": "a", "term": "", "value": 1.0}])
+    imap = IndexMap.from_keys([feature_key("a"), feature_key("b")])
+    data = load_labeled_points_avro(
+        path, index_map=imap, selected_features_file=sel_path,
+        add_intercept=False)
+    X = data.features.toarray()
+    assert X[0, imap.index_of(feature_key("a"))] == 1.0
+    assert X[0, imap.index_of(feature_key("b"))] == 0.0  # filtered out

@@ -1,0 +1,190 @@
+"""Diagnostics tests: HL, importance, Kendall tau, fitting, bootstrap,
+reporting (mirrors reference diagnostics/* test suites)."""
+
+import numpy as np
+import pytest
+from scipy import stats as scipy_stats
+
+from photon_ml_tpu.diagnostics.diagnostics import (
+    bootstrap_training,
+    feature_importance,
+    fitting_diagnostic,
+    hosmer_lemeshow,
+    kendall_tau,
+    prediction_error_independence,
+)
+from photon_ml_tpu.diagnostics.reporting import (
+    BulletedList,
+    Chapter,
+    Document,
+    LinePlot,
+    Section,
+    SimpleText,
+    Table,
+    render_html,
+    render_text,
+)
+from photon_ml_tpu.diagnostics.transformers import build_diagnostic_document
+from photon_ml_tpu.io.index_map import IndexMap, feature_key
+
+
+class TestHosmerLemeshow:
+    def test_well_calibrated_model_small_chi2(self):
+        rng = np.random.default_rng(0)
+        p = rng.uniform(0.05, 0.95, size=20000)
+        labels = (rng.uniform(size=20000) < p).astype(float)
+        rep = hosmer_lemeshow(labels, p)
+        assert rep.p_value > 0.01  # calibrated → no rejection
+        assert len(rep.bins) == 10
+        # counts conserve the sample
+        total = sum(b.observed_pos + b.observed_neg for b in rep.bins)
+        assert total == 20000
+
+    def test_miscalibrated_model_large_chi2(self):
+        rng = np.random.default_rng(1)
+        p = rng.uniform(0.05, 0.95, size=5000)
+        labels = (rng.uniform(size=5000) < 0.5).astype(float)  # ignore p
+        rep = hosmer_lemeshow(labels, p)
+        assert rep.chi_square > scipy_stats.chi2.ppf(0.999, rep.degrees_of_freedom)
+
+
+class TestFeatureImportance:
+    def test_ranking_and_factor(self):
+        imap = IndexMap.from_keys([feature_key(f"f{i}") for i in range(4)])
+        w = np.asarray([0.1, -2.0, 0.5, 0.0])
+        mean_abs = np.asarray([10.0, 0.1, 1.0, 5.0])
+        rep = feature_importance(w, imap, mean_abs)
+        # importance = |w*factor| = [1.0, 0.2, 0.5, 0.0] → f0 top
+        top = max(rep.feature_importance.items(), key=lambda kv: kv[1][1])
+        assert top[0] == ("f0", "")
+        assert rep.rank_to_importance[90] >= rep.rank_to_importance[10]
+
+    def test_defaults_to_unit_factor(self):
+        rep = feature_importance(np.asarray([1.0, -3.0]))
+        assert max(rep.feature_importance.values(),
+                   key=lambda v: v[1])[1] == 3.0
+
+
+class TestKendallTau:
+    def test_matches_scipy_tau_beta(self):
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=300)
+        b = 0.5 * a + rng.normal(size=300)
+        rep = kendall_tau(a, b)
+        want, _ = scipy_stats.kendalltau(a, b)
+        assert rep.tau_beta == pytest.approx(want, abs=1e-12)
+        # no ties in continuous draws: alpha == beta
+        assert rep.tau_alpha == pytest.approx(rep.tau_beta, abs=1e-9)
+        assert rep.concordant + rep.discordant == 300 * 299 // 2
+
+    def test_independent_high_p(self):
+        rng = np.random.default_rng(3)
+        rep = kendall_tau(rng.normal(size=500), rng.normal(size=500))
+        assert rep.p_value > 0.01
+
+    def test_prediction_error_independence_caps_sample(self):
+        rng = np.random.default_rng(4)
+        labels = rng.normal(size=10000)
+        preds = labels + rng.normal(size=10000)
+        rep = prediction_error_independence(labels, preds, max_samples=1000)
+        assert rep.kendall_tau.num_items == 1000
+
+
+class TestFitting:
+    def test_learning_curves_shrink_gap(self):
+        # factory trains ridge on the given rows; test error should drop
+        rng = np.random.default_rng(5)
+        n, d = 2000, 5
+        X = rng.normal(size=(n, d))
+        w_true = rng.normal(size=d)
+        y = X @ w_true + 0.1 * rng.normal(size=n)
+
+        def factory(idx, warm):
+            Xi, yi = X[idx], y[idx]
+            w = np.linalg.solve(Xi.T @ Xi + 1e-3 * np.eye(d), Xi.T @ yi)
+            def rmse(Xa, ya):
+                return float(np.sqrt(np.mean((Xa @ w - ya) ** 2)))
+            return {1.0: (w, {"RMSE": rmse(Xi, yi)},
+                          {"RMSE": rmse(X, y)})}
+
+        reports = fitting_diagnostic(n, d, factory, seed=0)
+        assert 1.0 in reports
+        curve = reports[1.0].metrics["RMSE"]
+        assert len(curve.portions) == 9
+        assert np.all(np.diff(curve.portions) > 0)
+        # holdout error at full data <= at smallest portion (noisy; lenient)
+        assert curve.test_values[-1] <= curve.test_values[0] + 0.05
+
+    def test_too_few_samples_returns_empty(self):
+        assert fitting_diagnostic(10, 5, lambda i, w: {}) == {}
+
+
+class TestBootstrap:
+    def test_coefficient_cis_cover_truth(self):
+        rng = np.random.default_rng(6)
+        n, d = 1500, 3
+        X = rng.normal(size=(n, d))
+        w_true = np.asarray([1.0, -0.5, 0.0])
+        y = X @ w_true + 0.1 * rng.normal(size=n)
+
+        def factory(idx, warm):
+            Xi, yi = X[idx], y[idx]
+            w = np.linalg.solve(Xi.T @ Xi + 1e-6 * np.eye(d), Xi.T @ yi)
+            return {1.0: (w, {"RMSE": float(np.sqrt(np.mean(
+                (Xi @ w - yi) ** 2)))})}
+
+        reports = bootstrap_training(n, 16, 0.8, factory, seed=0)
+        rep = reports[1.0]
+        assert len(rep.coefficient_summaries) == d
+        for j in range(d):
+            s = rep.coefficient_summaries[j]
+            assert s.min - 0.05 <= w_true[j] <= s.max + 0.05
+        # the zero coefficient straddles zero
+        assert 2 in rep.straddling_zero
+        assert "RMSE" in rep.metric_summaries
+
+    def test_requires_multiple_samples(self):
+        with pytest.raises(ValueError):
+            bootstrap_training(100, 1, 0.5, lambda i, w: {})
+
+
+class TestReporting:
+    def _doc(self):
+        return Document("Test Report", [
+            Chapter("Chapter A", [
+                Section("S1", [
+                    SimpleText("hello world"),
+                    BulletedList(["x", "y"]),
+                    Table(["col1", "col2"], [["1", "2"], ["3", "4"]],
+                          caption="tiny"),
+                    LinePlot(x=np.asarray([1.0, 2.0, 3.0]),
+                             series={"train": np.asarray([3.0, 2.0, 1.0])},
+                             title="curve", x_label="x", y_label="y"),
+                ])])])
+
+    def test_text_renderer(self):
+        text = render_text(self._doc())
+        assert "Test Report" in text and "1.1 S1" in text
+        assert "hello world" in text and "* x" in text
+        assert "col1" in text and "curve" in text
+
+    def test_html_renderer_valid_structure(self):
+        html = render_html(self._doc())
+        assert html.startswith("<!DOCTYPE html>")
+        assert "<table>" in html and "<svg" in html and "</html>" in html
+        assert "hello world" in html
+
+    def test_build_diagnostic_document_assembles(self):
+        rng = np.random.default_rng(7)
+        p = rng.uniform(0.1, 0.9, size=500)
+        labels = (rng.uniform(size=500) < p).astype(float)
+        hl = hosmer_lemeshow(labels, p)
+        imp = feature_importance(np.asarray([1.0, -2.0]))
+        ind = prediction_error_independence(labels, p)
+        doc = build_diagnostic_document(
+            "Diagnostics", hl=hl, importance=[imp], independence=ind,
+            preamble="run xyz")
+        html = render_html(doc)
+        assert "Hosmer-Lemeshow" in html
+        assert "Feature importance" in html
+        assert "independence" in html

@@ -1,0 +1,261 @@
+"""End-to-end driver tests on generated fixtures.
+
+The analog of the reference's acceptance suites:
+- DriverIntegTest (legacy, heart.avro over every task/optimizer combo)
+- cli/game/training/DriverTest + cli/game/scoring/DriverTest
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.feature_indexing_job import main as index_main
+from photon_ml_tpu.cli.game_scoring_driver import main as score_main
+from photon_ml_tpu.cli.game_training_driver import main as game_main
+from photon_ml_tpu.cli.legacy_driver import (
+    LegacyDriver,
+    main as legacy_main,
+    parse_args,
+)
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import write_container
+from photon_ml_tpu.io.model_io import load_scored_items, read_models_text
+
+
+def _make_binary_avro(path, n=300, d=5, seed=0, w=None):
+    """TrainingExampleAvro fixture with a learnable binary signal. Pass the
+    same ``w`` for train and validation splits of one task."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    if w is None:
+        w = np.random.default_rng(999).normal(size=d)
+    p = 1.0 / (1.0 + np.exp(-(X @ w)))
+    y = (rng.uniform(size=n) < p).astype(float)
+    records = []
+    for i in range(n):
+        records.append({
+            "uid": f"r{i}", "label": float(y[i]),
+            "features": [{"name": f"f{j}", "term": "",
+                          "value": float(X[i, j])} for j in range(d)],
+            "metadataMap": None, "weight": None, "offset": None,
+        })
+    write_container(path, schemas.TRAINING_EXAMPLE, records)
+    return X, y
+
+
+GAME_SCHEMA = {
+    "name": "GameRecord", "type": "record", "namespace": "t",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+        {"name": "globalFeatures",
+         "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "userFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+    ],
+}
+
+
+def _make_game_avro(path, n=400, n_users=8, d_g=6, d_u=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w_rng = np.random.default_rng(777)  # same true model across splits
+    w_g = w_rng.normal(size=d_g)
+    W_u = w_rng.normal(size=(n_users, d_u))
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        xg = rng.normal(size=d_g)
+        xu = rng.normal(size=d_u)
+        margin = xg @ w_g + xu @ W_u[u]
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append({
+            "uid": f"s{i}", "response": y, "offset": None, "weight": None,
+            "metadataMap": {"userId": f"user{u}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(xg[j])} for j in range(d_g)],
+            "userFeatures": [{"name": f"u{j}", "term": "",
+                              "value": float(xu[j])} for j in range(d_u)],
+        })
+    write_container(path, GAME_SCHEMA, records)
+
+
+class TestLegacyDriver:
+    def test_logistic_lbfgs_l2_end_to_end(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        _make_binary_avro(train, seed=0)
+        validate = str(tmp_path / "validate.avro")
+        _make_binary_avro(validate, seed=1)
+        out = str(tmp_path / "out")
+        legacy_main([
+            "--training-data-directory", train,
+            "--validating-data-directory", validate,
+            "--output-directory", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "10,1,0.1",
+            "--num-iterations", "40",
+            "--data-validation-type", "VALIDATE_FULL",
+        ])
+        models = read_models_text(os.path.join(out, "output"))
+        assert len(models) == 3
+        metrics = json.loads(open(os.path.join(out, "metrics.json")).read())
+        assert len(metrics) == 3
+        key = "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+        aucs = [m[key] for m in metrics.values() if key in m]
+        assert max(aucs) > 0.75  # learnable signal → decent AUC
+        assert os.path.exists(os.path.join(out, "best"))
+
+    def test_owlqn_l1_and_tron(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        _make_binary_avro(train, n=200, seed=2)
+        for i, (opt, reg) in enumerate([("LBFGS", "L1"), ("TRON", "L2")]):
+            out = str(tmp_path / f"out{i}")
+            legacy_main([
+                "--training-data-directory", train,
+                "--output-directory", out,
+                "--task", "LOGISTIC_REGRESSION",
+                "--optimizer", opt,
+                "--regularization-type", reg,
+                "--regularization-weights", "1",
+                "--num-iterations", "30",
+            ])
+            assert read_models_text(os.path.join(out, "output"))
+
+    def test_tron_l1_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="TRON"):
+            parse_args([
+                "--training-data-directory", "x",
+                "--output-directory", "y",
+                "--optimizer", "TRON",
+                "--regularization-type", "L1",
+            ])
+
+    def test_diagnostics_produced(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_binary_avro(train, n=900, d=3, seed=3)
+        _make_binary_avro(validate, n=200, d=3, seed=4)
+        out = str(tmp_path / "out")
+        legacy_main([
+            "--training-data-directory", train,
+            "--validating-data-directory", validate,
+            "--output-directory", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "1",
+            "--num-iterations", "15",
+            "--diagnostic-mode", "ALL",
+        ])
+        html = open(os.path.join(out, "diagnostic-report.html")).read()
+        assert "Hosmer-Lemeshow" in html
+        assert "Learning curves" in html
+        assert os.path.exists(os.path.join(out, "diagnostic-report.txt"))
+
+    def test_normalization_standardization(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        _make_binary_avro(train, n=250, seed=5)
+        out = str(tmp_path / "out")
+        legacy_main([
+            "--training-data-directory", train,
+            "--output-directory", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "1",
+            "--normalization-type", "STANDARDIZATION",
+            "--num-iterations", "30",
+            "--summarization-output-dir", str(tmp_path / "summary"),
+        ])
+        assert read_models_text(os.path.join(out, "output"))
+        assert os.path.exists(
+            str(tmp_path / "summary" / "part-00000.avro"))
+
+
+class TestGameDrivers:
+    def test_game_train_then_score(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game_avro(train, seed=0)
+        _make_game_avro(validate, n=150, seed=1)
+        out = str(tmp_path / "game-out")
+        game_main([
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:30,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations",
+            "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:30,1e-7,1.0,1,LBFGS,L2",
+            "--evaluator-type", "AUC",
+        ])
+        best_dir = os.path.join(out, "best")
+        assert os.path.isdir(os.path.join(best_dir, "fixed-effect", "fixed"))
+        assert os.path.isdir(
+            os.path.join(best_dir, "random-effect", "perUser"))
+
+        score_out = str(tmp_path / "score-out")
+        score_main([
+            "--input-data-dirs", validate,
+            "--game-model-input-dir", best_dir,
+            "--output-dir", score_out,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--random-effect-id-set", "userId",
+            "--evaluator-type", "AUC",
+        ])
+        scores = load_scored_items(
+            os.path.join(score_out, "scores", "part-00000.avro"))
+        assert len(scores) == 150
+        assert all(np.isfinite(r["predictionScore"]) for r in scores)
+
+    def test_game_grid_selects_best(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game_avro(train, n=250, seed=2)
+        _make_game_avro(validate, n=120, seed=3)
+        out = str(tmp_path / "out")
+        game_main([
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures",
+            "--updating-sequence", "fixed",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:20,1e-7,10,1,LBFGS,L2;fixed:20,1e-7,0.01,1,LBFGS,L2",
+            "--evaluator-type", "AUC",
+            "--model-output-mode", "ALL",
+        ])
+        # grid of 2 → two saved grid models + best
+        assert os.path.isdir(os.path.join(out, "output", "grid-0"))
+        assert os.path.isdir(os.path.join(out, "output", "grid-1"))
+        assert os.path.isdir(os.path.join(out, "best"))
+
+
+class TestFeatureIndexingCli:
+    def test_game_mode(self, tmp_path, capsys):
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=50, seed=4)
+        index_main([
+            "--input-paths", train,
+            "--output-dir", str(tmp_path / "index"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--num-partitions", "2",
+        ])
+        outp = capsys.readouterr().out
+        assert "global:" in outp and "user:" in outp

@@ -1,0 +1,91 @@
+"""Tests for logging/timer, events, and checkpointing utilities."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.utils.checkpoint import CheckpointManager
+from photon_ml_tpu.utils.events import (
+    EventEmitter,
+    PhotonOptimizationLogEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.utils.logging import LogLevel, PhotonLogger, Timer, timed_phase
+
+
+def test_logger_levels_and_file(tmp_path):
+    path = str(tmp_path / "run.log")
+    log = PhotonLogger(path, level=LogLevel.INFO, echo=False)
+    log.debug("hidden")
+    log.info("shown")
+    log.warn("warned")
+    log.close()
+    text = open(path).read()
+    assert "hidden" not in text
+    assert "shown" in text and "warned" in text
+
+
+def test_timer_and_timed_phase(tmp_path):
+    t = Timer().start()
+    time.sleep(0.01)
+    t.stop()
+    assert t.duration_seconds >= 0.01
+    log = PhotonLogger(str(tmp_path / "t.log"), echo=False)
+    with timed_phase("phase-x", log):
+        time.sleep(0.01)
+    log.close()
+    assert "phase-x took" in open(str(tmp_path / "t.log")).read()
+
+
+def test_event_emitter_dispatch():
+    emitter = EventEmitter()
+    seen = []
+    emitter.register_listener(seen.append)
+    emitter.send_event(TrainingStartEvent(timestamp=1.0))
+    emitter.send_event(PhotonOptimizationLogEvent(
+        regularization_weight=0.5, states=None, metrics={"AUC": 0.9}))
+    assert len(seen) == 2
+    assert seen[1].metrics == {"AUC": 0.9}
+
+
+def test_event_listener_by_name():
+    emitter = EventEmitter()
+    emitter.register_listener_by_name("builtins.print")  # callable listener
+    emitter.send_event(TrainingStartEvent(timestamp=0.0))  # must not raise
+    with pytest.raises(ValueError):
+        emitter.register_listener_by_name("unqualified")
+
+
+def test_checkpoint_round_trip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    state = {
+        "iteration": 3,
+        "lambda_index": 1,
+        "coordinates": {
+            "fixed": np.arange(5, dtype=np.float32),
+            "per-user": np.ones((4, 3)),
+        },
+        "history": [1.0, 0.5, 0.25],
+        "meta": ("run", True, None),
+    }
+    mgr.save(0, {"iteration": 0})
+    mgr.save(3, state)
+    assert mgr.latest_step() == 3
+    restored = mgr.restore()
+    assert restored["iteration"] == 3
+    assert restored["meta"] == ("run", True, None)
+    np.testing.assert_array_equal(restored["coordinates"]["fixed"],
+                                  state["coordinates"]["fixed"])
+    np.testing.assert_array_equal(restored["coordinates"]["per-user"],
+                                  state["coordinates"]["per-user"])
+    assert restored["history"] == [1.0, 0.5, 0.25]
+
+
+def test_checkpoint_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    for s in range(5):
+        mgr.save(s, {"step": s})
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.restore(4)["step"] == 4

@@ -1,0 +1,71 @@
+"""DataValidators tests (mirrors reference test/.../data/DataValidatorsTest)."""
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.validators import (
+    DataValidationType,
+    sanity_check_data,
+)
+from photon_ml_tpu.optimize.config import TaskType
+
+
+def _clean(n=10, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = sp.csr_matrix(rng.normal(size=(n, d)))
+    labels = rng.integers(0, 2, size=n).astype(float)
+    offsets = np.zeros(n)
+    return labels, offsets, X
+
+
+def test_clean_data_passes_all_tasks():
+    labels, offsets, X = _clean()
+    for task in TaskType:
+        assert sanity_check_data(labels, offsets, X, task)
+
+
+def test_nan_feature_fails():
+    labels, offsets, X = _clean()
+    X = X.tolil()
+    X[3, 1] = np.nan
+    msgs = []
+    assert not sanity_check_data(labels, offsets, X.tocsr(),
+                                 TaskType.LINEAR_REGRESSION,
+                                 logger=msgs.append)
+    assert any("Finite features" in m and "3" in m for m in msgs)
+
+
+def test_binary_label_check():
+    labels, offsets, X = _clean()
+    labels[2] = 0.5
+    assert not sanity_check_data(labels, offsets, X,
+                                 TaskType.LOGISTIC_REGRESSION)
+    # but fine for linear regression
+    assert sanity_check_data(labels, offsets, X, TaskType.LINEAR_REGRESSION)
+
+
+def test_poisson_rejects_negative_labels():
+    labels, offsets, X = _clean()
+    labels[0] = -1.0
+    assert not sanity_check_data(labels, offsets, X,
+                                 TaskType.POISSON_REGRESSION)
+
+
+def test_infinite_offset_fails():
+    labels, offsets, X = _clean()
+    offsets[1] = np.inf
+    assert not sanity_check_data(labels, offsets, X,
+                                 TaskType.LOGISTIC_REGRESSION)
+
+
+def test_disabled_passes_bad_data():
+    labels, offsets, X = _clean()
+    labels[:] = np.nan
+    assert sanity_check_data(labels, offsets, X, TaskType.LINEAR_REGRESSION,
+                             DataValidationType.VALIDATE_DISABLED)
+
+
+def test_sample_mode_runs():
+    labels, offsets, X = _clean(n=500)
+    assert sanity_check_data(labels, offsets, X, TaskType.LINEAR_REGRESSION,
+                             DataValidationType.VALIDATE_SAMPLE)
