@@ -24,11 +24,34 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.data.batch import Batch, DenseBatch
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 
 Array = jnp.ndarray
+
+
+def _pallas_sums(loss, w_eff, margin_shift, batch,
+                 axis_name: Optional[str]):
+    """Single-pass fused (value, vector_sum, prefactor_sum) when profitable:
+    dense f32 batch, real size, TPU backend (ops/pallas_kernels.py). Returns
+    None when the two-pass XLA form should be used instead."""
+    if not isinstance(batch, DenseBatch) or batch.X.ndim != 2:
+        return None
+    from photon_ml_tpu.ops.pallas_kernels import (
+        fused_value_gradient_sums,
+        pallas_supported,
+    )
+
+    n, d = batch.X.shape
+    # axis_name set => the caller runs us under shard_map (manual
+    # partitioning, per-shard shapes): safe on any device count.
+    if not pallas_supported(n, d, batch.X.dtype,
+                            inside_shard_map=axis_name is not None):
+        return None
+    return fused_value_gradient_sums(
+        loss, False, batch.X, batch.labels, batch.offsets, batch.weights,
+        w_eff, margin_shift)
 
 
 def _maybe_psum(x, axis_name: Optional[str]):
@@ -53,12 +76,16 @@ def value_and_gradient(
       grad_j       = factors_j (vectorSum_j - shifts_j prefactorSum)
     """
     w_eff, margin_shift = norm.effective_coefficients(coef)
-    z = batch.margins(w_eff, margin_shift)
-    l, d1 = loss.loss_and_d1(z, batch.labels)
-    value = jnp.sum(batch.weights * l)
-    r = batch.weights * d1
-    vector_sum = batch.weighted_feature_sum(r)
-    prefactor_sum = jnp.sum(r)
+    sums = _pallas_sums(loss, w_eff, margin_shift, batch, axis_name)
+    if sums is not None:
+        value, vector_sum, prefactor_sum = sums
+    else:
+        z = batch.margins(w_eff, margin_shift)
+        l, d1 = loss.loss_and_d1(z, batch.labels)
+        value = jnp.sum(batch.weights * l)
+        r = batch.weights * d1
+        vector_sum = batch.weighted_feature_sum(r)
+        prefactor_sum = jnp.sum(r)
     value = _maybe_psum(value, axis_name)
     vector_sum = _maybe_psum(vector_sum, axis_name)
     prefactor_sum = _maybe_psum(prefactor_sum, axis_name)
