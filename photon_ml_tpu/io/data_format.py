@@ -187,15 +187,27 @@ def load_libsvm(path: str, feature_dimension: int,
                 delim: str = " ", idx_value_delim: str = ":") -> LabeledData:
     """LibSVM text → LabeledData. Labels are binarized (>0 → 1) like the
     reference; the intercept occupies the LAST column when enabled
-    (IdentityIndexMapLoader semantics)."""
+    (IdentityIndexMapLoader semantics).
+
+    Parsing dispatches to the native C++ parser (io/native_loader.py,
+    mmap + multithreaded) when available and custom delimiters aren't
+    requested; the Python row loop below is the fallback and the semantic
+    reference."""
     true_dim = feature_dimension + 1 if use_intercept else feature_dimension
-    labels_list: list[float] = []
-    rows, cols, vals = [], [], []
     # Skip hidden/underscore-prefixed files (_SUCCESS, .crc checksums) the
     # way the avro directory reader filters to *.avro.
     paths = ([os.path.join(path, p) for p in sorted(os.listdir(path))
               if not p.startswith((".", "_"))]
              if os.path.isdir(path) else [path])
+
+    if delim == " " and idx_value_delim == ":":
+        native = _load_libsvm_native(paths, feature_dimension,
+                                     use_intercept, zero_based)
+        if native is not None:
+            return native
+
+    labels_list: list[float] = []
+    rows, cols, vals = [], [], []
     i = 0
     for p in paths:
         with open(p) as fh:
@@ -230,16 +242,55 @@ def load_libsvm(path: str, feature_dimension: int,
         (np.asarray(vals), (np.asarray(rows, np.int64),
                             np.asarray(cols, np.int64))),
         shape=(n, true_dim))
+    return _libsvm_labeled_data(features, np.asarray(labels_list),
+                                feature_dimension, use_intercept)
+
+
+def _libsvm_labeled_data(features: sp.csr_matrix, labels: np.ndarray,
+                         feature_dimension: int,
+                         use_intercept: bool) -> LabeledData:
+    """LabeledData with the IdentityIndexMapLoader map (intercept LAST when
+    enabled) — shared by the Python and native parse paths."""
     if use_intercept:
-        # Identity map with the intercept in the LAST column
-        # (IdentityIndexMapLoader semantics, util/IdentityIndexMapLoader).
         keys = {str(i): i for i in range(feature_dimension)}
         keys[INTERCEPT_KEY] = feature_dimension
         index_map = IndexMap(keys)
     else:
-        index_map = IndexMap.identity(true_dim)
-    return LabeledData(features, np.asarray(labels_list), np.zeros(n),
-                       np.ones(n), index_map)
+        index_map = IndexMap.identity(feature_dimension)
+    n = features.shape[0]
+    return LabeledData(features, labels, np.zeros(n), np.ones(n), index_map)
+
+
+def _load_libsvm_native(paths, feature_dimension: int, use_intercept: bool,
+                        zero_based: bool) -> Optional[LabeledData]:
+    """Native-parser path of :func:`load_libsvm`; None → use Python loop."""
+    from photon_ml_tpu.io.native_loader import parse_libsvm_native
+
+    if not paths:
+        return None  # empty-directory case: Python loop builds 0-row data
+    parts = []
+    for p in paths:
+        out = parse_libsvm_native(p, zero_based)
+        if out is None:
+            return None
+        parts.append(out)
+    mats, labels_all = [], []
+    for raw_labels, mat, dim in parts:
+        if dim > feature_dimension:
+            raise ValueError(
+                f"feature index {dim - 1 + (0 if zero_based else 1)} out of "
+                f"range for feature_dimension={feature_dimension} "
+                f"(zero_based={zero_based})")
+        n = mat.shape[0]
+        mat = sp.csr_matrix((mat.data, mat.indices, mat.indptr),
+                            shape=(n, feature_dimension))
+        if use_intercept:
+            mat = sp.hstack([mat, np.ones((n, 1))], format="csr")
+        mats.append(mat)
+        labels_all.append((raw_labels > 0).astype(np.float64))
+    features = sp.vstack(mats, format="csr") if len(mats) > 1 else mats[0]
+    return _libsvm_labeled_data(features, np.concatenate(labels_all),
+                                feature_dimension, use_intercept)
 
 
 # ---------------------------------------------------------------------------

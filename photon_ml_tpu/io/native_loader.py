@@ -1,0 +1,121 @@
+"""ctypes bridge to the native (C++) host-side parsers.
+
+The TPU compute path is JAX/XLA; ingestion is host work, so the framework
+ships a native parser (native/libsvm_parser.cpp) for the LibSVM hot path —
+mmap + multithreaded two-phase CSR build. This module compiles the shared
+library on first use (plain ``g++``, cached under native/build/) and falls
+back to the pure-Python parser when no toolchain is available.
+
+``load_libsvm`` in io/data_format.py dispatches here automatically for
+single files; directory inputs concatenate per-file results.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libphoton_native.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "libsvm_parser.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compile() -> bool:
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-fPIC", "-Wall",
+           "-pthread", "-shared", "-o", _LIB_PATH, _SRC_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_native_lib() -> Optional[ctypes.CDLL]:
+    """The loaded shared library, building it on first use; None when
+    unavailable (no source, no compiler, or disabled via
+    PHOTON_DISABLE_NATIVE)."""
+    global _lib, _build_failed
+    if os.environ.get("PHOTON_DISABLE_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC_PATH)
+                and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)):
+            if not os.path.exists(_SRC_PATH) or not _compile():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.photon_libsvm_open.restype = ctypes.c_void_p
+        lib.photon_libsvm_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.photon_libsvm_fill.restype = ctypes.c_int
+        lib.photon_libsvm_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.photon_libsvm_close.restype = None
+        lib.photon_libsvm_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def parse_libsvm_native(path: str, zero_based: bool
+                        ) -> Optional[tuple[np.ndarray, sp.csr_matrix, int]]:
+    """(raw_labels, csr WITHOUT intercept column, max_index+1) or None when
+    the native library is unavailable or parsing fails."""
+    lib = get_native_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    nnz = ctypes.c_int64()
+    handle = lib.photon_libsvm_open(path.encode(), ctypes.byref(rows),
+                                    ctypes.byref(nnz))
+    if not handle:
+        return None
+    try:
+        n, k = rows.value, nnz.value
+        labels = np.empty(n, np.float64)
+        indptr = np.empty(n + 1, np.int64)
+        indices = np.empty(max(k, 1), np.int32)
+        values = np.empty(max(k, 1), np.float64)
+        max_index = ctypes.c_int64()
+        rc = lib.photon_libsvm_fill(handle, int(zero_based), labels, indptr,
+                                    indices, values,
+                                    ctypes.byref(max_index))
+    finally:
+        lib.photon_libsvm_close(handle)
+    if rc != 0:
+        raise ValueError(
+            f"native libsvm parse of {path!r} failed with code {rc}")
+    dim = int(max_index.value) + 1
+    mat = sp.csr_matrix((values[:k], indices[:k], indptr),
+                        shape=(n, max(dim, 0)))
+    return labels, mat, dim

@@ -1,0 +1,107 @@
+"""Native C++ LibSVM parser vs the Python reference loop."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.data_format import load_libsvm
+from photon_ml_tpu.io.native_loader import get_native_lib
+
+
+requires_native = pytest.mark.skipif(
+    get_native_lib() is None, reason="native toolchain unavailable")
+
+
+def _write(path, lines):
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+@requires_native
+def test_native_matches_python(tmp_path):
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(500):
+        idxs = sorted(rng.choice(np.arange(1, 51), 8, replace=False))
+        feats = " ".join(f"{j}:{rng.normal():.4f}" for j in idxs)
+        lines.append(f"{'+1' if rng.uniform() < 0.5 else '-1'} {feats}")
+    lines.insert(3, "")            # blank line
+    lines.insert(7, " +1 5:0.25")  # leading space
+    p = str(tmp_path / "data.libsvm")
+    _write(p, lines)
+
+    nat = load_libsvm(p, feature_dimension=50)
+    os.environ["PHOTON_DISABLE_NATIVE"] = "1"
+    try:
+        py = load_libsvm(p, feature_dimension=50)
+    finally:
+        del os.environ["PHOTON_DISABLE_NATIVE"]
+    np.testing.assert_allclose(nat.labels, py.labels)
+    np.testing.assert_allclose(nat.features.toarray(), py.features.toarray())
+    assert nat.index_map.intercept_index == py.index_map.intercept_index
+
+
+@requires_native
+def test_native_out_of_range_raises(tmp_path):
+    p = str(tmp_path / "bad.libsvm")
+    _write(p, ["+1 9:1.0"])
+    with pytest.raises(ValueError, match="out of range"):
+        load_libsvm(p, feature_dimension=5)
+
+
+@requires_native
+def test_native_directory_and_no_intercept(tmp_path):
+    d = tmp_path / "dir"
+    d.mkdir()
+    _write(str(d / "part-00000"), ["+1 1:1.0", "-1 2:2.0"])
+    _write(str(d / "part-00001"), ["+1 3:3.0"])
+    (d / "_SUCCESS").write_text("")
+    data = load_libsvm(str(d), feature_dimension=3, use_intercept=False)
+    assert data.features.shape == (3, 3)
+    np.testing.assert_allclose(
+        data.features.toarray(),
+        [[1.0, 0, 0], [0, 2.0, 0], [0, 0, 3.0]])
+
+
+@requires_native
+def test_native_zero_based(tmp_path):
+    p = str(tmp_path / "zb.libsvm")
+    _write(p, ["+1 0:1.5 2:2.5"])
+    data = load_libsvm(p, feature_dimension=3, zero_based=True,
+                       use_intercept=False)
+    np.testing.assert_allclose(data.features.toarray(), [[1.5, 0.0, 2.5]])
+
+
+@requires_native
+def test_native_malformed_inputs_error_not_corrupt(tmp_path):
+    """Code-review regressions: label containing ':', token without ':',
+    token with two ':', and \\v bytes must error (or parse) cleanly — never
+    hang or write out of bounds."""
+    cases = {
+        "label_colon.libsvm": "1:2 3:4",      # label token must be a number
+        "no_colon.libsvm": "+1 abc",          # feature without ':'
+        "two_colons.libsvm": "+1 1:2:3",      # trailing junk after value
+    }
+    for name, line in cases.items():
+        p = str(tmp_path / name)
+        _write(p, [line])
+        with pytest.raises(ValueError, match="native libsvm parse"):
+            load_libsvm(p, feature_dimension=10)
+
+
+@requires_native
+def test_native_vertical_tab_no_hang(tmp_path):
+    p = str(tmp_path / "vtab.libsvm")
+    _write(p, ["1 2:3\v"])
+    data = load_libsvm(p, feature_dimension=3, use_intercept=False)
+    np.testing.assert_allclose(data.features.toarray(), [[0.0, 3.0, 0.0]])
+
+
+@requires_native
+def test_native_empty_directory_falls_back(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    (d / "_SUCCESS").write_text("")
+    data = load_libsvm(str(d), feature_dimension=3)
+    assert data.num_samples == 0
