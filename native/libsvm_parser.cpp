@@ -81,11 +81,19 @@ inline const char* token_end(const char* p, const char* end) {
     return p;
 }
 
-// Split [0, size) into per-thread ranges aligned to line starts.
-std::vector<std::pair<size_t, size_t>> chunk_lines(const char* data,
-                                                   size_t size,
-                                                   unsigned threads) {
-    std::vector<std::pair<size_t, size_t>> out;
+// A chunk is a [begin, end) byte range; normally into the mmap, but the
+// final unterminated line (if any) lives in a NUL-terminated copy — the
+// libc number parsers are unbounded, and an mmap whose size is an exact
+// page multiple has no readable byte past the end.
+struct Chunk {
+    const char* begin;
+    const char* end;
+};
+
+// Split [0, newline_region) into per-thread ranges aligned to line starts.
+std::vector<Chunk> chunk_lines(const char* data, size_t size,
+                               unsigned threads) {
+    std::vector<Chunk> out;
     if (size == 0) return out;
     size_t per = size / threads;
     size_t start = 0;
@@ -93,7 +101,7 @@ std::vector<std::pair<size_t, size_t>> chunk_lines(const char* data,
         size_t end = (t + 1 == threads) ? size
                                         : std::min(size, start + per);
         while (end < size && data[end - 1] != '\n') ++end;
-        out.emplace_back(start, end);
+        out.push_back(Chunk{data + start, data + end});
         start = end;
     }
     return out;
@@ -107,10 +115,9 @@ struct LineStats {
 // Count rows and feature tokens in one chunk (phase 1). Counts EVERY
 // post-label token as a potential feature — the fill pass errors out on
 // malformed tokens, so over-counting only ever over-allocates.
-void count_chunk(const char* data, size_t begin, size_t end_pos,
-                 LineStats* stats) {
-    const char* p = data + begin;
-    const char* end = data + end_pos;
+void count_chunk(Chunk chunk, LineStats* stats) {
+    const char* p = chunk.begin;
+    const char* end = chunk.end;
     while (p < end) {
         const char* line_end = static_cast<const char*>(
             memchr(p, '\n', static_cast<size_t>(end - p)));
@@ -132,7 +139,8 @@ void count_chunk(const char* data, size_t begin, size_t end_pos,
 
 struct ParserState {
     Mapped m;
-    std::vector<std::pair<size_t, size_t>> chunks;
+    std::string tail;  // final line without trailing newline, NUL-safe copy
+    std::vector<Chunk> chunks;
     std::vector<LineStats> stats;
     int64_t rows = 0;
     int64_t nnz = 0;
@@ -153,9 +161,8 @@ struct FillCtx {
 };
 
 void fill_chunk(FillCtx* ctx) {
-    const char* data = ctx->st->m.data;
-    const char* p = data + ctx->st->chunks[ctx->chunk].first;
-    const char* end = data + ctx->st->chunks[ctx->chunk].second;
+    const char* p = ctx->st->chunks[ctx->chunk].begin;
+    const char* end = ctx->st->chunks[ctx->chunk].end;
     int64_t row = ctx->row_offset;
     int64_t k = ctx->nnz_offset;
     while (p < end) {
@@ -183,6 +190,7 @@ void fill_chunk(FillCtx* ctx) {
                 const char* colon = static_cast<const char*>(
                     memchr(tok, ':', static_cast<size_t>(tok_e - tok)));
                 if (!colon) { ctx->error = -7; return; }  // "abc"
+                if (colon == tok) { ctx->error = -3; return; }  // ":5"
                 long idx = strtol(tok, &after, 10);
                 if (after != colon) { ctx->error = -3; return; }
                 if (!ctx->zero_based) --idx;
@@ -221,13 +229,20 @@ void* photon_libsvm_open(const char* path, int64_t* out_rows,
                          int64_t* out_nnz) {
     auto* st = new ParserState();
     if (!st->m.open_file(path)) { delete st; return nullptr; }
-    unsigned threads = n_threads(st->m.size);
-    st->chunks = chunk_lines(st->m.data, st->m.size, threads);
+    // Carve off the final unterminated line into a NUL-terminated copy.
+    size_t region = st->m.size;
+    while (region > 0 && st->m.data[region - 1] != '\n') --region;
+    if (region < st->m.size)
+        st->tail.assign(st->m.data + region, st->m.size - region);
+    unsigned threads = n_threads(region);
+    st->chunks = chunk_lines(st->m.data, region, threads);
+    if (!st->tail.empty())
+        st->chunks.push_back(Chunk{st->tail.data(),
+                                   st->tail.data() + st->tail.size()});
     st->stats.resize(st->chunks.size());
     std::vector<std::thread> pool;
     for (size_t i = 0; i < st->chunks.size(); ++i)
-        pool.emplace_back(count_chunk, st->m.data, st->chunks[i].first,
-                          st->chunks[i].second, &st->stats[i]);
+        pool.emplace_back(count_chunk, st->chunks[i], &st->stats[i]);
     for (auto& t : pool) t.join();
     for (auto& s : st->stats) { st->rows += s.rows; st->nnz += s.nnz; }
     *out_rows = st->rows;

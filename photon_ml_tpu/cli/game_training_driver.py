@@ -136,7 +136,14 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p = argparse.ArgumentParser(prog="game-training",
                                 description="GAME training on TPU")
     p.add_argument("--train-input-dirs", required=True)
+    p.add_argument("--train-date-range",
+                   help="yyyyMMdd-yyyyMMdd over <dir>/daily/yyyy/MM/dd")
+    p.add_argument("--train-date-range-days-ago",
+                   help="start-end days-ago pair (alternative to "
+                        "--train-date-range)")
     p.add_argument("--validate-input-dirs")
+    p.add_argument("--validate-date-range")
+    p.add_argument("--validate-date-range-days-ago")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--task-type", required=True,
                    choices=[t.name for t in TaskType])
@@ -161,6 +168,10 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--compute-variance", default="false")
     p.add_argument("--delete-output-dir-if-exists", default="false")
     p.add_argument("--application-name", default="game-training")
+    p.add_argument("--checkpoint-dir",
+                   help="snapshot coordinate states after each CD sweep "
+                        "and auto-resume from the latest snapshot "
+                        "(single-grid-point runs only)")
     return p.parse_args(argv)
 
 
@@ -214,8 +225,13 @@ class GameTrainingDriver:
                 self.ns.feature_name_and_term_set_path, all_sections)
         else:
             from photon_ml_tpu.io.avro import read_records
+            from photon_ml_tpu.utils.date_range import resolve_input_paths
+
+            paths = resolve_input_paths(
+                self.ns.train_input_dirs, self.ns.train_date_range,
+                self.ns.train_date_range_days_ago)
             sets = NameAndTermFeatureSets.from_records(
-                read_records(self.ns.train_input_dirs), all_sections)
+                [r for p in paths for r in read_records(p)], all_sections)
         for shard, sections in self.section_keys.items():
             self.index_maps[shard] = sets.index_map(
                 sections, add_intercept=self.intercept_map.get(shard, True))
@@ -230,14 +246,23 @@ class GameTrainingDriver:
         return sorted(id_types)
 
     def prepare_game_dataset(self) -> None:
+        from photon_ml_tpu.utils.date_range import resolve_input_paths
+
+        train_paths = resolve_input_paths(
+            self.ns.train_input_dirs, self.ns.train_date_range,
+            self.ns.train_date_range_days_ago)
         self.train_data = load_game_dataset_avro(
-            self.ns.train_input_dirs, self.section_keys, self.index_maps,
+            train_paths, self.section_keys, self.index_maps,
             id_types=self._id_types(), response_required=True)
         self.logger.info(
-            f"train dataset: {self.train_data.num_samples} samples")
+            f"train dataset: {self.train_data.num_samples} samples "
+            f"from {len(train_paths)} path(s)")
         if self.ns.validate_input_dirs:
+            validate_paths = resolve_input_paths(
+                self.ns.validate_input_dirs, self.ns.validate_date_range,
+                self.ns.validate_date_range_days_ago)
             self.validate_data = load_game_dataset_avro(
-                self.ns.validate_input_dirs, self.section_keys,
+                validate_paths, self.section_keys,
                 self.index_maps, id_types=self._id_types(),
                 response_required=True)
 
@@ -317,6 +342,36 @@ class GameTrainingDriver:
         results = []
         combos = list(itertools.product(
             self.fixed_opt_grid, self.random_opt_grid, self.factored_grid))
+        ckpt_mgr = None
+        initial_states = None
+        initial_best = None
+        start_iteration = 0
+        if self.ns.checkpoint_dir:
+            from photon_ml_tpu.utils.checkpoint import CheckpointManager
+
+            if len(combos) > 1:
+                raise ValueError(
+                    "--checkpoint-dir supports single-grid-point runs only "
+                    f"(got {len(combos)} grid combinations)")
+            ckpt_mgr = CheckpointManager(self.ns.checkpoint_dir)
+            latest = ckpt_mgr.latest_step()
+            if latest is not None:
+                snap = ckpt_mgr.restore(latest)
+
+                def _jnp_states(d):
+                    return {cid: (tuple(jnp.asarray(s) for s in v)
+                                  if isinstance(v, tuple)
+                                  else jnp.asarray(v))
+                            for cid, v in d.items()}
+
+                initial_states = _jnp_states(snap["states"])
+                start_iteration = int(snap["iteration"])
+                if snap.get("best_states") is not None:
+                    initial_best = (snap.get("best_metric"),
+                                    _jnp_states(snap["best_states"]))
+                self.logger.info(
+                    f"resuming from checkpoint at iteration "
+                    f"{start_iteration}")
         for gi, (f_cfgs, r_cfgs, fac_cfgs) in enumerate(combos):
             desc = (f"grid[{gi}]: fixed={ {k: v.render() for k, v in f_cfgs.items()} } "
                     f"random={ {k: v.render() for k, v in r_cfgs.items()} }")
@@ -334,16 +389,23 @@ class GameTrainingDriver:
                                        else None),
                     higher_is_better=(first_spec.better_than(1.0, 0.0)
                                       if first_spec else True),
-                    logger=self.logger)
+                    initial_states=initial_states,
+                    logger=self.logger,
+                    checkpoint_manager=ckpt_mgr,
+                    start_iteration=start_iteration,
+                    initial_best=initial_best)
             results.append((desc, result))
             metric = result.best_metric
             if metric is not None:
                 if best is None or (first_spec.better_than(metric, best[0])):
                     best = (metric, result, desc)
         if best is None and results:
-            # no validation: lowest training objective wins
+            # no validation: lowest training objective wins; a run resumed
+            # past its last iteration has no new states — treat as neutral
             best_result = min(
-                results, key=lambda dr: dr[1].states[-1].objective)
+                results,
+                key=lambda dr: (dr[1].states[-1].objective
+                                if dr[1].states else float("inf")))
             best = (None, best_result[1], best_result[0])
         return best, results
 

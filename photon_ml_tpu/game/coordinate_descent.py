@@ -79,6 +79,9 @@ def run_coordinate_descent(
     higher_is_better: bool = True,
     initial_states: Optional[dict] = None,
     logger: Optional[Callable[[str], None]] = None,
+    checkpoint_manager=None,
+    start_iteration: int = 0,
+    initial_best: Optional[tuple] = None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent over ``coordinates`` in dict order.
 
@@ -99,17 +102,30 @@ def run_coordinate_descent(
 
     # Init: zero states, zero scores (CoordinateDescent.scala:93-101).
     states = dict(initial_states or {})
+    resumed = set(states)
     for cid in ids:
         if cid not in states:
             states[cid] = coordinates[cid].initial_state()
-    scores = {cid: jnp.zeros(num_samples) for cid in ids}
+    # Restored coordinates must contribute their scores from the start —
+    # zeros would make the first resumed sweep optimize against offsets
+    # that pretend the other coordinates' models don't exist.
+    scores = {cid: (coordinates[cid].score(states[cid])
+                    if cid in resumed else jnp.zeros(num_samples))
+              for cid in ids}
     total = jnp.zeros(num_samples)
+    for cid in ids:
+        total = total + scores[cid]
 
     history: list[CoordinateDescentState] = []
     best_model = None
     best_metric = None
+    best_states = None
+    if initial_best is not None:
+        best_metric, restored_states = initial_best
+        best_states = dict(restored_states)
+        best_model = publish_game_model(coordinates, best_states)
 
-    for it in range(num_iterations):
+    for it in range(start_iteration, num_iterations):
         for cid in ids:
             t0 = time.time()
             coord = coordinates[cid]
@@ -139,10 +155,28 @@ def run_coordinate_descent(
                                   else m < best_metric))
                     if better:  # (:245-255)
                         best_metric, best_model = m, model
+                        best_states = dict(states)
 
             history.append(CoordinateDescentState(
                 iteration=it, coordinate_id=cid, objective=objective,
                 seconds=dt, tracker=tracker, validation_metrics=metrics))
+
+        if checkpoint_manager is not None:
+            def _np_states(d):
+                return {
+                    cid: (tuple(np.asarray(s) for s in d[cid])
+                          if isinstance(d[cid], tuple)
+                          else np.asarray(d[cid]))
+                    for cid in d}
+
+            checkpoint_manager.save(it + 1, {
+                "iteration": it + 1,
+                "states": _np_states(states),
+                "best_metric": (None if best_metric is None
+                                else float(best_metric)),
+                "best_states": (None if best_states is None
+                                else _np_states(best_states)),
+            })
 
     final = publish_game_model(coordinates, states)
     return CoordinateDescentResult(model=final, states=history,

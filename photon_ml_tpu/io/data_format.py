@@ -215,7 +215,11 @@ def load_libsvm(path: str, feature_dimension: int,
                 line = line.strip()
                 if not line:
                     continue
-                ts = line.split(delim)
+                # Default delimiter = ANY run of whitespace, matching the
+                # native parser exactly (tab-separated files parse the same
+                # whether or not a compiler is present); custom delimiters
+                # keep literal splitting.
+                ts = line.split() if delim == " " else line.split(delim)
                 label = float(ts[0])
                 labels_list.append(1.0 if label > 0 else 0.0)
                 for item in ts[1:]:
@@ -369,7 +373,7 @@ def _id_from_record(rec: dict, id_type: str) -> str:
 
 
 def load_game_dataset_avro(
-        path: str,
+        path: str | Sequence[str],
         feature_shard_sections: dict[str, Sequence[str]],
         index_maps: dict[str, IndexMap],
         id_types: Sequence[str] = (),
@@ -377,8 +381,14 @@ def load_game_dataset_avro(
     """Avro records → columnar :class:`GameDataset`: one CSR per feature
     shard (union of that shard's sections, intercept appended when the
     shard's index map has the intercept key), response/offset/weight
-    columns, dictionary-encoded id columns, uids kept when present."""
-    records = _read_records(path)
+    columns, dictionary-encoded id columns, uids kept when present.
+
+    ``path`` may be a single file/directory or a list of them (the dated
+    daily-partition layout resolves to several directories)."""
+    if isinstance(path, str):
+        records = _read_records(path)
+    else:
+        records = [r for p in path for r in _read_records(p)]
     n = len(records)
     responses = np.full(n, np.nan)
     offsets = np.zeros(n)

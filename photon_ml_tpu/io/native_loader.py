@@ -33,11 +33,10 @@ _build_failed = False
 
 
 def _compile() -> bool:
-    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-fPIC", "-Wall",
-           "-pthread", "-shared", "-o", _LIB_PATH, _SRC_PATH]
+    # One build definition: the Makefile (native/Makefile) owns the flags.
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
         return True
     except Exception:
         return False

@@ -1,0 +1,61 @@
+"""DateRange parsing + dated input-path resolution (util/DateRange analog)."""
+
+import datetime
+import os
+
+import pytest
+
+from photon_ml_tpu.utils.date_range import (
+    DateRange,
+    input_paths_within_date_range,
+    resolve_input_paths,
+)
+
+
+def test_parse_range():
+    r = DateRange.from_range("20260101-20260103")
+    assert r.start == datetime.date(2026, 1, 1)
+    assert r.end == datetime.date(2026, 1, 3)
+    assert len(r.days()) == 3
+    assert str(r) == "2026-01-01-2026-01-03"
+
+
+def test_invalid_range_rejected():
+    with pytest.raises(ValueError, match="start date"):
+        DateRange.from_range("20260105-20260101")
+    with pytest.raises(ValueError, match="Couldn't parse"):
+        DateRange.from_range("garbage")
+
+
+def test_days_ago():
+    today = datetime.date(2026, 7, 29)
+    r = DateRange.from_days_ago_range("3-1", today)
+    assert r.start == datetime.date(2026, 7, 26)
+    assert r.end == datetime.date(2026, 7, 28)
+
+
+def test_input_paths_daily_layout(tmp_path):
+    base = tmp_path / "data"
+    for d in ("2026/01/01", "2026/01/02", "2026/01/04"):
+        (base / "daily" / d).mkdir(parents=True)
+    r = DateRange.from_range("20260101-20260104")
+    paths = input_paths_within_date_range([str(base)], r)
+    assert len(paths) == 3  # Jan 3 missing, silently skipped
+    with pytest.raises(FileNotFoundError):
+        input_paths_within_date_range([str(base)], r, error_on_missing=True)
+    with pytest.raises(FileNotFoundError, match="No data folder"):
+        input_paths_within_date_range(
+            [str(base)], DateRange.from_range("20270101-20270102"))
+
+
+def test_resolve_input_paths(tmp_path):
+    base = tmp_path / "d"
+    (base / "daily" / "2026" / "01" / "01").mkdir(parents=True)
+    # no range: dirs pass through
+    assert resolve_input_paths(str(base)) == [str(base)]
+    # with range: daily paths
+    out = resolve_input_paths(str(base), date_range="20260101-20260101")
+    assert out == [str(base / "daily" / "2026" / "01" / "01")]
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        resolve_input_paths(str(base), date_range="20260101-20260101",
+                            date_range_days_ago="3-1")

@@ -259,3 +259,54 @@ class TestFeatureIndexingCli:
         ])
         outp = capsys.readouterr().out
         assert "global:" in outp and "user:" in outp
+
+
+class TestCheckpointResume:
+    def test_game_checkpoint_and_resume(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=200, seed=5)
+        ckpt = str(tmp_path / "ckpt")
+        args = [
+            "--train-input-dirs", train,
+            "--output-dir", str(tmp_path / "out1"),
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures",
+            "--updating-sequence", "fixed",
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:15,1e-7,0.1,1,LBFGS,L2",
+            "--checkpoint-dir", ckpt,
+        ]
+        game_main(args)
+        from photon_ml_tpu.utils.checkpoint import CheckpointManager
+        mgr = CheckpointManager(ckpt)
+        assert mgr.latest_step() == 2
+        # resume: second run starts from the snapshot (no iterations left →
+        # model published straight from restored states)
+        args[args.index(str(tmp_path / "out1"))] = str(tmp_path / "out2")
+        game_main(args)
+        import os
+        assert os.path.isdir(os.path.join(str(tmp_path / "out2"), "best"))
+
+    def test_dated_inputs(self, tmp_path):
+        day_dir = tmp_path / "data" / "daily" / "2026" / "07" / "01"
+        day_dir.mkdir(parents=True)
+        _make_game_avro(str(day_dir / "part-00000.avro"), n=150, seed=6)
+        out = str(tmp_path / "out")
+        game_main([
+            "--train-input-dirs", str(tmp_path / "data"),
+            "--train-date-range", "20260630-20260702",
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures",
+            "--updating-sequence", "fixed",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:15,1e-7,0.1,1,LBFGS,L2",
+        ])
+        import os
+        assert os.path.isdir(os.path.join(out, "best"))

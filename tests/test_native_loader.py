@@ -105,3 +105,45 @@ def test_native_empty_directory_falls_back(tmp_path):
     (d / "_SUCCESS").write_text("")
     data = load_libsvm(str(d), feature_dimension=3)
     assert data.num_samples == 0
+
+
+@requires_native
+def test_native_page_multiple_no_trailing_newline(tmp_path):
+    """File size an exact page multiple, last byte part of a numeric token:
+    the parser must not scan past the mapping (code-review regression)."""
+    p = str(tmp_path / "page.libsvm")
+    line = "+1 1:0.5 2:1.25\n"
+    page = os.sysconf("SC_PAGE_SIZE")
+    n_full = (2 * page) // len(line) - 1
+    body = line * n_full
+    remaining = 2 * page - len(body)
+    assert remaining >= 6
+    body += "+1 1:" + "7" * (remaining - 5)  # numeric token at exact EOF
+    with open(p, "w") as fh:
+        fh.write(body)
+    assert os.path.getsize(p) == 2 * page
+    data = load_libsvm(p, feature_dimension=2, use_intercept=False)
+    assert data.num_samples == n_full + 1
+    assert data.features[-1, 0] == float("7" * (remaining - 5))
+
+
+@requires_native
+def test_native_tab_delimited_matches_python(tmp_path):
+    p = str(tmp_path / "tabs.libsvm")
+    _write(p, ["+1\t1:0.5\t2:1.5", "-1 2:2.0"])
+    nat = load_libsvm(p, feature_dimension=2, use_intercept=False)
+    os.environ["PHOTON_DISABLE_NATIVE"] = "1"
+    try:
+        py = load_libsvm(p, feature_dimension=2, use_intercept=False)
+    finally:
+        del os.environ["PHOTON_DISABLE_NATIVE"]
+    np.testing.assert_allclose(nat.features.toarray(), py.features.toarray())
+    np.testing.assert_allclose(nat.labels, py.labels)
+
+
+@requires_native
+def test_native_empty_index_rejected(tmp_path):
+    p = str(tmp_path / "emptyidx.libsvm")
+    _write(p, ["+1 :5"])
+    with pytest.raises(ValueError, match="native libsvm parse"):
+        load_libsvm(p, feature_dimension=5)
