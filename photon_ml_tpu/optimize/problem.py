@@ -98,17 +98,13 @@ class GLMOptimizationProblem:
 
     # -- solve ---------------------------------------------------------------
 
-    def run(self, batch: Batch, initial: Optional[Array] = None
-            ) -> tuple[GeneralizedLinearModel, OptimizationResult]:
-        """Train on a device batch; returns (model in RAW feature space,
-        optimization result with trajectory + convergence reason)."""
+    def solve(self, obj: GLMObjective, batch: Batch, x0: Array):
+        """Optimizer dispatch → (x, RunHistory, progressed). Pure-jax: safe
+        to call under jit/shard_map (parallel/distributed.py wraps it with
+        a per-shard batch and a psum-ing objective)."""
         cfg = self.config
-        dim = batch.num_features
-        dtype = batch.X.dtype if hasattr(batch, "X") else batch.values.dtype
-        x0 = jnp.zeros(dim, dtype) if initial is None else initial
-        obj = self.objective()
         payload = (obj, batch)
-
+        dim = x0.shape[-1]
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         use_owlqn = (cfg.optimizer_type == OptimizerType.LBFGS and l1 > 0.0)
 
@@ -116,28 +112,35 @@ class GLMOptimizationProblem:
             l1_arr = jnp.full(dim, l1, x0.dtype)
             if self.l1_mask is not None:
                 l1_arr = l1_arr * self.l1_mask.astype(x0.dtype)
-            x, history, progressed = minimize_owlqn(
+            return minimize_owlqn(
                 _objective_vg, x0, payload, l1=l1_arr,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
                 box=self.box)
-        elif cfg.optimizer_type == OptimizerType.LBFGS:
-            x, history, progressed = minimize_lbfgs(
+        if cfg.optimizer_type == OptimizerType.LBFGS:
+            return minimize_lbfgs(
                 _objective_vg, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
                 box=self.box)
-        elif cfg.optimizer_type == OptimizerType.TRON:
-            x, history, progressed = minimize_tron(
+        if cfg.optimizer_type == OptimizerType.TRON:
+            return minimize_tron(
                 _objective_vg, _objective_hvp, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
                 box=self.box)
-        else:
-            raise ValueError(f"unknown optimizer {cfg.optimizer_type}")
+        raise ValueError(f"unknown optimizer {cfg.optimizer_type}")
 
+    def publish(self, x: Array, history, progressed,
+                obj: Optional[GLMObjective] = None,
+                batch: Optional[Batch] = None
+                ) -> tuple[GeneralizedLinearModel, OptimizationResult]:
+        """Solver output → (raw-space model, result record): optional
+        variance approximation, then coefficient de-normalization
+        (createModel analog)."""
+        cfg = self.config
         result = OptimizationResult.from_history(
             x, history, cfg.max_iterations, cfg.tolerance, bool(progressed))
 
         variances = None
-        if self.compute_variances:
+        if self.compute_variances and obj is not None and batch is not None:
             diag = obj.hessian_diagonal(x, batch)
             variances = 1.0 / (diag + VARIANCE_EPSILON)
 
@@ -147,6 +150,17 @@ class GLMOptimizationProblem:
         model = GeneralizedLinearModel(
             Coefficients(means=means, variances=variances), self.task)
         return model, result
+
+    def run(self, batch: Batch, initial: Optional[Array] = None
+            ) -> tuple[GeneralizedLinearModel, OptimizationResult]:
+        """Train on a device batch; returns (model in RAW feature space,
+        optimization result with trajectory + convergence reason)."""
+        dim = batch.num_features
+        dtype = batch.X.dtype if hasattr(batch, "X") else batch.values.dtype
+        x0 = jnp.zeros(dim, dtype) if initial is None else initial
+        obj = self.objective()
+        x, history, progressed = self.solve(obj, batch, x0)
+        return self.publish(x, history, progressed, obj, batch)
 
     def regularization_value(self, coef_normalized: Array) -> float:
         """lambda-weighted penalty of a (normalized-space) coefficient vector,

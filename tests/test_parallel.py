@@ -98,3 +98,77 @@ def test_shard_batch_rejects_indivisible_rows(rng):
     batch = dense_batch(rng.normal(size=(13, 3)), np.zeros(13))
     with pytest.raises(ValueError, match="divisible"):
         shard_batch(batch, make_mesh())
+
+
+def test_shard_map_fit_matches_local(rng, devices):
+    """Explicit shard_map+psum fit == single-device fit (the manual
+    collectives backend, parallel/distributed.py)."""
+    from photon_ml_tpu.optimize.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+        TaskType,
+    )
+    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+    from photon_ml_tpu.parallel.mesh import make_mesh, shard_batch
+
+    n, d = 512, 32
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(
+        np.float32)
+    batch = dense_batch(X, y)
+
+    problem = GLMOptimizationProblem(
+        config=GLMOptimizationConfiguration(
+            max_iterations=25, tolerance=1e-8, regularization_weight=0.5,
+            optimizer_type=OptimizerType.LBFGS,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2)),
+        task=TaskType.LOGISTIC_REGRESSION)
+
+    local_model, local_res = problem.run(batch)
+
+    mesh = make_mesh(num_data=len(devices), num_entity=1, devices=devices)
+    sharded = shard_batch(batch, mesh)
+    dist_model, dist_res = run_glm_shard_map(problem, sharded, mesh)
+
+    np.testing.assert_allclose(
+        np.asarray(dist_model.coefficients.means),
+        np.asarray(local_model.coefficients.means), rtol=2e-4, atol=2e-4)
+    assert dist_res.iterations > 0
+
+
+def test_shard_map_fit_tron(rng, devices):
+    from photon_ml_tpu.optimize.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+        TaskType,
+    )
+    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+    from photon_ml_tpu.parallel.mesh import make_mesh, shard_batch
+
+    n, d = 256, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d).astype(np.float32)
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    batch = dense_batch(X, y)
+    problem = GLMOptimizationProblem(
+        config=GLMOptimizationConfiguration(
+            max_iterations=10, tolerance=1e-8, regularization_weight=1.0,
+            optimizer_type=OptimizerType.TRON,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2)),
+        task=TaskType.LINEAR_REGRESSION)
+    local_model, _ = problem.run(batch)
+    mesh = make_mesh(num_data=len(devices), num_entity=1, devices=devices)
+    dist_model, _ = run_glm_shard_map(problem, shard_batch(batch, mesh),
+                                      mesh)
+    np.testing.assert_allclose(
+        np.asarray(dist_model.coefficients.means),
+        np.asarray(local_model.coefficients.means), rtol=2e-4, atol=2e-4)
