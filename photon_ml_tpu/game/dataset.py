@@ -28,6 +28,7 @@ feature spaces padded to one ``D_red``; padded rows carry weight 0 and row id
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional
 
 import numpy as np
@@ -245,8 +246,24 @@ class RandomEffectDataConfiguration:
     num_partitions: int = 1
     num_active_data_points_upper_bound: Optional[int] = None
     num_passive_data_points_lower_bound: Optional[int] = None
+    # CLI field 5 is a features-to-samples RATIO (double) in the reference
+    # (RandomEffectDataConfiguration.scala:104-109); the per-entity keep
+    # count is ceil(ratio * num_entity_samples) (RandomEffectDataSet.scala:
+    # 384-390). The absolute count is a direct-API knob, not CLI-parsed.
+    num_features_to_samples_ratio_upper_bound: Optional[float] = None
     num_features_to_keep_upper_bound: Optional[int] = None
     projector: ProjectorConfig = ProjectorConfig(ProjectorType.INDEX_MAP)
+
+    def features_to_keep(self, num_entity_samples: int) -> Optional[int]:
+        """Per-entity feature cap: the absolute bound if set, else
+        ceil(ratio * samples) (RandomEffectDataSet.scala:386)."""
+        if self.num_features_to_keep_upper_bound is not None:
+            return self.num_features_to_keep_upper_bound
+        if self.num_features_to_samples_ratio_upper_bound is not None:
+            return int(math.ceil(
+                self.num_features_to_samples_ratio_upper_bound
+                * num_entity_samples))
+        return None
 
     @staticmethod
     def parse(s: str) -> "RandomEffectDataConfiguration":
@@ -256,10 +273,24 @@ class RandomEffectDataConfiguration:
                 f"random-effect data config needs at least idType,shard,"
                 f"numPartitions: {s!r}")
 
+        def _unset(i):
+            return i >= len(parts) or parts[i] in ("", "-", "none", "None")
+
         def opt_int(i):
-            if i >= len(parts) or parts[i] in ("", "-", "none", "None"):
+            # Negative raw values mean "no bound" (the reference maps them
+            # to Int.MaxValue, RandomEffectDataConfiguration.scala:92-95).
+            if _unset(i):
                 return None
-            return int(parts[i])
+            v = int(parts[i])
+            return None if v < 0 else v
+
+        def opt_ratio(i):
+            # Field 5 is a double (features-to-samples ratio); negative
+            # means unbounded (RandomEffectDataConfiguration.scala:104-109).
+            if _unset(i):
+                return None
+            v = float(parts[i])
+            return None if v < 0 else v
 
         proj = ProjectorConfig(ProjectorType.INDEX_MAP)
         if len(parts) > 6 and parts[6] not in ("", "-", "none"):
@@ -270,7 +301,7 @@ class RandomEffectDataConfiguration:
             num_partitions=int(parts[2]),
             num_active_data_points_upper_bound=opt_int(3),
             num_passive_data_points_lower_bound=opt_int(4),
-            num_features_to_keep_upper_bound=opt_int(5),
+            num_features_to_samples_ratio_upper_bound=opt_ratio(5),
             projector=proj,
         )
 
@@ -455,7 +486,7 @@ def build_random_effect_dataset(
     if proj_cfg.kind == ProjectorType.INDEX_MAP:
         feats = [
             _select_features(mat, active[int(c)][0], data.responses,
-                             config.num_features_to_keep_upper_bound)
+                             config.features_to_keep(len(active[int(c)][0])))
             for c in ent_codes
         ]
         projectors = build_index_map_projectors(feats, raw_dim)

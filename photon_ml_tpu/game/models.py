@@ -126,6 +126,12 @@ class RandomEffectModel:
         return _match(self.entity_codes, codes)
 
     def score(self, data: GameDataset) -> Array:
+        if self.coefficients.shape[0] == 0:
+            # Empty coordinate (e.g. the checked-in GameIntegTest/gameModel
+            # random effects): every row is cold-start ⇒ zero contribution.
+            # Also avoids a (N,0)-vs-(N,D) scipy shape error when the block
+            # width doesn't match the dataset shard.
+            return jnp.zeros(data.num_samples)
         codes = data.id_columns[self.random_effect_type]
         local = self._lookup(codes, data)  # [N] in [0, E]
         mat = data.feature_shards[self.feature_shard_id]

@@ -268,14 +268,26 @@ def load_game_model(input_dir: str,
         for name in sorted(os.listdir(re_dir)):
             inner = os.path.join(re_dir, name)
             re_type, shard_id = _read_id_info(os.path.join(inner, ID_INFO))
-            _, records = read_directory(os.path.join(inner, COEFFICIENTS))
+            # A random-effect coordinate with no coefficients dir is a valid
+            # empty model: the reference's RDD load over a pathless glob
+            # yields zero per-entity GLMs — the checked-in
+            # GameIntegTest/gameModel fixture ships exactly this layout
+            # (random-effect/<name>/ holding only id-info). read_directory
+            # itself handles a dir with no avro files.
+            coeff_dir = os.path.join(inner, COEFFICIENTS)
+            records = (read_directory(coeff_dir)[1]
+                       if os.path.isdir(coeff_dir) else [])
             imap = index_maps.get(shard_id)
             if imap is None:
-                # Union of all per-entity features → one compact map.
+                # Union of all per-entity features → one compact map. An
+                # EMPTY coordinate registers nothing: a zero-length map in
+                # the returned dict would silently zero out that shard for
+                # any dataset later built against these maps.
                 keys = sorted({feature_key(f["name"], f["term"])
                                for r in records for f in r["means"]})
                 imap = IndexMap.from_keys(keys)
-                index_maps[shard_id] = imap
+                if records:
+                    index_maps[shard_id] = imap
             # Per-entity variances are discarded on load, matching the
             # reference (ModelProcessingUtils.scala:342 TODO: "only the
             # means of the coefficients are loaded").

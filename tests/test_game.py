@@ -127,14 +127,24 @@ class TestRandomEffectDataset:
         assert ds.random_projector.matrix.shape == (6, 3)
 
     def test_parse_config_string(self):
+        # Field 5 is a features-to-samples RATIO (double), per-entity keep
+        # count = ceil(ratio * samples) — RandomEffectDataConfiguration.
+        # scala:104-109, RandomEffectDataSet.scala:386.
         cfg = RandomEffectDataConfiguration.parse(
-            "userId,shardA,4,100,20,50,random=16")
+            "userId,shardA,4,100,20,0.5,random=16")
         assert cfg.random_effect_type == "userId"
         assert cfg.num_active_data_points_upper_bound == 100
         assert cfg.num_passive_data_points_lower_bound == 20
-        assert cfg.num_features_to_keep_upper_bound == 50
+        assert cfg.num_features_to_samples_ratio_upper_bound == 0.5
+        assert cfg.features_to_keep(25) == 13
         assert cfg.projector.kind == ProjectorType.RANDOM
         assert cfg.projector.projected_dim == 16
+        # Negative bounds mean "no bound" (DriverTest passes -1).
+        cfg2 = RandomEffectDataConfiguration.parse(
+            "userId,shardA,4,-1,0,-1,index_map")
+        assert cfg2.num_active_data_points_upper_bound is None
+        assert cfg2.num_features_to_samples_ratio_upper_bound is None
+        assert cfg2.features_to_keep(10) is None
 
     def test_balanced_entity_order(self):
         counts = np.array([100, 1, 1, 1, 50, 49, 1, 1])
