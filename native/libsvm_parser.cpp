@@ -191,8 +191,15 @@ void fill_chunk(FillCtx* ctx) {
                     memchr(tok, ':', static_cast<size_t>(tok_e - tok)));
                 if (!colon) { ctx->error = -7; return; }  // "abc"
                 if (colon == tok) { ctx->error = -3; return; }  // ":5"
+                errno = 0;
                 long idx = strtol(tok, &after, 10);
                 if (after != colon) { ctx->error = -3; return; }
+                // Reject indices that would wrap in the int32 indices
+                // array (strtol saturates with ERANGE on long overflow).
+                if (errno == ERANGE || idx > INT32_MAX) {
+                    ctx->error = -8;
+                    return;
+                }
                 if (!ctx->zero_based) --idx;
                 if (idx < 0) { ctx->error = -4; return; }
                 double v = strtod(colon + 1, &after);

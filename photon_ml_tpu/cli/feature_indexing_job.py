@@ -15,6 +15,9 @@ from photon_ml_tpu.io.data_format import (
     TRAINING_EXAMPLE_FIELD_NAMES,
 )
 from photon_ml_tpu.io.feature_index_job import build_feature_index
+from photon_ml_tpu.utils.compile_cache import (
+    enable_persistent_compile_cache,
+)
 
 
 def parse_args(argv: Sequence[str]) -> argparse.Namespace:
@@ -32,6 +35,7 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    enable_persistent_compile_cache()
     ns = parse_args(argv if argv is not None else sys.argv[1:])
     add_intercept = str(ns.add_intercept).lower() in ("true", "1")
     shard_sections = _parse_section_keys_map(

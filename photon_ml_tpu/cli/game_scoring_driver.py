@@ -25,6 +25,9 @@ from photon_ml_tpu.io.data_format import (
 )
 from photon_ml_tpu.io.model_io import load_game_model, save_scored_items
 from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
+from photon_ml_tpu.utils.compile_cache import (
+    enable_persistent_compile_cache,
+)
 
 from photon_ml_tpu.cli.game_training_driver import (
     _parse_key_value_map,
@@ -35,7 +38,13 @@ from photon_ml_tpu.cli.game_training_driver import (
 def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p = argparse.ArgumentParser(prog="game-scoring",
                                 description="GAME scoring on TPU")
-    p.add_argument("--input-data-dirs", required=True)
+    p.add_argument("--input-data-dirs", required=True,
+                   help="comma-separated input dirs/files")
+    p.add_argument("--date-range",
+                   help="yyyyMMdd-yyyyMMdd over <dir>/daily/yyyy/MM/dd")
+    p.add_argument("--date-range-days-ago",
+                   help="start-end days-ago pair (alternative to "
+                        "--date-range)")
     p.add_argument("--game-model-input-dir", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--feature-name-and-term-set-path")
@@ -99,9 +108,15 @@ class GameScoringDriver:
             {x.strip() for x in ns.random_effect_id_set.split(",")
              if x.strip()}
             | {e.id_type for e in self.evaluators if e.id_type})
+        # Multi-dir + date-range narrowing, like the training driver (the
+        # reference scoring Driver shares GAMEDriver's input resolution).
+        from photon_ml_tpu.utils.date_range import resolve_input_paths
+
+        input_paths = resolve_input_paths(
+            ns.input_data_dirs, ns.date_range, ns.date_range_days_ago)
         with timed_phase("prepareGameDataSet", self.logger):
             data = load_game_dataset_avro(
-                ns.input_data_dirs, self.section_keys, index_maps,
+                input_paths, self.section_keys, index_maps,
                 id_types=id_types, response_required=False)
         self.logger.info(f"scoring {data.num_samples} samples")
 
@@ -132,6 +147,7 @@ class GameScoringDriver:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    enable_persistent_compile_cache()
     ns = parse_args(argv if argv is not None else sys.argv[1:])
     driver = GameScoringDriver(ns)
     try:

@@ -71,6 +71,9 @@ from photon_ml_tpu.optimize.config import (
 )
 from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
 from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
+from photon_ml_tpu.utils.compile_cache import (
+    enable_persistent_compile_cache,
+)
 
 
 class ModelOutputMode:
@@ -449,6 +452,7 @@ class GameTrainingDriver:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    enable_persistent_compile_cache()
     ns = parse_args(argv if argv is not None else sys.argv[1:])
     driver = GameTrainingDriver(ns)
     try:

@@ -42,7 +42,7 @@ from photon_ml_tpu.diagnostics import diagnostics as diag
 from photon_ml_tpu.diagnostics.reporting import render_html, render_text
 from photon_ml_tpu.diagnostics.transformers import build_diagnostic_document
 from photon_ml_tpu.evaluation.model_evaluation import (
-    evaluate_model,
+    evaluate_model_grid,
     select_best_model,
 )
 from photon_ml_tpu.io.data_format import (
@@ -75,6 +75,9 @@ from photon_ml_tpu.utils.events import (
     PhotonSetupEvent,
     TrainingFinishEvent,
     TrainingStartEvent,
+)
+from photon_ml_tpu.utils.compile_cache import (
+    enable_persistent_compile_cache,
 )
 from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
 
@@ -380,8 +383,12 @@ class LegacyDriver(EventEmitter):
             return
         with timed_phase("validate", self.logger):
             batch = self._batch(self.validate_data)
-            for tm in self.models:
-                metrics = evaluate_model(tm.model, batch)
+            # Whole lambda grid in ONE jitted call + one host fetch
+            # (Evaluation.scala:100-152 runs one Spark job per metric per
+            # model; on a remote chip those tiny dispatches dominated).
+            metric_maps = evaluate_model_grid(
+                [tm.model for tm in self.models], batch)
+            for tm, metrics in zip(self.models, metric_maps):
                 self.per_lambda_metrics[tm.regularization_weight] = metrics
                 self.logger.info(
                     f"lambda={tm.regularization_weight:g} metrics={metrics}")
@@ -453,15 +460,32 @@ class LegacyDriver(EventEmitter):
         self._advance(DriverStage.DIAGNOSED)
 
     def _model_factory(self, with_metrics_on_train: bool):
-        """(row_indices, warm_start) → per-λ results, for fitting/bootstrap
-        diagnostics (the reference's modelFactory closures)."""
+        """(train_indices, eval_indices, warm_start) → per-λ results, for
+        fitting/bootstrap diagnostics (the reference's modelFactory
+        closures). ``eval_indices`` selects the held-out evaluation rows
+        (FittingDiagnostic.scala evaluates metricsTest on the held-out
+        partition); ``None`` evaluates on the full training batch (the
+        bootstrap diagnostic's convention).
+
+        Warm starts are threaded per lambda across calls in the problem's
+        normalized coefficient space via a closure-held cache — the passed
+        ``warm_start`` dict only gates which lambdas may reuse it (the raw
+        coefficients it carries are back-transformed model space, not a
+        valid optimizer start under normalization).
+        """
         p = self.params
         data = self.train_data
+        normalized_warm: dict[float, np.ndarray] = {}
 
-        def factory(idx: np.ndarray, warm_start: dict):
-            sub = dense_batch(data.features[idx].toarray(),
-                              data.labels[idx], data.offsets[idx],
-                              data.weights[idx])
+        def _sub_batch(idx: np.ndarray):
+            return dense_batch(data.features[idx].toarray(),
+                               data.labels[idx], data.offsets[idx],
+                               data.weights[idx])
+
+        def factory(train_idx: np.ndarray, eval_idx, warm_start: dict):
+            sub = _sub_batch(train_idx)
+            starts = {lam: coef for lam, coef in normalized_warm.items()
+                      if lam in warm_start} or None
             models = train_glm_grid(
                 sub, p.task, p.regularization_weights,
                 optimizer_type=p.optimizer,
@@ -469,12 +493,19 @@ class LegacyDriver(EventEmitter):
                     p.regularization_type, p.elastic_net_alpha),
                 max_iterations=p.num_iterations,
                 tolerance=p.convergence_tolerance,
-                normalization=self.normalization, box=self.box)
+                normalization=self.normalization, box=self.box,
+                initial_by_weight=starts)
+            held = (self._batch(data) if eval_idx is None
+                    else _sub_batch(np.asarray(eval_idx)))
+            glms = [tm.model for tm in models]
+            test_maps = evaluate_model_grid(glms, held)
+            train_maps = (evaluate_model_grid(glms, sub)
+                          if with_metrics_on_train else [None] * len(models))
             out = {}
-            full = self._batch(data)
-            for tm in models:
-                train_metrics = evaluate_model(tm.model, sub)
-                test_metrics = evaluate_model(tm.model, full)
+            for tm, train_metrics, test_metrics in zip(
+                    models, train_maps, test_maps):
+                normalized_warm[tm.regularization_weight] = np.asarray(
+                    tm.result.coefficients)
                 coef = np.asarray(tm.model.coefficients.means)
                 if with_metrics_on_train:
                     out[tm.regularization_weight] = (
@@ -552,6 +583,7 @@ class LegacyDriver(EventEmitter):
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    enable_persistent_compile_cache()
     params = parse_args(argv if argv is not None else sys.argv[1:])
     driver = LegacyDriver(params)
     try:

@@ -221,11 +221,12 @@ def prediction_error_independence(
 # Learning-curve fitting diagnostic
 # ---------------------------------------------------------------------------
 
-# model_factory(row_indices, warm_start: {lambda: coef}) ->
-#   {lambda: (coefficients, {metric: value_on_train},
-#             {metric: value_on_holdout})}
+# model_factory(train_indices, holdout_indices, warm_start: {lambda: coef})
+#   -> {lambda: (coefficients, {metric: value_on_train},
+#                {metric: value_on_holdout})}
 FitModelFactory = Callable[
-    [np.ndarray, dict], dict[float, tuple[np.ndarray, dict, dict]]]
+    [np.ndarray, Optional[np.ndarray], dict],
+    dict[float, tuple[np.ndarray, dict, dict]]]
 
 
 def fitting_diagnostic(
@@ -250,7 +251,10 @@ def fitting_diagnostic(
     for max_tag in range(num_partitions - 1):
         train_idx = np.flatnonzero(tags <= max_tag)
         portion = 100.0 * len(train_idx) / num_samples
-        results = model_factory(train_idx, warm_start)
+        # Test metrics are computed on the held-out partition — rows the
+        # model never saw (FittingDiagnostic.scala evaluates metricsTest on
+        # the holdout), so the curves can actually show overfitting.
+        results = model_factory(train_idx, holdout, warm_start)
         warm_start = {lam: coef for lam, (coef, _, _) in results.items()}
         for lam, (_, train_metrics, test_metrics) in results.items():
             for metric, test_v in test_metrics.items():
@@ -277,10 +281,11 @@ def fitting_diagnostic(
 # Bootstrap training diagnostic
 # ---------------------------------------------------------------------------
 
-# model_factory(row_indices, warm_start) -> {lambda: (coefficients,
-#   {metric: value})}
+# model_factory(train_indices, eval_indices=None, warm_start) ->
+#   {lambda: (coefficients, {metric: value})}
 BootstrapModelFactory = Callable[
-    [np.ndarray, dict], dict[float, tuple[np.ndarray, dict]]]
+    [np.ndarray, Optional[np.ndarray], dict],
+    dict[float, tuple[np.ndarray, dict]]]
 
 
 def bootstrap_training(
@@ -308,7 +313,7 @@ def bootstrap_training(
         size = int(round(portion_per_sample * num_samples))
         idx = rng.choice(num_samples, size=size, replace=True)
         for lam, (coef, metrics) in model_factory(
-                idx, dict(warm_start or {})).items():
+                idx, None, dict(warm_start or {})).items():
             per_lambda.setdefault(lam, []).append(
                 (np.asarray(coef, np.float64), metrics))
 

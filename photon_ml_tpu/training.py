@@ -14,7 +14,7 @@ Breeze optimizer per lambda and re-broadcasts coefficients per iteration.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Mapping, Optional, Sequence
 
 import jax.numpy as jnp
 
@@ -55,8 +55,15 @@ def train_glm_grid(
     compute_variances: bool = False,
     warm_start: bool = True,
     l1_mask: Optional[Array] = None,
+    initial_by_weight: Optional[Mapping[float, Array]] = None,
 ) -> list[TrainedModel]:
     """Train one GLM per regularization weight, descending, warm-started.
+
+    ``initial_by_weight`` supplies a per-lambda starting point in the
+    problem's (normalized) coefficient space — e.g. the same lambda's
+    optimum from a previous retrain, as the reference's fitting diagnostic
+    threads through scanLeft (FittingDiagnostic.scala:48-110). It takes
+    precedence over the previous-lambda warm start.
 
     Returns models ordered as the (descending-sorted) weights were trained.
     """
@@ -77,7 +84,10 @@ def train_glm_grid(
         problem = GLMOptimizationProblem(
             config=cfg, task=task, normalization=normalization, box=box,
             compute_variances=compute_variances, l1_mask=l1_mask)
-        model, result = problem.run(batch, initial=init)
+        start = init
+        if initial_by_weight is not None and lam in initial_by_weight:
+            start = jnp.asarray(initial_by_weight[lam])
+        model, result = problem.run(batch, initial=start)
         out.append(TrainedModel(lam, model, result))
         if warm_start:
             # Warm start in normalized coefficient space

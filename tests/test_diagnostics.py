@@ -99,13 +99,16 @@ class TestFitting:
         w_true = rng.normal(size=d)
         y = X @ w_true + 0.1 * rng.normal(size=n)
 
-        def factory(idx, warm):
+        seen_eval_idx = []
+
+        def factory(idx, eval_idx, warm):
+            seen_eval_idx.append(np.asarray(eval_idx))
             Xi, yi = X[idx], y[idx]
             w = np.linalg.solve(Xi.T @ Xi + 1e-3 * np.eye(d), Xi.T @ yi)
             def rmse(Xa, ya):
                 return float(np.sqrt(np.mean((Xa @ w - ya) ** 2)))
             return {1.0: (w, {"RMSE": rmse(Xi, yi)},
-                          {"RMSE": rmse(X, y)})}
+                          {"RMSE": rmse(X[eval_idx], y[eval_idx])})}
 
         reports = fitting_diagnostic(n, d, factory, seed=0)
         assert 1.0 in reports
@@ -114,9 +117,14 @@ class TestFitting:
         assert np.all(np.diff(curve.portions) > 0)
         # holdout error at full data <= at smallest portion (noisy; lenient)
         assert curve.test_values[-1] <= curve.test_values[0] + 0.05
+        # the holdout partition is disjoint from every training prefix and
+        # constant across calls (FittingDiagnostic holds the last tag out)
+        holdout = seen_eval_idx[0]
+        for ev in seen_eval_idx:
+            np.testing.assert_array_equal(ev, holdout)
 
     def test_too_few_samples_returns_empty(self):
-        assert fitting_diagnostic(10, 5, lambda i, w: {}) == {}
+        assert fitting_diagnostic(10, 5, lambda i, e, w: {}) == {}
 
 
 class TestBootstrap:
@@ -127,7 +135,8 @@ class TestBootstrap:
         w_true = np.asarray([1.0, -0.5, 0.0])
         y = X @ w_true + 0.1 * rng.normal(size=n)
 
-        def factory(idx, warm):
+        def factory(idx, eval_idx, warm):
+            assert eval_idx is None  # bootstrap evaluates on the full batch
             Xi, yi = X[idx], y[idx]
             w = np.linalg.solve(Xi.T @ Xi + 1e-6 * np.eye(d), Xi.T @ yi)
             return {1.0: (w, {"RMSE": float(np.sqrt(np.mean(

@@ -205,8 +205,10 @@ class TestGameDrivers:
             os.path.join(best_dir, "random-effect", "perUser"))
 
         score_out = str(tmp_path / "score-out")
+        # Comma-separated multi-input scoring (the plural flag's contract;
+        # the reference scoring driver shares GAMEDriver input resolution).
         score_main([
-            "--input-data-dirs", validate,
+            "--input-data-dirs", f"{validate},{train}",
             "--game-model-input-dir", best_dir,
             "--output-dir", score_out,
             "--feature-shard-id-to-feature-section-keys-map",
@@ -216,7 +218,7 @@ class TestGameDrivers:
         ])
         scores = load_scored_items(
             os.path.join(score_out, "scores", "part-00000.avro"))
-        assert len(scores) == 150
+        assert len(scores) == 150 + 400  # both inputs scored
         assert all(np.isfinite(r["predictionScore"]) for r in scores)
 
     def test_game_grid_selects_best(self, tmp_path):

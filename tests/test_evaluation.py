@@ -141,3 +141,53 @@ def test_evaluate_dispatch(rng):
     assert auc == pytest.approx(sklearn.metrics.roc_auc_score(y, s), abs=1e-10)
     rmse = evaluate(EvaluatorSpec.parse("RMSE"), jnp.asarray(s), jnp.asarray(y))
     assert rmse == pytest.approx(np.sqrt(np.mean((s - y) ** 2)), rel=1e-9)
+
+
+def test_evaluate_model_grid_matches_reference_formulas(rng):
+    """The fused [L, D]-grid evaluator returns the same numbers as
+    independent per-metric computations (one jitted call replaces the
+    reference's per-model, per-metric Spark jobs, Evaluation.scala:100-152)."""
+    from photon_ml_tpu.data.batch import dense_batch
+    from photon_ml_tpu.evaluation import model_evaluation as me
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_ml_tpu.optimize.config import TaskType
+
+    n, d, L = 300, 6, 4
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) > 0.5).astype(float)
+    w = rng.random(n) + 0.5
+    batch = dense_batch(X, y, weights=w)
+    models = [GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=d), jnp.float64)),
+        TaskType.LOGISTIC_REGRESSION) for _ in range(L)]
+
+    grid_maps = me.evaluate_model_grid(models, batch)
+    assert len(grid_maps) == L
+    for model, got in zip(models, grid_maps):
+        # Expected values from the same dtype the batch kernel computes in
+        # (dense_batch stores float32; sklearn would otherwise see f64).
+        margins = np.asarray(
+            np.asarray(batch.X) @ np.asarray(model.coefficients.means,
+                                             np.float32), np.float64)
+        preds = 1.0 / (1.0 + np.exp(-margins))
+        # f32 tolerances: the batch stores float32, so weight/loss
+        # accumulations differ from numpy f64 at ~1e-7 relative.
+        auc = sklearn.metrics.roc_auc_score(y, margins, sample_weight=w)
+        assert got[me.AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] == \
+            pytest.approx(auc, abs=2e-5)
+        rmse = np.sqrt(np.average((preds - y) ** 2, weights=w))
+        assert got[me.ROOT_MEAN_SQUARED_ERROR] == pytest.approx(rmse, rel=1e-4)
+        mae = np.average(np.abs(preds - y), weights=w)
+        assert got[me.MEAN_ABSOLUTE_ERROR] == pytest.approx(mae, rel=1e-4)
+        ll = np.average(-(np.logaddexp(0.0, margins) - y * margins), weights=w)
+        assert got[me.DATA_LOG_LIKELIHOOD] == pytest.approx(ll, rel=1e-4)
+        aic = 2 * d - 2 * ll * w.sum()
+        assert got[me.AKAIKE_INFORMATION_CRITERION] == pytest.approx(
+            aic, rel=1e-3)
+    # single-model path is the L=1 view of the same kernel (bitwise may
+    # differ from the L=4 batch: XLA reassociates the batched matmul)
+    single = me.evaluate_model(models[0], batch)
+    assert single.keys() == grid_maps[0].keys()
+    for key in single:
+        assert single[key] == pytest.approx(
+            grid_maps[0][key], rel=1e-5, abs=1e-6), key
