@@ -413,6 +413,8 @@ class GameTrainingDriver:
         return best, results
 
     def run(self) -> CoordinateDescentResult:
+        from photon_ml_tpu.parallel.mesh import setup_default_mesh
+
         ns = self.ns
         if os.path.isdir(ns.output_dir) and os.listdir(ns.output_dir):
             if str(ns.delete_output_dir_if_exists).lower() in ("true", "1"):
@@ -422,6 +424,9 @@ class GameTrainingDriver:
                 raise FileExistsError(
                     f"output dir {ns.output_dir} is not empty")
         os.makedirs(ns.output_dir, exist_ok=True)
+        # Multi-chip: all devices on the data axis; fixed-effect solves go
+        # through the shard_map backend (see GLMOptimizationProblem.run).
+        setup_default_mesh()
         with timed_phase("prepareFeatureMaps", self.logger):
             self.prepare_feature_maps()
         with timed_phase("prepareGameDataSet", self.logger):

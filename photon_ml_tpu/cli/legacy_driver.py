@@ -557,6 +557,11 @@ class LegacyDriver(EventEmitter):
 
     def run(self) -> None:
         """Driver.run :142-202."""
+        from photon_ml_tpu.parallel.mesh import setup_default_mesh
+
+        # Multi-chip: shard the sample axis; solves route through the
+        # shard_map backend (see GLMOptimizationProblem.run).
+        setup_default_mesh()
         p = self.params
         if os.path.exists(p.output_directory) and os.listdir(
                 p.output_directory):

@@ -154,7 +154,23 @@ class GLMOptimizationProblem:
     def run(self, batch: Batch, initial: Optional[Array] = None
             ) -> tuple[GeneralizedLinearModel, OptimizationResult]:
         """Train on a device batch; returns (model in RAW feature space,
-        optimization result with trajectory + convergence reason)."""
+        optimization result with trajectory + convergence reason).
+
+        When the process has a default mesh with a >1 data axis
+        (parallel/mesh.setup_default_mesh — the drivers' bootstrap), the
+        solve routes through the explicit shard_map+psum backend: rows are
+        sharded, each device runs the solver loop locally, and per-shard
+        shapes stay local so the fused Pallas kernel engages on every chip
+        (a pallas_call has no GSPMD partitioning rule, so the auto-sharded
+        path would silently fall back to the two-pass XLA form on a pod).
+        """
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, get_default_mesh
+
+        mesh = get_default_mesh()
+        if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+            from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+
+            return run_glm_shard_map(self, batch, mesh, initial=initial)
         dim = batch.num_features
         dtype = batch.X.dtype if hasattr(batch, "X") else batch.values.dtype
         x0 = jnp.zeros(dim, dtype) if initial is None else initial

@@ -45,54 +45,52 @@ except ImportError:  # pragma: no cover
         return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=False)
 
-from photon_ml_tpu.data.batch import DenseBatch
+from photon_ml_tpu.data.batch import Batch, pad_batch
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.optimize.common import OptimizationResult
 from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
-from photon_ml_tpu.parallel.mesh import DATA_AXIS
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
 
 Array = jnp.ndarray
 
 
 def run_glm_shard_map(
         problem: GLMOptimizationProblem,
-        batch: DenseBatch,
+        batch: Batch,
         mesh,
         initial: Optional[Array] = None,
 ) -> tuple[GeneralizedLinearModel, OptimizationResult]:
     """Fit ``problem`` on ``batch`` with rows explicitly sharded over the
-    mesh ``data`` axis. The batch must already be padded to a row count
-    divisible by the data-axis size (zero-weight rows; mesh.shard_batch).
+    mesh ``data`` axis. Works for any row-major batch layout (DenseBatch,
+    EllBatch — every array leaf has rows leading). Rows not divisible by
+    the data-axis size are padded with zero-weight rows here.
     """
     n_shards = mesh.shape[DATA_AXIS]
     rows = batch.labels.shape[0]
-    if rows % n_shards != 0:
-        raise ValueError(
-            f"batch rows {rows} not divisible by data axis {n_shards}; "
-            "pad with zero-weight rows first")
+    padded = pad_rows_to_multiple(rows, n_shards)
+    if padded != rows:
+        batch = pad_batch(batch, padded)
 
     dim = batch.num_features
-    x0 = (jnp.zeros(dim, batch.X.dtype) if initial is None
+    dtype = batch.X.dtype if hasattr(batch, "X") else batch.values.dtype
+    x0 = (jnp.zeros(dim, dtype) if initial is None
           else jnp.asarray(initial))
     # psum-ing objective: every reduction crosses the data axis.
     obj = dataclasses.replace(problem.objective(), axis_name=DATA_AXIS)
 
-    def local_fit(X, labels, offsets, weights, x0_rep):
-        shard = DenseBatch(X=X, labels=labels, offsets=offsets,
-                           weights=weights)
+    def local_fit(shard, x0_rep):
         x, history, progressed = problem.solve(obj, shard, x0_rep)
         return x, history, progressed
 
-    row = P(DATA_AXIS)
+    row_specs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), batch)
     # grads are psum-identical on every device, but the replication checker
     # can't prove it through the while_loop — checking is disabled.
     fit = _shard_map(
         local_fit, mesh,
-        in_specs=(row, row, row, row, P()),
+        in_specs=(row_specs, P()),
         out_specs=(P(), P(), P()),
     )
-    x, history, progressed = jax.jit(fit)(
-        batch.X, batch.labels, batch.offsets, batch.weights, x0)
+    x, history, progressed = jax.jit(fit)(batch, x0)
 
     # Variances/publication run on the full (GSPMD-sharded) batch.
     return problem.publish(x, history, progressed, problem.objective(),

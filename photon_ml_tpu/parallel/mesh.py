@@ -34,6 +34,35 @@ from photon_ml_tpu.data.batch import DenseBatch, EllBatch
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
 
+# Process-wide default mesh: the drivers' distribution context. When set
+# with a >1 data axis, GLMOptimizationProblem.run routes fixed-effect
+# solves through the explicit shard_map backend so per-shard shapes stay
+# local and the fused Pallas kernel engages on every chip (it has no GSPMD
+# partitioning rule, so the GSPMD path would disable it on >1 device —
+# ops/pallas_kernels.pallas_supported).
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+def setup_default_mesh(num_entity: int = 1) -> Optional[Mesh]:
+    """Driver bootstrap: build an all-devices (data x entity) mesh and make
+    it the process default. Single-device processes get no mesh (every
+    sharding is a no-op there)."""
+    if len(jax.devices()) <= 1:
+        set_default_mesh(None)
+        return None
+    mesh = make_mesh(num_entity=num_entity)
+    set_default_mesh(mesh)
+    return mesh
+
 
 def make_mesh(
     num_data: Optional[int] = None,

@@ -36,6 +36,16 @@ def devices():
     return devs
 
 
+@pytest.fixture(autouse=True)
+def _reset_default_mesh():
+    """Driver runs install a process-default mesh (setup_default_mesh);
+    keep that from leaking across tests."""
+    yield
+    from photon_ml_tpu.parallel.mesh import set_default_mesh
+
+    set_default_mesh(None)
+
+
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
