@@ -120,6 +120,13 @@ def evaluate_model_grid(models: Sequence[GeneralizedLinearModel],
     task = models[0].task
     if any(m.task != task for m in models):
         raise ValueError("evaluate_model_grid requires a homogeneous task")
+    dim = models[0].coefficients.means.shape
+    for i, m in enumerate(models):
+        if m.coefficients.means.shape != dim:
+            raise ValueError(
+                f"evaluate_model_grid requires homogeneous coefficient "
+                f"dimensions: model 0 has shape {tuple(dim)} but model {i} "
+                f"has {tuple(m.coefficients.means.shape)}")
     W = jnp.stack([m.coefficients.means for m in models])
     packed = jax.device_get(_evaluate_grid_kernel(task, W, batch))
     names = _metric_names(task)

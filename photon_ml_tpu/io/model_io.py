@@ -28,6 +28,7 @@ Re-design of the reference's model (de)serialization stack
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Iterable, Optional
 
@@ -43,6 +44,8 @@ from photon_ml_tpu.io.avro import (
 from photon_ml_tpu.io.index_map import IndexMap, feature_key, split_feature_key
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.optimize.config import TaskType
+
+logger = logging.getLogger(__name__)
 
 # Directory-layout constants (reference avro/Constants.scala:22-25).
 ID_INFO = "id-info"
@@ -264,6 +267,7 @@ def load_game_model(input_dir: str,
             models[name] = FixedEffectModel(glm, shard_id)
 
     re_dir = os.path.join(input_dir, RANDOM_EFFECT)
+    empty_shards: dict = {}  # shard_id -> first empty coordinate seen
     if os.path.isdir(re_dir):
         for name in sorted(os.listdir(re_dir)):
             inner = os.path.join(re_dir, name)
@@ -288,6 +292,8 @@ def load_game_model(input_dir: str,
                 imap = IndexMap.from_keys(keys)
                 if records:
                     index_maps[shard_id] = imap
+                else:
+                    empty_shards.setdefault(shard_id, name)
             # Per-entity variances are discarded on load, matching the
             # reference (ModelProcessingUtils.scala:342 TODO: "only the
             # means of the coefficients are loaded").
@@ -304,6 +310,16 @@ def load_game_model(input_dir: str,
                 entity_codes=np.arange(len(ids)),
                 coefficients=jnp.asarray(coefs),
                 entity_ids=np.asarray(ids, dtype=object))
+
+    # Warn only for shards that REMAIN unserved: another (non-empty)
+    # coordinate sharing the feature shard may have registered a map.
+    for shard_id, name in empty_shards.items():
+        if shard_id not in index_maps:
+            logger.warning(
+                "random-effect coordinate %r is empty and no index map was "
+                "supplied for feature shard %r; the shard is omitted from "
+                "the returned index maps — building a dataset against these "
+                "maps will not serve shard %r", name, shard_id, shard_id)
 
     if not models:
         raise FileNotFoundError(f"no models under {input_dir}")

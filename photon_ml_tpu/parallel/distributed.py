@@ -9,14 +9,24 @@ HessianVectorAggregator.scala:146):
 
 - every device runs the SAME L-BFGS/OWL-QN/TRON loop on its row shard;
 - each objective evaluation ends in ``lax.psum`` over the ``data`` axis, so
-  coefficients stay bit-identical across devices (the replicated-parameter
-  invariant that replaces the reference's coefficient Broadcast);
+  every device sees the same collective result and the replicated
+  coefficient iterates stay bit-identical ACROSS DEVICES (the invariant
+  that replaces the reference's coefficient Broadcast);
 - per-shard shapes are local, which lets the fused Pallas kernel engage on
   each shard (ops/pallas_kernels.py's shard_map gate).
 
 Use this path when GSPMD's choices need overriding (e.g. to force the
-single-pass kernel, or to compose with other manual collectives); results
-match ``GLMOptimizationProblem.run`` on the full batch.
+single-pass kernel, or to compose with other manual collectives).
+
+Parity with the local path: psum sums per-shard partials, which reassociates
+the floating-point reduction relative to ``GLMOptimizationProblem.run`` on
+the full batch. In float64 both paths converge to the same optimum to
+machine epsilon; in float32, when the convergence tolerance sits below the
+f32 noise floor (~1e-7 relative), the two trajectories stall at points that
+differ at the noise-floor scale (~1e-4 coefficient max-abs observed). That
+is inherent to distributed summation — the reference's treeAggregate has the
+same property vs a sequential fold — and is pinned by
+tests/test_mesh_routing.py's paired f64/f32 parity tests.
 """
 
 from __future__ import annotations

@@ -6,18 +6,64 @@ remote TPU). A persistent on-disk cache makes every driver run after the
 first reuse compiled executables, so short CLI jobs (heart-sized trainings,
 scoring runs) are not dominated by compile time.
 
+The cache directory is keyed by a machine/backend fingerprint: XLA:CPU AOT
+results encode target machine features (AVX-512 variants etc.), and loading
+an entry compiled on a different host can mis-execute ("could lead to
+execution errors such as SIGILL" per XLA's loader). A shared home directory
+must therefore never serve one machine's entries to another.
+
+Growth: when the running JAX exposes ``jax_compilation_cache_max_size`` the
+cache is capped (LRU-evicted by JAX) at 1 GiB and every kernel is persisted,
+however fast it compiled — short CLI runs are dominated by many sub-second
+compiles. On older JAX without the cap, JAX's default persistence thresholds
+(compile time >= 1s) apply instead, which slows growth but does not bound
+it — long-lived hosts on such versions need external cleanup.
 Opt out with ``PHOTON_DISABLE_COMPILE_CACHE=1`` or point the directory
 elsewhere with ``PHOTON_COMPILE_CACHE_DIR``.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "photon_ml_tpu", "xla")
 
+_MAX_CACHE_BYTES = 1 << 30  # 1 GiB, LRU-evicted by JAX where supported
+
 _enabled = False
+
+
+def _machine_fingerprint(jax) -> str:
+    """Digest of everything that can change generated code: jax/jaxlib
+    versions, the active backend, platform triple, and (on Linux) the CPU
+    feature flags that XLA:CPU AOT results are specialized to."""
+    parts = [
+        platform.system(),
+        platform.machine(),
+        getattr(jax, "__version__", "?"),
+    ]
+    try:
+        import jaxlib
+
+        parts.append(getattr(jaxlib, "__version__", "?"))
+    except ImportError:  # pragma: no cover
+        pass
+    # Requested platform, WITHOUT initializing the backend: drivers enable
+    # the cache first thing in main(), and forcing TPU client init there
+    # would make --help pay multi-second startup and break any later
+    # jax.distributed.initialize() ordering.
+    parts.append(os.environ.get("JAX_PLATFORMS")
+                 or str(jax.config.jax_platforms or "default"))
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((ln for ln in f if ln.startswith("flags")), "")
+        parts.append(flags.strip())
+    except OSError:  # pragma: no cover - non-Linux
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
 def enable_persistent_compile_cache() -> bool:
@@ -29,16 +75,35 @@ def enable_persistent_compile_cache() -> bool:
         return True
     if os.environ.get("PHOTON_DISABLE_COMPILE_CACHE"):
         return False
-    cache_dir = os.environ.get("PHOTON_COMPILE_CACHE_DIR", _DEFAULT_DIR)
+    base_dir = os.environ.get("PHOTON_COMPILE_CACHE_DIR", _DEFAULT_DIR)
     try:
         import jax
 
+        cache_dir = os.path.join(base_dir, _machine_fingerprint(jax))
         os.makedirs(cache_dir, exist_ok=True)
+        # One-time sweep: earlier releases wrote entries directly under the
+        # base dir (unfingerprinted, possibly compiled on another machine).
+        # JAX never reads or LRU-evicts them from there — dead bytes.
+        for entry in os.listdir(base_dir):
+            path = os.path.join(base_dir, entry)
+            if os.path.isfile(path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # Cache every kernel, however fast it compiled: CLI runs re-pay
-        # even sub-second compiles on every invocation otherwise.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            jax.config.update("jax_compilation_cache_max_size",
+                              _MAX_CACHE_BYTES)
+            capped = True
+        except AttributeError:  # size cap absent on older JAX
+            capped = False
+        if capped:
+            # Growth is bounded by the LRU cap, so persist everything:
+            # short CLI runs (heart-sized trainings, scoring) are dominated
+            # by many sub-second kernel compiles.
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _enabled = True
     except Exception:
         return False

@@ -40,11 +40,11 @@ def _problem(optimizer=OptimizerType.LBFGS, lam=0.5):
                                   task=TaskType.LOGISTIC_REGRESSION)
 
 
-def _toy_batch(rng, n=333, d=12):
+def _toy_batch(rng, n=333, d=12, dtype=jnp.float32):
     X = rng.normal(size=(n, d))
     w = rng.normal(size=d)
     y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
-    return dense_batch(X, y)
+    return dense_batch(X, y, dtype=dtype)
 
 
 def test_default_mesh_routes_run_through_shard_map(rng, monkeypatch):
@@ -69,16 +69,41 @@ def test_default_mesh_routes_run_through_shard_map(rng, monkeypatch):
     assert calls == [8]  # mesh active -> shard_map backend
     assert result.iterations > 0
 
-    # Numerics: explicit psum path == local fit (same optimum; the row
-    # padding adds zero-weight rows only).
+    # Numerics: explicit psum path reaches the same optimum as the local
+    # fit up to f32 reassociation noise (the row padding adds zero-weight
+    # rows only; exactness is pinned by the f64 parity test below).
     np.testing.assert_allclose(
         np.asarray(model_sharded.coefficients.means),
-        np.asarray(model_local.coefficients.means), rtol=2e-4, atol=2e-5)
+        np.asarray(model_local.coefficients.means), rtol=1e-3, atol=5e-4)
 
 
 @pytest.mark.parametrize("optimizer", [OptimizerType.LBFGS,
                                        OptimizerType.TRON])
-def test_shard_map_backend_matches_local(rng, optimizer):
+def test_shard_map_backend_matches_local_f64(rng, optimizer):
+    """The real parity gate: in float64 the psum backend and the local fit
+    agree to machine epsilon (both reach FUNCTION_VALUES_CONVERGED at the
+    same optimum; measured max-abs 2.2e-16). Any actual backend bug (wrong
+    psum axis, bad row padding, shard misalignment) shows up at >=1e-6 here.
+    """
+    batch = _toy_batch(rng, n=264, d=9, dtype=jnp.float64)
+    problem = _problem(optimizer)
+    model_local, _ = problem.run(batch)
+    mesh = make_mesh()
+    model_dist, _ = distributed.run_glm_shard_map(problem, batch, mesh)
+    np.testing.assert_allclose(
+        np.asarray(model_dist.coefficients.means),
+        np.asarray(model_local.coefficients.means), rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("optimizer", [OptimizerType.LBFGS,
+                                       OptimizerType.TRON])
+def test_shard_map_backend_matches_local_f32(rng, optimizer):
+    """In float32 at tolerance 1e-9 (below the f32 noise floor) both runs
+    stop on the objective-not-improving detector, and psum's different
+    summation order stalls the trajectory at a slightly different point —
+    measured max-abs ~1.1e-4 for L-BFGS. That is reassociation sensitivity,
+    not a backend bug (the f64 test above pins exactness), so the f32 bound
+    is the noise-floor scale, not machine epsilon."""
     batch = _toy_batch(rng, n=264, d=9)
     problem = _problem(optimizer)
     model_local, _ = problem.run(batch)
@@ -86,7 +111,7 @@ def test_shard_map_backend_matches_local(rng, optimizer):
     model_dist, _ = distributed.run_glm_shard_map(problem, batch, mesh)
     np.testing.assert_allclose(
         np.asarray(model_dist.coefficients.means),
-        np.asarray(model_local.coefficients.means), rtol=2e-4, atol=2e-5)
+        np.asarray(model_local.coefficients.means), rtol=1e-3, atol=5e-4)
 
 
 def test_shard_map_backend_ell_batch(rng):
