@@ -36,13 +36,12 @@ import scipy.sparse as sp
 
 import jax.numpy as jnp
 
-from photon_ml_tpu.data.batch import Batch, DenseBatch, ell_from_rows
+from photon_ml_tpu.data.batch import Batch, DenseBatch, ell_from_csr
 from photon_ml_tpu.projector.projectors import (
     IndexMapProjectors,
     ProjectorConfig,
     ProjectorType,
     RandomProjector,
-    build_index_map_projectors,
     build_random_projector,
 )
 
@@ -156,13 +155,7 @@ def _csr_to_batch(
             offsets=jnp.asarray(offsets, jnp.float32),
             weights=jnp.asarray(weights, jnp.float32),
         )
-    rows = [
-        (mat.indices[mat.indptr[i]:mat.indptr[i + 1]],
-         mat.data[mat.indptr[i]:mat.indptr[i + 1]])
-        for i in range(mat.shape[0])
-    ]
-    return ell_from_rows(rows, mat.shape[1], labels, offsets, weights,
-                         dtype=dtype)
+    return ell_from_csr(mat, labels, offsets, weights, dtype=dtype)
 
 
 def build_fixed_effect_dataset(
@@ -384,48 +377,137 @@ class RandomEffectDataset:
         return scores[self.passive_row_ids]
 
 
-def _reservoir_cap(rng: np.random.Generator, rows: np.ndarray, cap: int
-                   ) -> tuple[np.ndarray, np.ndarray, float]:
-    """Split one entity's row ids into (active, passive) with weight rescale.
+def _topk_per_segment(seg: np.ndarray, score: np.ndarray,
+                      limit: np.ndarray) -> np.ndarray:
+    """Boolean mask keeping the ``limit[seg]`` highest-``score`` items of
+    each segment (stable; vectorized — no per-segment loop)."""
+    order = np.lexsort((-score, seg))
+    seg_sorted = seg[order]
+    # rank within segment along the sorted layout
+    boundaries = np.flatnonzero(np.diff(seg_sorted)) + 1
+    starts = np.concatenate([[0], boundaries])
+    seg_sizes = np.diff(np.concatenate([starts, [len(seg)]]))
+    rank = np.arange(len(seg)) - np.repeat(starts, seg_sizes)
+    keep_sorted = rank < limit[seg_sorted]
+    mask = np.zeros(len(seg), dtype=bool)
+    mask[order] = keep_sorted
+    return mask
 
-    Mirrors RandomEffectDataSet.scala:254-317: keep a uniform sample of
-    ``cap`` rows as active, rescale their weights by count/cap so expected
-    total weight is preserved; the rest become passive.
+
+def _densify_chunked(sub: sp.csr_matrix, chunk: int = 1 << 16) -> np.ndarray:
+    """``sub.toarray()`` in bounded-memory row chunks (identity projection
+    on a wide shard would otherwise materialize one giant temporary on top
+    of the destination block)."""
+    r, d = sub.shape
+    out = np.zeros((r, d), dtype=np.float32)
+    for lo in range(0, r, chunk):
+        out[lo:lo + chunk] = sub[lo:lo + chunk].toarray()
+    return out
+
+
+def _project_nnz(sub: sp.csr_matrix, entity_of_row: np.ndarray,
+                 projectors: IndexMapProjectors
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Reduced column of every stored element of ``sub``, batched.
+
+    Row ``r`` of ``sub`` belongs to entity ``entity_of_row[r]``; each nnz's
+    raw column is looked up in that entity's sorted index map with ONE
+    ``searchsorted`` over a flattened (entity, raw_col) key table — the
+    vectorized inverse of ``IndexMapProjectors.project_row``. Returns
+    ``(row_of_nnz, reduced_col, valid)``; invalid elements (features the
+    entity's map dropped) must be discarded by the caller.
     """
-    n = len(rows)
-    if cap is None or n <= cap:
-        return rows, rows[:0], 1.0
-    keep = rng.choice(n, size=cap, replace=False)
-    mask = np.zeros(n, dtype=bool)
-    mask[keep] = True
-    return rows[mask], rows[~mask], n / cap
+    lens = np.diff(sub.indptr)
+    row_of = np.repeat(np.arange(sub.shape[0]), lens)
+    ent = np.asarray(entity_of_row, dtype=np.int64)[row_of]
+    d_red = projectors.max_reduced_dim
+    stride = projectors.raw_dim + 1
+    e = projectors.num_entities
+    table = (np.arange(e, dtype=np.int64)[:, None] * stride
+             + projectors.raw_indices.astype(np.int64)).ravel()
+    keys = ent * stride + sub.indices
+    pos = np.searchsorted(table, keys)
+    pos_clip = np.minimum(pos, len(table) - 1)
+    valid = table[pos_clip] == keys
+    j = pos_clip - ent * d_red
+    return row_of, j, valid
 
 
-def _select_features(mat: sp.csr_matrix, rows: np.ndarray, labels: np.ndarray,
-                     keep: Optional[int]) -> np.ndarray:
-    """Union of features in ``rows``, optionally top-``keep`` by |Pearson|.
+def _build_projectors_from_active(
+    sub: sp.csr_matrix,
+    entity_of_row: np.ndarray,
+    act_counts: np.ndarray,
+    labels: np.ndarray,
+    raw_dim: int,
+    config: RandomEffectDataConfiguration,
+    pad_to_multiple: int = 8,
+) -> IndexMapProjectors:
+    """Per-entity feature unions + optional |Pearson| top-k, in bulk.
 
-    Mirrors LocalDataSet.scala:202-248: rank features by absolute Pearson
-    correlation with the label (support count breaks ties implicitly through
-    the correlation of near-constant columns being 0).
+    One pass over the active nnz replaces E calls to ``_select_features``:
+    per-(entity, feature) sums accumulate via ``np.add.at`` on the unique
+    (entity, feature) pairs, correlations come from the moment identities
+    cov = E[xy] - E[x]E[y], var = E[x^2] - E[x]^2 (zeros contribute only
+    through the entity's row count), and the per-entity cap is a vectorized
+    rank-within-segment selection. Mirrors LocalDataSet.scala:202-248.
     """
-    sub = mat[rows]
-    present = np.unique(sub.indices) if sub.nnz else np.zeros(0, np.int64)
-    if keep is None or len(present) <= keep:
-        return present
-    sub = sub[:, present]
-    y = labels[rows].astype(np.float64)
-    Xd = np.asarray(sub.todense(), dtype=np.float64)
-    xm = Xd.mean(axis=0)
-    ym = y.mean()
-    cov = ((Xd - xm) * (y - ym)[:, None]).mean(axis=0)
-    sx = Xd.std(axis=0)
-    sy = y.std()
-    denom = sx * sy
-    corr = np.where(denom > 0, np.abs(cov) / np.where(denom > 0, denom, 1.0),
-                    0.0)
-    top = np.argsort(-corr, kind="stable")[:keep]
-    return np.sort(present[top])
+    e_real = len(act_counts)
+    lens = np.diff(sub.indptr)
+    row_of = np.repeat(np.arange(sub.shape[0]), lens)
+    ent = np.asarray(entity_of_row, dtype=np.int64)[row_of]
+    keys = ent * raw_dim + sub.indices
+    pairs, inv = np.unique(keys, return_inverse=True)
+    pair_ent = (pairs // raw_dim).astype(np.int64)
+    pair_col = (pairs % raw_dim).astype(np.int32)
+
+    # Per-entity keep limits (None -> no cap anywhere).
+    if config.num_features_to_keep_upper_bound is not None:
+        limits = np.full(e_real, config.num_features_to_keep_upper_bound,
+                         dtype=np.int64)
+    elif config.num_features_to_samples_ratio_upper_bound is not None:
+        limits = np.ceil(config.num_features_to_samples_ratio_upper_bound
+                         * act_counts).astype(np.int64)
+    else:
+        limits = None
+
+    if limits is not None:
+        # |Pearson(feature, label)| per (entity, feature) from sparse moments.
+        v = sub.data.astype(np.float64)
+        y = np.asarray(labels, dtype=np.float64)
+        s1 = np.zeros(len(pairs))
+        s2 = np.zeros(len(pairs))
+        sxy = np.zeros(len(pairs))
+        np.add.at(s1, inv, v)
+        np.add.at(s2, inv, v * v)
+        np.add.at(sxy, inv, v * y[row_of])
+        k_e = np.maximum(act_counts, 1).astype(np.float64)
+        sy1 = np.zeros(e_real)
+        sy2 = np.zeros(e_real)
+        np.add.at(sy1, np.asarray(entity_of_row, dtype=np.int64), y)
+        np.add.at(sy2, np.asarray(entity_of_row, dtype=np.int64), y * y)
+        ym = sy1 / k_e
+        y_sd = np.sqrt(np.maximum(sy2 / k_e - ym * ym, 0.0))
+        ke_p = k_e[pair_ent]
+        xm = s1 / ke_p
+        cov = sxy / ke_p - xm * ym[pair_ent]
+        var_x = np.maximum(s2 / ke_p - xm * xm, 0.0)
+        denom = np.sqrt(var_x) * y_sd[pair_ent]
+        corr = np.where(denom > 0, np.abs(cov) / np.where(denom > 0, denom,
+                                                          1.0), 0.0)
+        keep = _topk_per_segment(pair_ent, corr, limits)
+        pair_ent, pair_col = pair_ent[keep], pair_col[keep]
+        # restore (entity, column) order after the score-ranked selection
+        reorder = np.lexsort((pair_col, pair_ent))
+        pair_ent, pair_col = pair_ent[reorder], pair_col[reorder]
+
+    reduced_dims = np.bincount(pair_ent, minlength=e_real).astype(np.int32)
+    d_red = int(reduced_dims.max()) if e_real else 1
+    d_red = max(1, -(-max(d_red, 1) // pad_to_multiple) * pad_to_multiple)
+    raw_indices = np.full((e_real, d_red), raw_dim, dtype=np.int32)
+    starts = np.concatenate([[0], np.cumsum(reduced_dims)[:-1]])
+    slot = np.arange(len(pair_ent)) - starts[pair_ent]
+    raw_indices[pair_ent, slot] = pair_col
+    return IndexMapProjectors(raw_indices, reduced_dims, raw_dim)
 
 
 def build_random_effect_dataset(
@@ -447,49 +529,59 @@ def build_random_effect_dataset(
     if id_type not in data.id_columns:
         raise KeyError(f"id type {id_type!r} not in dataset (have "
                        f"{list(data.id_columns)})")
-    codes = data.id_columns[id_type]
-    mat = data.feature_shards[config.feature_shard_id]
+    codes = np.asarray(data.id_columns[id_type])
+    mat = data.feature_shards[config.feature_shard_id].tocsr()
     n, raw_dim = mat.shape
     rng = np.random.default_rng(seed)
 
-    # --- group rows by entity (host): one argsort, contiguous slices.
-    order = np.argsort(codes, kind="stable")
+    # --- group + reservoir split in one lexsort: rows ordered by
+    # (entity, random key), so the first `cap` rows of each group ARE a
+    # uniform sample (RandomEffectDataSet.scala:254-317's reservoir,
+    # vectorized). No per-entity Python loop anywhere below.
+    order = np.lexsort((rng.random(n), codes))
     sorted_codes = codes[order]
-    uniq, starts = np.unique(sorted_codes, return_index=True)
-    bounds = np.append(starts, n)
-    groups = {int(uniq[i]): order[bounds[i]:bounds[i + 1]]
-              for i in range(len(uniq))}
+    uniq, starts, group_sizes = np.unique(
+        sorted_codes, return_index=True, return_counts=True)
+    e_real = len(uniq)
+    grp_of_sorted = np.repeat(np.arange(e_real), group_sizes)
+    pos_in_group = np.arange(n) - starts[grp_of_sorted]
 
-    # --- active/passive split with reservoir cap + weight rescale.
     cap = config.num_active_data_points_upper_bound
-    active: dict[int, tuple[np.ndarray, float]] = {}
-    passive_rows: list[np.ndarray] = []
-    passive_codes: list[np.ndarray] = []
-    for code, rows in groups.items():
-        act, pas, scale = _reservoir_cap(rng, rows, cap)
-        active[code] = (act, scale)
-        lo = config.num_passive_data_points_lower_bound
-        if len(pas) and (lo is None or len(pas) >= lo):
-            passive_rows.append(pas)
-            passive_codes.append(np.full(len(pas), code, dtype=np.int64))
+    if cap is None:
+        active_mask = np.ones(n, dtype=bool)
+        act_counts = group_sizes
+    else:
+        active_mask = pos_in_group < cap
+        act_counts = np.minimum(group_sizes, cap)
+    # weight rescale count/cap preserves expected total weight per entity
+    group_scale = group_sizes / np.maximum(act_counts, 1)
+
+    lo = config.num_passive_data_points_lower_bound
+    pas_counts = group_sizes - act_counts
+    keep_passive_group = (pas_counts > 0 if lo is None
+                          else pas_counts >= lo)
+    passive_mask = ~active_mask & keep_passive_group[grp_of_sorted]
 
     # --- load-balanced entity ordering for contiguous sharding.
-    ent_codes = np.asarray(sorted(active), dtype=np.int64)
-    counts = np.asarray([len(active[int(c)][0]) for c in ent_codes])
-    perm = balanced_entity_order(counts, num_bins=max(1, entity_axis_size))
-    ent_codes = ent_codes[perm]
+    perm = balanced_entity_order(act_counts, num_bins=max(1, entity_axis_size))
+    ent_codes = uniq[perm].astype(np.int64)
+    inv_perm = np.empty(e_real, dtype=np.int64)
+    inv_perm[perm] = np.arange(e_real)
+
+    rows_act = order[active_mask]  # dataset row ids of active rows
+    ent_of_act = inv_perm[grp_of_sorted[active_mask]]  # local entity index
+    slot_of_act = pos_in_group[active_mask]
+    counts = act_counts[perm]  # active rows per local entity
 
     # --- per-entity feature space (projection).
     proj_cfg = config.projector
     projectors = None
     random_projector = None
+    sub = mat[rows_act]  # one bulk CSR row gather, row r <-> active row r
     if proj_cfg.kind == ProjectorType.INDEX_MAP:
-        feats = [
-            _select_features(mat, active[int(c)][0], data.responses,
-                             config.features_to_keep(len(active[int(c)][0])))
-            for c in ent_codes
-        ]
-        projectors = build_index_map_projectors(feats, raw_dim)
+        projectors = _build_projectors_from_active(
+            sub, ent_of_act, counts, data.responses[rows_act], raw_dim,
+            config)
         d_red = projectors.max_reduced_dim
     elif proj_cfg.kind == ProjectorType.RANDOM:
         random_projector = build_random_projector(
@@ -499,7 +591,6 @@ def build_random_effect_dataset(
         d_red = raw_dim
 
     # --- pad E to the entity axis and N to a stable multiple.
-    e_real = len(ent_codes)
     e_pad = max(1, -(-max(e_real, 1) // entity_axis_size) * entity_axis_size)
     n_max = int(counts.max()) if e_real else 1
     n_max = max(1, -(-n_max // pad_rows_multiple) * pad_rows_multiple)
@@ -510,47 +601,38 @@ def build_random_effect_dataset(
     weights = np.zeros((e_pad, n_max), dtype=np.float32)
     row_ids = np.full((e_pad, n_max), n, dtype=np.int32)
 
-    for e_i, code in enumerate(ent_codes):
-        rows, scale = active[int(code)]
-        k = len(rows)
-        sub = mat[rows]
-        if projectors is not None:
-            cols = projectors.raw_indices[e_i]
-            valid = cols < raw_dim
-            dense = np.zeros((k, d_red), dtype=np.float32)
-            if valid.any():
-                dense[:, valid] = np.asarray(
-                    sub[:, cols[valid]].todense(), dtype=np.float32)
-            X[e_i, :k] = dense
-        elif random_projector is not None:
-            X[e_i, :k] = (sub @ random_projector.matrix).astype(np.float32)
-        else:
-            X[e_i, :k] = np.asarray(sub.todense(), dtype=np.float32)
-        labels[e_i, :k] = data.responses[rows]
-        offsets[e_i, :k] = data.offsets[rows]
-        weights[e_i, :k] = data.weights[rows] * scale
-        row_ids[e_i, :k] = rows
+    labels[ent_of_act, slot_of_act] = data.responses[rows_act]
+    offsets[ent_of_act, slot_of_act] = data.offsets[rows_act]
+    weights[ent_of_act, slot_of_act] = (
+        data.weights[rows_act] * group_scale[grp_of_sorted[active_mask]])
+    row_ids[ent_of_act, slot_of_act] = rows_act
+
+    if projectors is not None:
+        nnz_row, nnz_j, nnz_ok = _project_nnz(sub, ent_of_act, projectors)
+        X[ent_of_act[nnz_row[nnz_ok]], slot_of_act[nnz_row[nnz_ok]],
+          nnz_j[nnz_ok]] = sub.data[nnz_ok]
+    elif random_projector is not None:
+        X[ent_of_act, slot_of_act] = (
+            sub @ random_projector.matrix).astype(np.float32)
+    else:
+        X[ent_of_act, slot_of_act] = _densify_chunked(sub)
 
     # --- passive side (sample-major, already projected per entity).
     p_X = p_ent = p_rows = p_off = None
-    if passive_rows:
-        pr = np.concatenate(passive_rows)
-        pc = np.concatenate(passive_codes)
-        code_to_local = {int(c): i for i, c in enumerate(ent_codes)}
-        local = np.asarray([code_to_local[int(c)] for c in pc], dtype=np.int32)
-        sub = mat[pr]
+    if passive_mask.any():
+        pr = order[passive_mask]
+        local = inv_perm[grp_of_sorted[passive_mask]].astype(np.int32)
+        sub_p = mat[pr]
         if projectors is not None:
             dense = np.zeros((len(pr), d_red), dtype=np.float32)
-            for j in range(len(pr)):
-                r = sub[j]
-                dense[j] = projectors.project_row(
-                    int(local[j]), r.indices, r.data)
+            nnz_row, nnz_j, nnz_ok = _project_nnz(sub_p, local, projectors)
+            dense[nnz_row[nnz_ok], nnz_j[nnz_ok]] = sub_p.data[nnz_ok]
             p_X = jnp.asarray(dense)
         elif random_projector is not None:
-            p_X = jnp.asarray((sub @ random_projector.matrix)
+            p_X = jnp.asarray((sub_p @ random_projector.matrix)
                               .astype(np.float32))
         else:
-            p_X = jnp.asarray(np.asarray(sub.todense(), dtype=np.float32))
+            p_X = jnp.asarray(_densify_chunked(sub_p))
         p_ent = jnp.asarray(local)
         p_rows = jnp.asarray(pr.astype(np.int32))
         p_off = jnp.asarray(data.offsets[pr].astype(np.float32))
