@@ -7,10 +7,10 @@ models followed by ``mapValues(localProblem.run)``, i.e. one Breeze L-BFGS per
 entity running data-local on a Spark executor).
 
 Here every entity's subproblem lives in one padded tensor
-``[E, N_max, D_red]`` and the *same* jitted L-BFGS/OWL-QN kernel
-(optimize/lbfgs.py) is ``vmap``ped over the entity axis — XLA batches the
-two-loop recursion and line search across entities, so thousands of tiny
-solves become large MXU matmuls. Sharding the entity axis over the mesh
+``[E, N_max, D_red]`` and the *same* jitted solver kernels
+(optimize/lbfgs.py, owlqn.py, tron.py) are ``vmap``ped over the entity
+axis — XLA batches the two-loop recursion / line search / trust-region CG
+across entities, so thousands of tiny solves become large MXU matmuls. Sharding the entity axis over the mesh
 (``pjit``) reproduces Spark's embarrassing parallelism with zero communication
 in the hot loop (SURVEY §2.2, §5.8).
 
@@ -42,6 +42,7 @@ from photon_ml_tpu.optimize.config import (
 )
 from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
 from photon_ml_tpu.optimize.owlqn import minimize_owlqn
+from photon_ml_tpu.optimize.tron import minimize_tron
 
 Array = jnp.ndarray
 
@@ -51,7 +52,12 @@ def _vg(w, payload):
     return obj.calculate(w, batch)
 
 
-@partial(jax.jit, static_argnames=("use_owlqn", "max_iter", "tolerance"))
+def _hvp(w, v, payload):
+    obj, batch = payload
+    return obj.hessian_vector(w, v, batch)
+
+
+@partial(jax.jit, static_argnames=("solver", "max_iter", "tolerance"))
 def _fit_blocks(
     X: Array,
     labels: Array,
@@ -60,18 +66,22 @@ def _fit_blocks(
     initial: Array,
     obj: GLMObjective,
     l1: Array,
-    use_owlqn: bool,
+    solver: str,
     max_iter: int,
     tolerance: float,
 ):
     """vmapped solve over entity blocks; returns (coefs [E,D], iters [E],
-    final loss values [E])."""
+    final loss values [E]). ``solver`` is one of "lbfgs"/"owlqn"/"tron"."""
 
     def solve_one(Xe, ye, oe, we, x0):
         batch = DenseBatch(X=Xe, labels=ye, offsets=oe, weights=we)
-        if use_owlqn:
+        if solver == "owlqn":
             x, hist, _ = minimize_owlqn(
                 _vg, x0, (obj, batch), l1=l1,
+                max_iter=max_iter, tolerance=tolerance)
+        elif solver == "tron":
+            x, hist, _ = minimize_tron(
+                _vg, _hvp, x0, (obj, batch),
                 max_iter=max_iter, tolerance=tolerance)
         else:
             x, hist, _ = minimize_lbfgs(
@@ -115,21 +125,33 @@ class RandomEffectOptimizationProblem:
         final losses [E]).
 
         ``offsets`` is the entity-major offset block (base offsets + other
-        coordinates' scores). TRON falls back to L-BFGS here: per-entity
-        problems are tiny and the batched CG inner loop is not worth its
-        compile cost (the reference likewise defaults random effects to
-        L-BFGS/OWL-QN in practice).
+        coordinates' scores). All three solvers run batched under ``vmap``:
+        TRON's trust-region/CG loop nest is the same ``lax.while_loop``
+        program per entity lane (OptimizerFactory.scala:69-77 allows TRON
+        for single-node problems; TRON.scala:84-341). As in the reference,
+        TRON requires a twice-differentiable loss, so smoothed-hinge + TRON
+        is rejected (OptimizerFactory.scala:78-79).
         """
         cfg = self.config
         e, _, d = dataset.X.shape
         x0 = (jnp.zeros((e, d), dataset.X.dtype)
               if initial is None else initial)
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
-        use_owlqn = (cfg.optimizer_type != OptimizerType.TRON and l1 > 0.0)
+        if cfg.optimizer_type == OptimizerType.TRON:
+            if self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+                raise ValueError(
+                    "TRON requires a twice-differentiable loss; the smoothed "
+                    "hinge (linear SVM) task has no usable Hessian "
+                    "(OptimizerFactory.scala:78-79). Use LBFGS instead.")
+            solver = "tron"
+        elif l1 > 0.0:
+            solver = "owlqn"
+        else:
+            solver = "lbfgs"
         coefs, iters, values = _fit_blocks(
             dataset.X, dataset.labels, offsets, dataset.weights, x0,
             self.objective(), jnp.full(d, l1, dataset.X.dtype),
-            use_owlqn, cfg.max_iterations, float(cfg.tolerance))
+            solver, cfg.max_iterations, float(cfg.tolerance))
         return coefs, iters, values
 
     def regularization_value(self, coefs: Array) -> float:

@@ -146,6 +146,35 @@ class TestRandomEffectDataset:
         assert cfg2.num_features_to_samples_ratio_upper_bound is None
         assert cfg2.features_to_keep(10) is None
 
+    def test_duplicate_csr_entries_summed(self):
+        # Non-canonical CSR (duplicate (row,col) entries) must behave as the
+        # summed matrix: the block fill scatters mat.data by (row, col), so
+        # GameDataset canonicalizes shards up front.
+        data_v = np.array([1.0, 2.0, 5.0])
+        indices = np.array([3, 3, 0])
+        indptr = np.array([0, 2, 3])
+        mat = sp.csr_matrix((data_v, indices, indptr), shape=(2, 4))
+        assert not mat.has_canonical_format
+        ds = GameDataset(responses=np.array([1.0, 0.0]),
+                         feature_shards={"s": mat})
+        ds.encode_ids("u", np.array([0, 0]))
+        assert ds.feature_shards["s"].has_canonical_format
+        assert not mat.has_canonical_format  # caller's matrix untouched
+        re_ds = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("u", "s", 1))
+        X = np.asarray(re_ds.X)[0]  # [N_max, d_red]
+        row_ids = np.asarray(re_ds.row_ids)[0]  # slot -> raw dataset row
+        # raw row 0 must carry 3.0 (=1+2) at col 3, raw row 1 carries 5.0
+        # at col 0 (reservoir sort may permute rows within the entity).
+        dense = np.zeros((2, 4), np.float32)
+        ri = re_ds.projectors.raw_indices[0]
+        for slot, col in enumerate(ri):
+            if col < 4:
+                for s in range(2):
+                    dense[row_ids[s], col] = X[s, slot]
+        np.testing.assert_allclose(dense[0], [0, 0, 0, 3.0])
+        np.testing.assert_allclose(dense[1], [5.0, 0, 0, 0])
+
     def test_balanced_entity_order(self):
         counts = np.array([100, 1, 1, 1, 50, 49, 1, 1])
         perm = balanced_entity_order(counts, num_bins=2)
@@ -194,6 +223,49 @@ class TestRandomEffectSolver:
             for i in range(data.num_samples)])
         np.testing.assert_allclose(np.asarray(s), expected, rtol=1e-4,
                                    atol=1e-5)
+
+    def test_tron_matches_lbfgs_per_entity(self, rng):
+        # Per-entity TRON (TRON.scala:84-341 under vmap) must land on the
+        # same per-entity optima as L-BFGS, mirroring the reference's
+        # TRON-vs-LBFGS max-difference discipline (BaseGLMIntegTest.scala).
+        data, _, W_e, users = make_game_data(rng, n=400, n_entities=6,
+                                             task="logistic")
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration("userId", "per_user", 1))
+
+        def cfg(opt):
+            return GLMOptimizationConfiguration(
+                max_iterations=60, tolerance=1e-10,
+                regularization_weight=0.1, optimizer_type=opt,
+                regularization_context=RegularizationContext(
+                    RegularizationType.L2))
+
+        task = TaskType.LOGISTIC_REGRESSION
+        c_tron, it_tron, v_tron = RandomEffectOptimizationProblem(
+            config=cfg(OptimizerType.TRON), task=task).run(
+                ds, ds.base_offsets)
+        c_lbfgs, _, v_lbfgs = RandomEffectOptimizationProblem(
+            config=cfg(OptimizerType.LBFGS), task=task).run(
+                ds, ds.base_offsets)
+        assert int(np.min(np.asarray(it_tron))) > 0  # TRON actually iterated
+        np.testing.assert_allclose(np.asarray(c_tron), np.asarray(c_lbfgs),
+                                   atol=2e-3)
+        np.testing.assert_allclose(np.asarray(v_tron), np.asarray(v_lbfgs),
+                                   rtol=1e-5)
+
+    def test_tron_rejects_smoothed_hinge(self, rng):
+        data, *_ = make_game_data(rng, n=100, n_entities=3)
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration("userId", "per_user", 1))
+        prob = RandomEffectOptimizationProblem(
+            config=GLMOptimizationConfiguration(
+                max_iterations=10, tolerance=1e-6, regularization_weight=1.0,
+                optimizer_type=OptimizerType.TRON,
+                regularization_context=RegularizationContext(
+                    RegularizationType.L2)),
+            task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+        with pytest.raises(ValueError, match="twice-differentiable"):
+            prob.run(ds, ds.base_offsets)
 
     def test_passive_data_scored(self, rng):
         data, *_ = make_game_data(rng, n=300, n_entities=3, task="linear")
