@@ -31,6 +31,9 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--format", default="TRAINING_EXAMPLE",
                    choices=["TRAINING_EXAMPLE", "RESPONSE_PREDICTION"],
                    help="legacy mode: which field naming to scan")
+    p.add_argument("--offheap", default="true",
+                   help="also write the memmap-served off-heap store "
+                        "(consumed via --offheap-indexmap-dir)")
     return p.parse_args(argv)
 
 
@@ -50,7 +53,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         feature_shard_sections=shard_sections,
         field_names=field_names,
         add_intercept=add_intercept,
-        num_partitions=ns.num_partitions)
+        num_partitions=ns.num_partitions,
+        offheap=str(ns.offheap).lower() in ("true", "1"))
     for ns_name, imap in built.items():
         print(f"{ns_name}: {len(imap)} features")
 

@@ -57,6 +57,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--model-id", default="")
     p.add_argument("--delete-output-dir-if-exists", default="false")
     p.add_argument("--application-name", default="game-scoring")
+    p.add_argument("--offheap-indexmap-dir",
+                   help="pre-built off-heap feature index store (one "
+                        "namespace per feature shard)")
+    p.add_argument("--offheap-indexmap-num-partitions", type=int,
+                   default=None,
+                   help="must match the partition count the store was built "
+                        "with (validated against the store's meta)")
     return p.parse_args(argv)
 
 
@@ -91,7 +98,15 @@ class GameScoringDriver:
         index_maps = {}
         all_sections = sorted({s for secs in self.section_keys.values()
                                for s in secs})
-        if ns.feature_name_and_term_set_path:
+        if getattr(ns, "offheap_indexmap_dir", None):
+            from photon_ml_tpu.io.feature_index_job import load_feature_index
+
+            index_maps.update(load_feature_index(
+                ns.offheap_indexmap_dir, sorted(self.section_keys),
+                offheap=True,
+                expected_partitions=getattr(
+                    ns, "offheap_indexmap_num_partitions", None)))
+        elif ns.feature_name_and_term_set_path:
             sets = NameAndTermFeatureSets.load(
                 ns.feature_name_and_term_set_path, all_sections)
             for shard, sections in self.section_keys.items():

@@ -171,6 +171,14 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--compute-variance", default="false")
     p.add_argument("--delete-output-dir-if-exists", default="false")
     p.add_argument("--application-name", default="game-training")
+    p.add_argument("--offheap-indexmap-dir",
+                   help="pre-built off-heap feature index store "
+                        "(one namespace per feature shard); skips scanning "
+                        "the data for features")
+    p.add_argument("--offheap-indexmap-num-partitions", type=int,
+                   default=None,
+                   help="must match the partition count the store was built "
+                        "with (validated against the store's meta)")
     p.add_argument("--checkpoint-dir",
                    help="snapshot coordinate states after each CD sweep "
                         "and auto-resume from the latest snapshot "
@@ -219,8 +227,22 @@ class GameTrainingDriver:
     # -- pipeline ----------------------------------------------------------
 
     def prepare_feature_maps(self) -> None:
-        """GAMEDriver.prepareFeatureMaps: per-shard index maps from the
-        feature name-and-term sets (default in-heap path)."""
+        """GAMEDriver.prepareFeatureMaps: per-shard index maps — off-heap
+        store when --offheap-indexmap-dir is given (GAMEDriver.scala:90-97
+        prepareFeatureMapsPalDB), else built from the feature name-and-term
+        sets (default in-heap path)."""
+        if getattr(self.ns, "offheap_indexmap_dir", None):
+            from photon_ml_tpu.io.feature_index_job import load_feature_index
+
+            self.index_maps.update(load_feature_index(
+                self.ns.offheap_indexmap_dir, sorted(self.section_keys),
+                offheap=True,
+                expected_partitions=getattr(
+                    self.ns, "offheap_indexmap_num_partitions", None)))
+            self.logger.info(
+                f"off-heap feature maps: "
+                f"{ {k: len(v) for k, v in self.index_maps.items()} }")
+            return
         all_sections = sorted({s for secs in self.section_keys.values()
                                for s in secs})
         if self.ns.feature_name_and_term_set_path:

@@ -54,6 +54,7 @@ from photon_ml_tpu.io.data_format import (
     load_libsvm,
     parse_constraint_map,
 )
+from photon_ml_tpu.io.index_map import OffHeapIndexMap
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.io.model_io import write_models_text
 from photon_ml_tpu.ops.normalization import (
@@ -132,6 +133,7 @@ class LegacyParams:
     delete_output_dirs_if_exist: bool = False
     event_listeners: Sequence[str] = ()
     offheap_indexmap_dir: Optional[str] = None
+    offheap_indexmap_num_partitions: Optional[int] = None
 
     def validate(self) -> None:
         """Params.validate :201 analog."""
@@ -195,6 +197,10 @@ def parse_args(argv: Sequence[str]) -> LegacyParams:
     p.add_argument("--delete-output-dirs-if-exist", default="false")
     p.add_argument("--event-listeners", default="")
     p.add_argument("--offheap-indexmap-dir")
+    p.add_argument("--offheap-indexmap-num-partitions", type=int,
+                   default=None,
+                   help="must match the partition count the store was built "
+                        "with (validated against the store's meta)")
     # Spark-era flags: accepted, ignored (XLA replaces them).
     p.add_argument("--kryo", default="true", help=argparse.SUPPRESS)
     p.add_argument("--min-partitions", type=int, default=1,
@@ -236,6 +242,7 @@ def parse_args(argv: Sequence[str]) -> LegacyParams:
         delete_output_dirs_if_exist=as_bool(ns.delete_output_dirs_if_exist),
         event_listeners=[x for x in ns.event_listeners.split(",") if x],
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
     )
     params.validate()
     return params
@@ -285,6 +292,16 @@ class LegacyDriver(EventEmitter):
                        else RESPONSE_PREDICTION_FIELD_NAMES)
         index_map = (self.train_data.index_map
                      if self.train_data is not None else None)
+        if index_map is None and p.offheap_indexmap_dir:
+            # InputFormatFactory.scala:49-60: an off-heap dir switches the
+            # suite to the pre-built PalDB store instead of scanning data
+            # for features; here the memmap store (OffHeapIndexMap).
+            index_map = OffHeapIndexMap(
+                p.offheap_indexmap_dir, namespace="global",
+                expected_partitions=p.offheap_indexmap_num_partitions)
+            self.logger.info(
+                f"off-heap index map: {len(index_map)} features from "
+                f"{p.offheap_indexmap_dir}")
         return load_labeled_points_avro(
             path, field_names, index_map=index_map,
             selected_features_file=p.selected_features_file,

@@ -157,9 +157,9 @@ def load_labeled_points_avro(
             # index map (GLMSuite's selected-feature semantics)
             if selected is not None and key not in selected:
                 continue
-            if key not in index_map:
-                continue
             j = index_map.index_of(key)
+            if j < 0:
+                continue
             if j in seen:
                 raise ValueError(f"Duplicate feature {key!r} in record {i}")
             seen.add(j)
@@ -400,6 +400,11 @@ def load_game_dataset_avro(
         shard: ([], [], []) for shard in feature_shard_sections}
     id_values: dict[str, list] = {t: [] for t in id_types}
 
+    # hoisted per-shard lookups: index_of probes on an OffHeapIndexMap cost
+    # a hash + memmap search each, so probe once per feature and cache the
+    # intercept index outside the record loop
+    intercepts = {shard: index_maps[shard].intercept_index
+                  for shard in feature_shard_sections}
     for i, rec in enumerate(records):
         if rec.get(RESPONSE) is not None:
             responses[i] = float(rec[RESPONSE])
@@ -425,9 +430,9 @@ def load_game_dataset_avro(
                         f"list (or is null)")
                 for f in entries:
                     key = feature_key(f[NAME], f.get(TERM) or "")
-                    if key not in imap:
-                        continue
                     j = imap.index_of(key)
+                    if j < 0:
+                        continue
                     if j in seen:
                         raise ValueError(
                             f"Duplicate feature {key!r} in record {i} for "
@@ -436,9 +441,9 @@ def load_game_dataset_avro(
                     rows.append(i)
                     cols.append(j)
                     vals.append(float(f[VALUE]))
-            if imap.intercept_index is not None:
+            if intercepts[shard] is not None:
                 rows.append(i)
-                cols.append(imap.intercept_index)
+                cols.append(intercepts[shard])
                 vals.append(1.0)
 
     shards = {}

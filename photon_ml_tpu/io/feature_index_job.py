@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 
 from photon_ml_tpu.io.avro import read_records as _read_records
 from photon_ml_tpu.io.data_format import NAME, TERM, FieldNames
-from photon_ml_tpu.io.index_map import IndexMap, feature_key
+from photon_ml_tpu.io.index_map import IndexMap, OffHeapIndexMap, feature_key
 
 
 def build_feature_index(
@@ -28,7 +28,8 @@ def build_feature_index(
         feature_shard_sections: Optional[dict[str, Sequence[str]]] = None,
         field_names: Optional[FieldNames] = None,
         add_intercept: bool = True,
-        num_partitions: int = 1) -> dict[str, IndexMap]:
+        num_partitions: int = 1,
+        offheap: bool = False) -> dict[str, IndexMap]:
     """Scan data → distinct feature keys → partitioned index-map stores.
 
     Two modes, matching the reference's legacy vs GAME usage:
@@ -37,18 +38,28 @@ def build_feature_index(
     - ``feature_shard_sections`` set: one map per feature shard over the
       union of its sections, saved under the shard id as namespace
       (the GAME per-shard feature-list layout).
+
+    ``offheap=True`` additionally writes the memmap-served
+    :class:`OffHeapIndexMap` store (the PalDB output the reference job
+    always produces), which the drivers consume via
+    ``--offheap-indexmap-dir``.
     """
     records = _read_records(input_path)
     out: dict[str, IndexMap] = {}
+
+    def _emit(keys, namespace):
+        imap = IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
+        imap.save(output_dir, num_partitions, namespace=namespace)
+        if offheap:
+            imap.save_offheap(output_dir, num_partitions, namespace=namespace)
+        out[namespace] = imap
 
     if field_names is not None:
         keys = set()
         for rec in records:
             for f in rec.get(field_names.features) or []:
                 keys.add(feature_key(f[NAME], f.get(TERM) or ""))
-        imap = IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
-        imap.save(output_dir, num_partitions, namespace="global")
-        out["global"] = imap
+        _emit(keys, "global")
 
     for shard, sections in (feature_shard_sections or {}).items():
         keys = set()
@@ -56,14 +67,27 @@ def build_feature_index(
             for section in sections:
                 for f in rec.get(section) or []:
                     keys.add(feature_key(f[NAME], f.get(TERM) or ""))
-        imap = IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
-        imap.save(output_dir, num_partitions, namespace=shard)
-        out[shard] = imap
+        _emit(keys, shard)
 
     return out
 
 
-def load_feature_index(directory: str, namespaces: Sequence[str]
-                       ) -> dict[str, IndexMap]:
-    """Load previously built stores (PalDBIndexMapLoader analog)."""
-    return {ns: IndexMap.load(directory, namespace=ns) for ns in namespaces}
+def load_feature_index(directory: str, namespaces: Sequence[str],
+                       offheap: Optional[bool] = None,
+                       expected_partitions: Optional[int] = None) -> dict:
+    """Load previously built stores (PalDBIndexMapLoader analog).
+
+    ``offheap=None`` auto-detects: a namespace with an off-heap meta file
+    loads as a memmap-served :class:`OffHeapIndexMap`, else the JSON store
+    is read fully (in-heap DefaultIndexMap behavior). ``expected_partitions``
+    is validated against each off-heap store's meta when given.
+    """
+    out: dict = {}
+    for ns in namespaces:
+        has_offheap = os.path.exists(
+            os.path.join(directory, f"{ns}-offheap-meta.json"))
+        use = has_offheap if offheap is None else offheap
+        out[ns] = (OffHeapIndexMap(directory, namespace=ns,
+                                   expected_partitions=expected_partitions)
+                   if use else IndexMap.load(directory, namespace=ns))
+    return out

@@ -247,6 +247,62 @@ def test_feature_index_job(tmp_path):
     assert dict(loaded["user"].items()) == dict(built["user"].items())
 
 
+def test_offheap_index_map_roundtrip(tmp_path):
+    from photon_ml_tpu.io.index_map import OffHeapIndexMap, stable_hash64
+
+    keys = [feature_key(f"name{i}", f"term{i % 7}") for i in range(500)]
+    imap = IndexMap.from_keys(keys, add_intercept=True)
+    store = str(tmp_path / "offheap")
+    imap.save_offheap(store, num_partitions=3, namespace="global")
+    oh = OffHeapIndexMap(store, namespace="global")
+
+    assert len(oh) == len(imap)
+    for k, v in imap.items():
+        assert oh.index_of(k) == v
+        assert oh.key_of(v) == k
+        assert k in oh
+    assert oh.index_of("absent\x01key") == -1
+    assert "nope" not in oh
+    assert oh.intercept_index == imap.intercept_index
+    assert dict(oh.items()) == dict(imap.items())
+
+    # partition layout is process-stable: files only reference blake2b
+    # hashes, never the salted builtin hash
+    h = stable_hash64(keys[0])
+    assert h == stable_hash64(keys[0])
+    # reload in a "new process" (fresh object) sees identical layout
+    oh2 = OffHeapIndexMap(store, namespace="global")
+    assert oh2.index_of(keys[123]) == imap.index_of(keys[123])
+
+    # the partition-count flag is validated against the store's meta
+    assert len(OffHeapIndexMap(store, "global", expected_partitions=3)) \
+        == len(imap)
+    with pytest.raises(ValueError, match="3 partitions"):
+        OffHeapIndexMap(store, "global", expected_partitions=8)
+
+
+def test_feature_index_job_offheap_autodetect(tmp_path):
+    from photon_ml_tpu.io.avro import write_container
+    from photon_ml_tpu.io.feature_index_job import (
+        build_feature_index,
+        load_feature_index,
+    )
+    from photon_ml_tpu.io.index_map import OffHeapIndexMap
+
+    path = str(tmp_path / "game.avro")
+    write_container(path, _GAME_SCHEMA, _game_records())
+    out = str(tmp_path / "index")
+    built = build_feature_index(
+        path, out,
+        feature_shard_sections={"global": ["globalFeatures"],
+                                "user": ["userFeatures"]},
+        num_partitions=2, offheap=True)
+    loaded = load_feature_index(out, ["global", "user"])
+    assert isinstance(loaded["global"], OffHeapIndexMap)
+    assert dict(loaded["global"].items()) == dict(built["global"].items())
+    assert dict(loaded["user"].items()) == dict(built["user"].items())
+
+
 def test_libsvm_leading_space_and_junk_files(tmp_path):
     d = tmp_path / "libsvm-dir"
     d.mkdir()

@@ -248,6 +248,72 @@ class TestGameDrivers:
         assert os.path.isdir(os.path.join(out, "best"))
 
 
+class TestOffHeapIndexMapFlow:
+    """FeatureIndexingJob → --offheap-indexmap-dir consumption, both driver
+    families (InputFormatFactory.scala:49-60, GAMEDriver.scala:90-97)."""
+
+    def test_legacy_driver_consumes_offheap_store(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        X, y = _make_binary_avro(train, n=250, seed=7)
+        index_dir = str(tmp_path / "index")
+        index_main([
+            "--input-paths", train,
+            "--output-dir", index_dir,
+            "--num-partitions", "3",
+            "--format", "TRAINING_EXAMPLE",
+            "--offheap", "true",
+        ])
+        out = str(tmp_path / "out")
+        legacy_main([
+            "--training-data-directory", train,
+            "--output-directory", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "1",
+            "--num-iterations", "30",
+            "--offheap-indexmap-dir", index_dir,
+            "--offheap-indexmap-num-partitions", "3",
+        ])
+        models = read_models_text(os.path.join(out, "output"))
+        assert models
+        # the map actually served lookups: learned dim == store size
+        from photon_ml_tpu.io.index_map import OffHeapIndexMap
+        oh = OffHeapIndexMap(index_dir, namespace="global")
+        (lam, glm), = models
+        assert len(glm.coefficients.means) == len(oh)
+
+    def test_game_driver_consumes_offheap_store(self, tmp_path):
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=200, seed=8)
+        index_dir = str(tmp_path / "index")
+        index_main([
+            "--input-paths", train,
+            "--output-dir", index_dir,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--num-partitions", "2",
+            "--offheap", "true",
+        ])
+        out = str(tmp_path / "out")
+        game_main([
+            "--train-input-dirs", train,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:20,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations", "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:20,1e-7,1.0,1,LBFGS,L2",
+            "--offheap-indexmap-dir", index_dir,
+        ])
+        assert os.path.isdir(os.path.join(out, "best", "fixed-effect",
+                                          "fixed"))
+
+
 class TestFeatureIndexingCli:
     def test_game_mode(self, tmp_path, capsys):
         train = str(tmp_path / "train.avro")
