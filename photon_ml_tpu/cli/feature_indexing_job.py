@@ -15,6 +15,7 @@ from photon_ml_tpu.io.data_format import (
     TRAINING_EXAMPLE_FIELD_NAMES,
 )
 from photon_ml_tpu.io.feature_index_job import build_feature_index
+from photon_ml_tpu.utils import parse_flag
 from photon_ml_tpu.utils.compile_cache import (
     enable_persistent_compile_cache,
 )
@@ -40,7 +41,7 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
 def main(argv: Optional[Sequence[str]] = None) -> None:
     enable_persistent_compile_cache()
     ns = parse_args(argv if argv is not None else sys.argv[1:])
-    add_intercept = str(ns.add_intercept).lower() in ("true", "1")
+    add_intercept = parse_flag(ns.add_intercept)
     shard_sections = _parse_section_keys_map(
         ns.feature_shard_id_to_feature_section_keys_map) or None
     field_names = None
@@ -54,7 +55,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         field_names=field_names,
         add_intercept=add_intercept,
         num_partitions=ns.num_partitions,
-        offheap=str(ns.offheap).lower() in ("true", "1"))
+        offheap=parse_flag(ns.offheap))
     for ns_name, imap in built.items():
         print(f"{ns_name}: {len(imap)} features")
 

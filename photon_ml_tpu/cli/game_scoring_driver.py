@@ -24,6 +24,7 @@ from photon_ml_tpu.io.data_format import (
     load_game_dataset_avro,
 )
 from photon_ml_tpu.io.model_io import load_game_model, save_scored_items
+from photon_ml_tpu.utils import parse_flag
 from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
 from photon_ml_tpu.utils.compile_cache import (
     enable_persistent_compile_cache,
@@ -78,7 +79,7 @@ class GameScoringDriver:
         self.section_keys = _parse_section_keys_map(
             ns.feature_shard_id_to_feature_section_keys_map)
         self.intercept_map = {
-            k: v.strip().lower() in ("true", "1")
+            k: parse_flag(v)
             for k, v in _parse_key_value_map(
                 ns.feature_shard_id_to_intercept_map).items()}
         self.evaluators = [EvaluatorSpec.parse(x)
@@ -88,7 +89,7 @@ class GameScoringDriver:
     def run(self) -> np.ndarray:
         ns = self.ns
         if os.path.isdir(ns.output_dir) and os.listdir(ns.output_dir):
-            if str(ns.delete_output_dir_if_exists).lower() in ("true", "1"):
+            if parse_flag(ns.delete_output_dir_if_exists):
                 import shutil
                 shutil.rmtree(ns.output_dir)
         os.makedirs(ns.output_dir, exist_ok=True)
@@ -103,7 +104,6 @@ class GameScoringDriver:
 
             index_maps.update(load_feature_index(
                 ns.offheap_indexmap_dir, sorted(self.section_keys),
-                offheap=True,
                 expected_partitions=getattr(
                     ns, "offheap_indexmap_num_partitions", None)))
         elif ns.feature_name_and_term_set_path:

@@ -70,6 +70,7 @@ from photon_ml_tpu.optimize.config import (
     TaskType,
 )
 from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.utils import parse_flag
 from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
 from photon_ml_tpu.utils.compile_cache import (
     enable_persistent_compile_cache,
@@ -198,7 +199,7 @@ class GameTrainingDriver:
         self.section_keys = _parse_section_keys_map(
             ns.feature_shard_id_to_feature_section_keys_map)
         self.intercept_map = {
-            k: v.strip().lower() in ("true", "1")
+            k: parse_flag(v)
             for k, v in _parse_key_value_map(
                 ns.feature_shard_id_to_intercept_map).items()}
         self.updating_sequence = [
@@ -236,7 +237,6 @@ class GameTrainingDriver:
 
             self.index_maps.update(load_feature_index(
                 self.ns.offheap_indexmap_dir, sorted(self.section_keys),
-                offheap=True,
                 expected_partitions=getattr(
                     self.ns, "offheap_indexmap_num_partitions", None)))
             self.logger.info(
@@ -297,7 +297,7 @@ class GameTrainingDriver:
         with this grid point's optimization configs."""
         coords = {}
         compute_variance = (
-            str(self.ns.compute_variance).lower() in ("true", "1"))
+            parse_flag(self.ns.compute_variance))
         for cid in self.updating_sequence:
             if cid in self.fixed_data_configs:
                 data_cfg = self.fixed_data_configs[cid]
@@ -439,7 +439,7 @@ class GameTrainingDriver:
 
         ns = self.ns
         if os.path.isdir(ns.output_dir) and os.listdir(ns.output_dir):
-            if str(ns.delete_output_dir_if_exists).lower() in ("true", "1"):
+            if parse_flag(ns.delete_output_dir_if_exists):
                 import shutil
                 shutil.rmtree(ns.output_dir)
             elif os.path.exists(os.path.join(ns.output_dir, "best")):

@@ -138,9 +138,14 @@ class LegacyParams:
     def validate(self) -> None:
         """Params.validate :201 analog."""
         errors = []
-        if (self.regularization_type == RegularizationType.L1
+        if (self.regularization_type in (RegularizationType.L1,
+                                         RegularizationType.ELASTIC_NET)
                 and self.optimizer == OptimizerType.TRON):
-            errors.append("TRON cannot be used with L1 regularization")
+            # DriverIntegTest.testInvalidRegularizationAndOptimizer: both
+            # L1 and ELASTIC_NET are invalid with TRON
+            errors.append(
+                f"TRON cannot be used with "
+                f"{self.regularization_type.name} regularization")
         if (self.diagnostic_mode in (DiagnosticMode.VALIDATE,
                                      DiagnosticMode.ALL)
                 and not self.validating_data_directory):
@@ -211,8 +216,7 @@ def parse_args(argv: Sequence[str]) -> LegacyParams:
                    help=argparse.SUPPRESS)
     ns = p.parse_args(argv)
 
-    def as_bool(x: str) -> bool:
-        return str(x).strip().lower() in ("true", "1", "yes")
+    from photon_ml_tpu.utils import parse_flag as as_bool
 
     params = LegacyParams(
         training_data_directory=ns.training_data_directory,

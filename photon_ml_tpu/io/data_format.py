@@ -400,9 +400,9 @@ def load_game_dataset_avro(
         shard: ([], [], []) for shard in feature_shard_sections}
     id_values: dict[str, list] = {t: [] for t in id_types}
 
-    # hoisted per-shard lookups: index_of probes on an OffHeapIndexMap cost
-    # a hash + memmap search each, so probe once per feature and cache the
-    # intercept index outside the record loop
+    # index_of probes on an OffHeapIndexMap cost a hash + memmap search
+    # each: features pay one probe per occurrence (not `in` + index_of),
+    # and the per-shard intercept index is cached outside the record loop
     intercepts = {shard: index_maps[shard].intercept_index
                   for shard in feature_shard_sections}
     for i, rec in enumerate(records):

@@ -1,0 +1,10 @@
+"""Run-scoped utilities: logging, events, checkpoints, date ranges."""
+
+from __future__ import annotations
+
+
+def parse_flag(value) -> bool:
+    """Parse a CLI boolean flag string the way the reference's Scala drivers
+    parse "true"/"false" option values (one shared definition so every
+    driver accepts the same spellings)."""
+    return str(value).strip().lower() in ("true", "1", "yes")
