@@ -102,8 +102,11 @@ class GameScoringDriver:
         if getattr(ns, "offheap_indexmap_dir", None):
             from photon_ml_tpu.io.feature_index_job import load_feature_index
 
+            # offheap=True matches the legacy driver's hard requirement: the
+            # flag asks for the off-heap store, missing meta fails loudly
             index_maps.update(load_feature_index(
                 ns.offheap_indexmap_dir, sorted(self.section_keys),
+                offheap=True,
                 expected_partitions=getattr(
                     ns, "offheap_indexmap_num_partitions", None)))
         elif ns.feature_name_and_term_set_path:

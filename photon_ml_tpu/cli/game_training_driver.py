@@ -235,8 +235,13 @@ class GameTrainingDriver:
         if getattr(self.ns, "offheap_indexmap_dir", None):
             from photon_ml_tpu.io.feature_index_job import load_feature_index
 
+            # offheap=True, not autodetect: the flag explicitly requests the
+            # off-heap store, so a dir without one fails loudly instead of
+            # silently loading the JSON index into RAM (and skipping the
+            # partition-count validation the flag exists to enforce)
             self.index_maps.update(load_feature_index(
                 self.ns.offheap_indexmap_dir, sorted(self.section_keys),
+                offheap=True,
                 expected_partitions=getattr(
                     self.ns, "offheap_indexmap_num_partitions", None)))
             self.logger.info(

@@ -314,6 +314,81 @@ class TestOffHeapIndexMapFlow:
                                           "fixed"))
 
 
+class TestMultipleEvaluators:
+    """DriverTest.multipleEvaluatorTypeProvider analog: every requested
+    evaluator runs per CD sweep and lands in validation_metrics; the FIRST
+    drives best-model selection (CoordinateDescent.scala:245-255)."""
+
+    @pytest.mark.parametrize("task,ev", [
+        ("LINEAR_REGRESSION", "RMSE,SQUARED_LOSS"),
+        ("LOGISTIC_REGRESSION",
+         "LOGISTIC_LOSS,AUC,precision@1:userId,precision@5:userId"),
+        ("LOGISTIC_REGRESSION", "AUC,AUC:userId"),
+        ("POISSON_REGRESSION", "POISSON_LOSS"),
+    ])
+    def test_multiple_evaluators_with_full_model(self, tmp_path, task, ev):
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            parse_args as game_parse,
+        )
+
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game_avro(train, n=200, seed=11)
+        _make_game_avro(validate, n=100, seed=12)
+        driver = GameTrainingDriver(game_parse([
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", str(tmp_path / "out"),
+            "--task-type", task,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:15,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations", "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:15,1e-7,1.0,1,LBFGS,L2",
+            "--evaluator-type", ev,
+            "--model-output-mode", "NONE",
+        ]))
+        result = driver.run()
+        expected = [x.strip() for x in ev.split(",")]
+        vm = result.states[-1].validation_metrics
+        assert vm is not None and sorted(vm) == sorted(expected)
+        assert all(np.isfinite(v) for v in vm.values()), vm
+        # first evaluator drives selection
+        assert result.best_metric == pytest.approx(
+            max(s.validation_metrics[expected[0]] for s in result.states)
+            if expected[0] in ("AUC",) or expected[0].startswith("precision")
+            else min(s.validation_metrics[expected[0]]
+                     for s in result.states))
+
+    def test_sharded_evaluator_unknown_id_type_raises(self, tmp_path):
+        """shardedEvaluatorOfUnknownIdTypeProvider analog: AUC:unknownId
+        must fail loudly, not score garbage."""
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=80, seed=13)
+        with pytest.raises(ValueError, match="nonexistentId"):
+            game_main([
+                "--train-input-dirs", train,
+                "--validate-input-dirs", train,
+                "--output-dir", str(tmp_path / "out"),
+                "--task-type", "LOGISTIC_REGRESSION",
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:globalFeatures",
+                "--updating-sequence", "fixed",
+                "--num-iterations", "1",
+                "--fixed-effect-data-configurations", "fixed:global,1",
+                "--fixed-effect-optimization-configurations",
+                "fixed:10,1e-7,0.1,1,LBFGS,L2",
+                "--evaluator-type", "AUC:nonexistentId",
+                "--model-output-mode", "NONE",
+            ])
+
+
 class TestFeatureIndexingCli:
     def test_game_mode(self, tmp_path, capsys):
         train = str(tmp_path / "train.avro")
