@@ -389,6 +389,62 @@ class TestMultipleEvaluators:
             ])
 
 
+class TestInterceptMap:
+    """DriverTest.testFixedEffectsWith/WithoutIntercept +
+    testRandomEffectsWithPartialIntercept analogs: the per-shard intercept
+    map controls whether (INTERCEPT) enters each shard's feature space."""
+
+    def _run(self, tmp_path, intercept_map):
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            parse_args as game_parse,
+        )
+
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=150, seed=21)
+        driver = GameTrainingDriver(game_parse([
+            "--train-input-dirs", train,
+            "--output-dir", str(tmp_path / "out"),
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--feature-shard-id-to-intercept-map", intercept_map,
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:10,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations", "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:10,1e-7,1.0,1,LBFGS,L2",
+            "--model-output-mode", "NONE",
+        ]))
+        driver.run()
+        return driver
+
+    def test_intercept_on_by_default(self, tmp_path):
+        from photon_ml_tpu.io.index_map import INTERCEPT_KEY
+
+        driver = self._run(tmp_path, "")
+        assert INTERCEPT_KEY in driver.index_maps["global"]
+        assert INTERCEPT_KEY in driver.index_maps["user"]
+        assert len(driver.index_maps["global"]) == 6 + 1
+
+    def test_intercept_off(self, tmp_path):
+        from photon_ml_tpu.io.index_map import INTERCEPT_KEY
+
+        driver = self._run(tmp_path, "global:false|user:false")
+        assert INTERCEPT_KEY not in driver.index_maps["global"]
+        assert len(driver.index_maps["global"]) == 6
+
+    def test_partial_intercept(self, tmp_path):
+        from photon_ml_tpu.io.index_map import INTERCEPT_KEY
+
+        driver = self._run(tmp_path, "global:true|user:false")
+        assert INTERCEPT_KEY in driver.index_maps["global"]
+        assert INTERCEPT_KEY not in driver.index_maps["user"]
+
+
 class TestFeatureIndexingCli:
     def test_game_mode(self, tmp_path, capsys):
         train = str(tmp_path / "train.avro")

@@ -165,3 +165,41 @@ def test_svm_classifier_predictions(rng):
     preds = np.asarray(model.predict_class(jnp.asarray(X)))
     assert set(np.unique(preds)) <= {0, 1}
     assert np.mean(preds == y) > 0.7
+
+
+@pytest.mark.parametrize("task", [
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.LINEAR_REGRESSION,
+    TaskType.POISSON_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+])
+def test_per_task_prediction_validators(rng, task):
+    """BaseGLMIntegTest *Validator.scala analog: trained predictions satisfy
+    the task's range contract — probabilities in [0,1] for logistic,
+    strictly positive means for Poisson, finite everywhere, binary
+    classifications for the classifiers."""
+    n, d = 500, 6
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d) * 0.5
+    margin = X @ w
+    if task == TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(margin, -4, 2))).astype(float)
+    elif task == TaskType.LINEAR_REGRESSION:
+        y = margin + 0.1 * rng.normal(size=n)
+    else:
+        y = (rng.random(n) < 1 / (1 + np.exp(-margin))).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    models = train_glm_grid(batch, task, regularization_weights=[1.0])
+    model = models[0].model
+    assert model.validate_coefficients()
+    preds = np.asarray(model.predict(jnp.asarray(X)))
+    assert np.all(np.isfinite(preds))
+    if task == TaskType.LOGISTIC_REGRESSION:
+        assert np.all((preds >= 0.0) & (preds <= 1.0))
+    if task == TaskType.POISSON_REGRESSION:
+        assert np.all(preds > 0.0)
+    if task in (TaskType.LOGISTIC_REGRESSION,
+                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        cls = np.asarray(model.predict_class(jnp.asarray(X)))
+        assert set(np.unique(cls)) <= {0, 1}
+        assert np.mean(cls == y) > 0.7
