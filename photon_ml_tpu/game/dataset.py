@@ -614,9 +614,16 @@ def build_random_effect_dataset(
     row_ids[ent_of_act, slot_of_act] = rows_act
 
     if projectors is not None:
-        nnz_row, nnz_j, nnz_ok = _project_nnz(sub, ent_of_act, projectors)
-        X[ent_of_act[nnz_row[nnz_ok]], slot_of_act[nnz_row[nnz_ok]],
-          nnz_j[nnz_ok]] = sub.data[nnz_ok]
+        from photon_ml_tpu.io.native_loader import pack_projected_rows_native
+
+        # Native single-pass pack (no nnz-length temporaries); numpy
+        # searchsorted formulation as fallback.
+        if not pack_projected_rows_native(
+                sub, ent_of_act, ent_of_act * n_max + slot_of_act,
+                projectors.raw_indices, X):
+            nnz_row, nnz_j, nnz_ok = _project_nnz(sub, ent_of_act, projectors)
+            X[ent_of_act[nnz_row[nnz_ok]], slot_of_act[nnz_row[nnz_ok]],
+              nnz_j[nnz_ok]] = sub.data[nnz_ok]
     elif random_projector is not None:
         X[ent_of_act, slot_of_act] = (
             sub @ random_projector.matrix).astype(np.float32)
@@ -630,9 +637,18 @@ def build_random_effect_dataset(
         local = inv_perm[grp_of_sorted[passive_mask]].astype(np.int32)
         sub_p = mat[pr]
         if projectors is not None:
+            from photon_ml_tpu.io.native_loader import (
+                pack_projected_rows_native,
+            )
+
             dense = np.zeros((len(pr), d_red), dtype=np.float32)
-            nnz_row, nnz_j, nnz_ok = _project_nnz(sub_p, local, projectors)
-            dense[nnz_row[nnz_ok], nnz_j[nnz_ok]] = sub_p.data[nnz_ok]
+            if not pack_projected_rows_native(
+                    sub_p, local.astype(np.int64),
+                    np.arange(len(pr), dtype=np.int64),
+                    projectors.raw_indices, dense):
+                nnz_row, nnz_j, nnz_ok = _project_nnz(sub_p, local,
+                                                      projectors)
+                dense[nnz_row[nnz_ok], nnz_j[nnz_ok]] = sub_p.data[nnz_ok]
             p_X = jnp.asarray(dense)
         elif random_projector is not None:
             p_X = jnp.asarray((sub_p @ random_projector.matrix)

@@ -25,7 +25,17 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libphoton_native.so")
-_SRC_PATH = os.path.join(_NATIVE_DIR, "libsvm_parser.cpp")
+
+
+def _newest_source_mtime() -> Optional[float]:
+    """Latest mtime across ALL native sources — a lib built before a new
+    .cpp was added must rebuild or its symbols are missing."""
+    try:
+        times = [os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+                 for f in os.listdir(_NATIVE_DIR) if f.endswith(".cpp")]
+    except OSError:
+        return None
+    return max(times) if times else None
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -54,36 +64,83 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
+        src_mtime = _newest_source_mtime()
         if not os.path.exists(_LIB_PATH) or (
-                os.path.exists(_SRC_PATH)
-                and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)):
-            if not os.path.exists(_SRC_PATH) or not _compile():
+                src_mtime is not None
+                and src_mtime > os.path.getmtime(_LIB_PATH)):
+            if src_mtime is None or not _compile():
                 _build_failed = True
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
+            lib.photon_libsvm_open.restype = ctypes.c_void_p
+            lib.photon_libsvm_open.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.photon_libsvm_fill.restype = ctypes.c_int
+            lib.photon_libsvm_fill.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.photon_libsvm_close.restype = None
+            lib.photon_libsvm_close.argtypes = [ctypes.c_void_p]
+            lib.photon_pack_projected_rows.restype = ctypes.c_int
+            lib.photon_pack_projected_rows.argtypes = [
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ]
+        except (OSError, AttributeError):
+            # unloadable lib OR a stale lib missing a newer entry point —
+            # degrade to the Python paths rather than crashing every call
             _build_failed = True
             return None
-        lib.photon_libsvm_open.restype = ctypes.c_void_p
-        lib.photon_libsvm_open.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.photon_libsvm_fill.restype = ctypes.c_int
-        lib.photon_libsvm_fill.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.photon_libsvm_close.restype = None
-        lib.photon_libsvm_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+def pack_projected_rows_native(
+        sub, table_of: np.ndarray, out_row_of: np.ndarray,
+        raw_indices: np.ndarray, out: np.ndarray) -> bool:
+    """Stream ``sub``'s (CSR) stored elements into ``out`` rows through
+    per-entity sorted feature tables (native/block_packer.cpp). Returns
+    False when the native library is unavailable — callers fall back to the
+    vectorized numpy path. ``out`` must be a zeroed [n_out, d_red] f32
+    array; ``raw_indices`` [n_tables, d_red] ascending with pad sentinels."""
+    lib = get_native_lib()
+    if lib is None:
+        return False
+    indptr = np.ascontiguousarray(sub.indptr, np.int64)
+    indices = np.ascontiguousarray(sub.indices, np.int32)
+    data = np.ascontiguousarray(sub.data, np.float32)
+    table_of = np.ascontiguousarray(table_of, np.int64)
+    out_row_of = np.ascontiguousarray(out_row_of, np.int64)
+    raw_indices = np.ascontiguousarray(raw_indices, np.int32)
+    n_tables, d_red = raw_indices.shape
+    flat = out.reshape(-1, out.shape[-1])
+    if flat.shape[1] != d_red:
+        # hard check (not an assert: -O would strip it and the C loop
+        # would write past out's rows)
+        raise ValueError(
+            f"out last dim {flat.shape[1]} != table width {d_red}")
+    rc = lib.photon_pack_projected_rows(
+        sub.shape[0], indptr, indices, data, table_of, out_row_of,
+        raw_indices, n_tables, d_red, flat.shape[0], flat)
+    if rc != 0:
+        raise ValueError(f"native block pack failed with code {rc}")
+    return True
 
 
 def parse_libsvm_native(path: str, zero_based: bool
