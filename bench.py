@@ -19,8 +19,12 @@ Measured on the real chip, one JSON line out (the driver records it):
   effect logistic GAME on a MovieLens-1M-shaped synthetic dataset
   (CoordinateDescent.scala:50-263), reporting dataset-build and train
   wall-clock plus per-CD-sweep seconds.
+- ``game_full`` (config 5): full GAME — fixed + per-user + per-item
+  coordinates in one CD sweep plus a matrix-factorization scoring pass
+  (the MovieLens-20M recipe's structure at 1-core-host-sized rows).
 - ``ingest``: 10M-row ELL pack + random-effect block build throughput
-  (RandomEffectDataSet.scala:169-206's shuffle analog).
+  (RandomEffectDataSet.scala:169-206's shuffle analog; the block fill
+  runs through the native C++ packer, native/block_packer.cpp).
 
 Roofline: kernel benches report achieved HBM GB/s and % of the chip's peak
 (detected from device_kind; override with PHOTON_HBM_PEAK_GBPS) so bandwidth
@@ -377,6 +381,135 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     }
 
 
+def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
+                    latent_dim=8) -> dict:
+    """Config 5: full GAME — fixed + per-user + per-item coordinates in one
+    CD sweep plus a matrix-factorization scoring pass (the MovieLens-20M
+    recipe at a 1-core-host-sized row count; per-coordinate structure, not
+    scale, is what config 5 adds over config 4)."""
+    import scipy.sparse as sp
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.coordinate import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.game.dataset import (
+        GameDataset,
+        RandomEffectDataConfiguration,
+        build_fixed_effect_dataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.models import MatrixFactorizationModel
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+    )
+    from photon_ml_tpu.optimize.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+        TaskType,
+    )
+    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+
+    rng = np.random.default_rng(11)
+    t0 = time.perf_counter()
+    users = (rng.zipf(1.3, size=n) % n_users).astype(np.int64)
+    movies = rng.integers(0, n_movies, n)
+    Xg = (rng.normal(size=(n, d_global)) / np.sqrt(d_global)).astype(
+        np.float32)
+    wg = rng.normal(size=d_global).astype(np.float32)
+    logits = (Xg @ wg + 0.4 * rng.normal(size=n_users)[users]
+              + 0.4 * rng.normal(size=n_movies)[movies])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    one = np.ones(n, np.float32)
+    data = GameDataset(responses=y, feature_shards={
+        "global": sp.csr_matrix(Xg),
+        "per_user": sp.csr_matrix(
+            (one, (np.arange(n), movies)), shape=(n, n_movies)),
+        "per_item": sp.csr_matrix(
+            (one, (np.arange(n), users)), shape=(n, n_users)),
+    })
+    data.encode_ids("userId", users)
+    data.encode_ids("movieId", movies)
+
+    def l2(lam, iters):
+        return GLMOptimizationConfiguration(
+            max_iterations=iters, tolerance=1e-7, regularization_weight=lam,
+            optimizer_type=OptimizerType.LBFGS,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2))
+
+    fixed_ds = build_fixed_effect_dataset(data, "global")
+    user_ds = build_random_effect_dataset(data, RandomEffectDataConfiguration(
+        "userId", "per_user", 1, num_active_data_points_upper_bound=64,
+        num_features_to_keep_upper_bound=64))
+    item_ds = build_random_effect_dataset(data, RandomEffectDataConfiguration(
+        "movieId", "per_item", 1, num_active_data_points_upper_bound=64,
+        num_features_to_keep_upper_bound=64))
+    build_secs = time.perf_counter() - t0
+    _progress(f"game-full dataset built in {build_secs:.1f}s (user block "
+              f"{tuple(int(s) for s in user_ds.X.shape)}, item block "
+              f"{tuple(int(s) for s in item_ds.X.shape)})")
+
+    task = TaskType.LOGISTIC_REGRESSION
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            dataset=fixed_ds,
+            problem=GLMOptimizationProblem(config=l2(10.0, 30), task=task)),
+        "per-user": RandomEffectCoordinate(
+            dataset=user_ds,
+            problem=RandomEffectOptimizationProblem(
+                config=l2(1.0, 15), task=task)),
+        "per-item": RandomEffectCoordinate(
+            dataset=item_ds,
+            problem=RandomEffectOptimizationProblem(
+                config=l2(1.0, 15), task=task)),
+    }
+    t0 = time.perf_counter()
+    result = run_coordinate_descent(
+        coords, num_iterations=1, task=task,
+        labels=jnp.asarray(data.responses, jnp.float32),
+        weights=jnp.asarray(data.weights, jnp.float32),
+        offsets=jnp.asarray(data.offsets, jnp.float32))
+    train_secs = time.perf_counter() - t0
+
+    # MF scoring pass: replicated factor tables, one jitted gather+dot
+    # (MatrixFactorizationModel.scala:50,141's RDD join as a device gather).
+    mf = MatrixFactorizationModel(
+        row_effect_type="userId", col_effect_type="movieId",
+        row_factors=jnp.asarray(rng.normal(
+            size=(n_users, latent_dim)).astype(np.float32)),
+        col_factors=jnp.asarray(rng.normal(
+            size=(n_movies, latent_dim)).astype(np.float32)))
+    r = jnp.asarray(users.astype(np.int32))
+    c = jnp.asarray(movies.astype(np.int32))
+
+    @jax.jit
+    def mf_score(rf, cf, r, c):
+        return jnp.sum(rf[r] * cf[c], axis=-1)
+
+    s = mf_score(mf.row_factors, mf.col_factors, r, c)
+    float(s[0])  # compile + fence
+    t0 = time.perf_counter()
+    for _ in range(5):
+        s = mf_score(mf.row_factors, mf.col_factors, r, c)
+    float(s[0])
+    mf_secs = (time.perf_counter() - t0) / 5
+    return {
+        "n_samples": n, "d_global": d_global,
+        "coordinates": ["fixed", "per-user", "per-item"],
+        "dataset_build_secs": round(build_secs, 2),
+        "cd_sweep_secs": round(train_secs, 2),
+        "mf_score_rows_per_sec": round(n / mf_secs, 0),
+        "final_objective": round(float(result.states[-1].objective), 1),
+    }
+
+
 def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
                  n_entities=50_000) -> dict:
     """10M-row ingestion: vectorized ELL pack + random-effect block build
@@ -392,10 +525,15 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     )
 
     rng = np.random.default_rng(3)
-    rows = np.repeat(np.arange(n), nnz_per_row)
-    cols = rng.integers(0, d, size=n * nnz_per_row)
+    # Direct CSR construction: rows are uniform-width, so indptr is an
+    # arange and no 80M-element COO sort is needed. Columns sorted per row
+    # (cheap axis-1 sort) so the matrix is canonical up front.
+    cols = np.sort(rng.integers(0, d, size=(n, nnz_per_row),
+                                dtype=np.int32), axis=1).reshape(-1)
     vals = rng.random(n * nnz_per_row).astype(np.float32)
-    mat = sp.csr_matrix((vals, (rows, cols)), shape=(n, d))
+    indptr = np.arange(0, n * nnz_per_row + 1, nnz_per_row, dtype=np.int64)
+    mat = sp.csr_matrix((vals, cols, indptr), shape=(n, d))
+    mat.sum_duplicates()  # canonicalize (random cols may repeat in a row)
     y = rng.integers(0, 2, n).astype(np.float64)
     codes = rng.integers(0, n_entities, n).astype(np.int64)
 
@@ -450,6 +588,8 @@ def main():
     owlqn = bench_owlqn()
     _progress("glmix end-to-end bench")
     glmix = bench_glmix()
+    _progress("full-GAME bench")
+    game_full = bench_game_full()
     _progress("ingest bench")
     ingest = bench_ingest()
     _progress("done")
@@ -466,6 +606,7 @@ def main():
         "hvp": hvp,
         "owlqn": owlqn,
         "glmix": glmix,
+        "game_full": game_full,
         "ingest": ingest,
     }))
 

@@ -37,6 +37,7 @@ import scipy.sparse as sp
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import Batch, DenseBatch, ell_from_csr
+from photon_ml_tpu.io.native_loader import pack_projected_rows_native
 from photon_ml_tpu.projector.projectors import (
     IndexMapProjectors,
     ProjectorConfig,
@@ -614,8 +615,6 @@ def build_random_effect_dataset(
     row_ids[ent_of_act, slot_of_act] = rows_act
 
     if projectors is not None:
-        from photon_ml_tpu.io.native_loader import pack_projected_rows_native
-
         # Native single-pass pack (no nnz-length temporaries); numpy
         # searchsorted formulation as fallback.
         if not pack_projected_rows_native(
@@ -637,10 +636,6 @@ def build_random_effect_dataset(
         local = inv_perm[grp_of_sorted[passive_mask]].astype(np.int32)
         sub_p = mat[pr]
         if projectors is not None:
-            from photon_ml_tpu.io.native_loader import (
-                pack_projected_rows_native,
-            )
-
             dense = np.zeros((len(pr), d_red), dtype=np.float32)
             if not pack_projected_rows_native(
                     sub_p, local.astype(np.int64),

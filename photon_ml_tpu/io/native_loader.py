@@ -129,6 +129,10 @@ def pack_projected_rows_native(
     out_row_of = np.ascontiguousarray(out_row_of, np.int64)
     raw_indices = np.ascontiguousarray(raw_indices, np.int32)
     n_tables, d_red = raw_indices.shape
+    if not out.flags.c_contiguous:
+        # reshape of a non-contiguous array would copy — native writes
+        # would land in the discarded temporary
+        raise ValueError("out must be C-contiguous")
     flat = out.reshape(-1, out.shape[-1])
     if flat.shape[1] != d_red:
         # hard check (not an assert: -O would strip it and the C loop

@@ -150,7 +150,7 @@ def test_native_empty_index_rejected(tmp_path):
 
 
 @requires_native
-def test_native_block_packer_matches_numpy(rng, monkeypatch):
+def test_native_block_packer_matches_numpy(monkeypatch):
     """native/block_packer.cpp vs the numpy searchsorted formulation:
     bit-identical active and passive blocks on a capped, feature-selected
     random-effect build."""
