@@ -11,6 +11,9 @@ Measured on the real chip, one JSON line out (the driver records it):
   Pallas kernel's three sums are parity-checked on-chip against the two-pass
   XLA form (the aggregator contract, :133-177) — every BENCH record doubles
   as a hardware correctness proof.
+- ``value_gradient_bf16``: the same kernel with X stored bf16 (caller
+  opt-in): half the HBM stream, f32 accumulators, parity-gated against the
+  f32 two-pass sums at bf16 input-rounding tolerance.
 - ``hvp`` (config 2): Gauss-Newton Hessian-vector products/sec
   (HessianVectorAggregator.scala:137-163 — TRON's inner CG op).
 - ``owlqn`` (config 3): full OWL-QN elastic-net Poisson solve wall-clock
@@ -174,7 +177,14 @@ def check_pallas_parity(batch, w) -> dict:
     return {"pallas_parity": "ok"}
 
 
-def bench_value_gradient(batch, w, peak, iters=50) -> dict:
+def _timed_eval_chain(batch, w, bytes_per_eval, peak, iters=50) -> dict:
+    """Shared timing harness for the value+gradient kernels (f32 and bf16
+    records MUST be measured identically). Chains each iteration's w on the
+    previous gradient (what L-BFGS does): identical-input replays can be
+    served from caches by remote backends, and block_until_ready alone is
+    not a reliable fence through the device tunnel — one final VALUE fetch
+    forces the whole chain. The 5-step warmup absorbs compile + the
+    backend's first-dispatch ramp."""
     import jax
     import jax.numpy as jnp
 
@@ -184,19 +194,12 @@ def bench_value_gradient(batch, w, peak, iters=50) -> dict:
     obj = GLMObjective(loss=get_loss("logistic"), l2_lambda=0.0)
     wj = jnp.asarray(w)
     calc = jax.jit(lambda w, b: obj.calculate(w, b))
-    # compile + warmup: a short throwaway chain absorbs the backend's
-    # one-time ramp (first-dispatch pipelining) before timing starts; the
-    # value fetch forces real completion.
     wi = wj
     for _ in range(5):
         v, g = calc(wi, batch)
         wi = wi - 1e-4 * g
     float(v)
 
-    # Chain each iteration's w on the previous gradient (what L-BFGS does):
-    # identical-input replays can be served from caches by remote backends,
-    # and block_until_ready alone is not a reliable fence through the
-    # device tunnel — one final VALUE fetch forces the whole chain.
     t0 = time.perf_counter()
     wi = wj
     for _ in range(iters):
@@ -204,11 +207,53 @@ def bench_value_gradient(batch, w, peak, iters=50) -> dict:
         wi = wi - 1e-4 * g
     float(v)
     dt = (time.perf_counter() - t0) / iters
+    out = {"evals_per_sec": round(1.0 / dt, 2)}
+    out.update(_roofline(bytes_per_eval, dt, peak))
+    return out
+
+
+def bench_value_gradient(batch, w, peak, iters=50) -> dict:
     n, d = batch.X.shape
     # Single-pass minimum traffic: one read of X (the fused kernel's goal).
-    out = {"evals_per_sec": round(1.0 / dt, 2)}
-    out.update(_roofline(4.0 * n * d, dt, peak))
-    return out
+    return _timed_eval_chain(batch, w, 4.0 * n * d, peak, iters)
+
+
+def bench_value_gradient_bf16(batch, w, peak, iters=50) -> dict:
+    """bf16-X variant of the headline kernel: half the HBM stream, f32
+    accumulators. Parity-checked against the f32 two-pass sums at bf16
+    input-rounding tolerance before timing; any failure is recorded, not
+    fatal (the f32 headline stands on its own)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.aggregators import GLMObjective
+    from photon_ml_tpu.ops.losses import get_loss
+    from photon_ml_tpu.ops.pallas_kernels import _xla_sums, pallas_supported
+
+    n, d = batch.X.shape
+    if not pallas_supported(n, d, jnp.bfloat16):
+        return {"skipped": "bf16 kernel not engaged on this backend"}
+    try:
+        bf = batch._replace(X=batch.X.astype(jnp.bfloat16))
+        obj = GLMObjective(loss=get_loss("logistic"), l2_lambda=0.0)
+        wj = jnp.asarray(w)
+        # parity vs the f32 two-pass reference
+        ref = jax.jit(lambda: _xla_sums(
+            obj.loss, batch.X, batch.labels, batch.offsets, batch.weights,
+            wj, jnp.float32(0.0)))()
+        v0, g0 = jax.jit(lambda w, b: obj.calculate(w, b))(wj, bf)
+        rv, rvec, _ = (np.asarray(x) for x in ref)
+        if abs(float(v0) - float(rv)) > 2e-2 * abs(float(rv)):
+            return {"parity": f"FAILED value {float(v0)} vs {float(rv)}"}
+        scale = max(1.0, float(np.abs(rvec).max()))
+        # g0 is the reconstructed gradient == vector_sum with no norm
+        if float(np.abs(np.asarray(g0) - rvec).max()) / scale > 5e-2:
+            return {"parity": "FAILED gradient"}
+        out = {"parity": "ok"}
+        out.update(_timed_eval_chain(bf, w, 2.0 * n * d, peak, iters))
+        return out
+    except Exception as e:  # pragma: no cover - hardware-path guard
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def bench_hvp(batch, w, peak, iters=50) -> dict:
@@ -280,6 +325,60 @@ def bench_owlqn(iters=3) -> dict:
             "n": n, "d": d}
 
 
+def _l2_config(lam, iters):
+    """Shared L-BFGS+L2 config for the GAME benches (configs 4 and 5 must
+    stay comparable)."""
+    from photon_ml_tpu.optimize.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+
+    return GLMOptimizationConfiguration(
+        max_iterations=iters, tolerance=1e-7, regularization_weight=lam,
+        optimizer_type=OptimizerType.LBFGS,
+        regularization_context=RegularizationContext(
+            RegularizationType.L2))
+
+
+def _movielens_data(rng, n, n_users, n_movies, d_global,
+                    with_item_effect=False):
+    """MovieLens-shaped synthetic GameDataset: power-law users, uniform
+    movies, dense globals, one-hot movie features per user coordinate (and
+    one-hot user features per item coordinate when requested). One recipe
+    for configs 4 and 5 so their numbers stay comparable."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.game.dataset import GameDataset
+
+    users = (rng.zipf(1.3, size=n) % n_users).astype(np.int64)
+    movies = rng.integers(0, n_movies, n)
+    Xg = (rng.normal(size=(n, d_global)) / np.sqrt(d_global)).astype(
+        np.float32)
+    wg = rng.normal(size=d_global).astype(np.float32)
+    logits = Xg @ wg + 0.5 * rng.normal(size=n_users)[users].astype(
+        np.float32)
+    if with_item_effect:
+        logits = logits + 0.4 * rng.normal(size=n_movies)[movies].astype(
+            np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    one = np.ones(n, np.float32)
+    shards = {
+        "global": sp.csr_matrix(Xg),
+        "per_user": sp.csr_matrix(
+            (one, (np.arange(n), movies)), shape=(n, n_movies)),
+    }
+    if with_item_effect:
+        shards["per_item"] = sp.csr_matrix(
+            (one, (np.arange(n), users)), shape=(n, n_users))
+    data = GameDataset(responses=y, feature_shards=shards)
+    data.encode_ids("userId", users)
+    if with_item_effect:
+        data.encode_ids("movieId", movies)
+    return data
+
+
 def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
                 active_cap=128, feature_cap=128) -> dict:
     """Config 4: fixed + per-user logistic GAME on MovieLens-1M-shaped data,
@@ -287,7 +386,7 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     users, 3706 movies). Caps keep the padded entity block ~400 MB — the
     bench host has ONE core and a tunneled device, so host build + transfer
     time is part of the measured budget."""
-    import scipy.sparse as sp
+    import jax.numpy as jnp
 
     from photon_ml_tpu.game.coordinate import (
         FixedEffectCoordinate,
@@ -295,7 +394,6 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     )
     from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
     from photon_ml_tpu.game.dataset import (
-        GameDataset,
         RandomEffectDataConfiguration,
         build_fixed_effect_dataset,
         build_random_effect_dataset,
@@ -303,36 +401,12 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     from photon_ml_tpu.game.random_effect import (
         RandomEffectOptimizationProblem,
     )
-    from photon_ml_tpu.optimize.config import (
-        GLMOptimizationConfiguration,
-        OptimizerType,
-        RegularizationContext,
-        RegularizationType,
-        TaskType,
-    )
+    from photon_ml_tpu.optimize.config import TaskType
     from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
 
     rng = np.random.default_rng(7)
-
     t0 = time.perf_counter()
-    # MovieLens-1M shape: power-law users, uniform movies, one-hot movie
-    # features for the per-user coordinate, dense globals for the fixed one.
-    users = (rng.zipf(1.3, size=n) % n_users).astype(np.int64)
-    movies = rng.integers(0, n_movies, n)
-    Xg = (rng.normal(size=(n, d_global)) / np.sqrt(d_global)).astype(
-        np.float32)
-    wg = rng.normal(size=d_global).astype(np.float32)
-    user_bias = 0.5 * rng.normal(size=n_users).astype(np.float32)
-    logits = Xg @ wg + user_bias[users]
-    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
-    X_user = sp.csr_matrix(
-        (np.ones(n, np.float32), (np.arange(n), movies)),
-        shape=(n, n_movies))
-    data = GameDataset(responses=y,
-                       feature_shards={"global": sp.csr_matrix(Xg),
-                                       "per_user": X_user})
-    data.encode_ids("userId", users)
-
+    data = _movielens_data(rng, n, n_users, n_movies, d_global)
     fixed_ds = build_fixed_effect_dataset(data, "global")
     re_cfg = RandomEffectDataConfiguration(
         random_effect_type="userId", feature_shard_id="per_user",
@@ -343,24 +417,18 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     _progress(f"glmix dataset built in {build_secs:.1f}s "
               f"(re block {tuple(int(s) for s in re_ds.X.shape)})")
 
-    def l2(lam, iters):
-        return GLMOptimizationConfiguration(
-            max_iterations=iters, tolerance=1e-7, regularization_weight=lam,
-            optimizer_type=OptimizerType.LBFGS,
-            regularization_context=RegularizationContext(
-                RegularizationType.L2))
-
     coords = {
         "fixed": FixedEffectCoordinate(
             dataset=fixed_ds,
             problem=GLMOptimizationProblem(
-                config=l2(10.0, 40), task=TaskType.LOGISTIC_REGRESSION)),
+                config=_l2_config(10.0, 40),
+                task=TaskType.LOGISTIC_REGRESSION)),
         "per-user": RandomEffectCoordinate(
             dataset=re_ds,
             problem=RandomEffectOptimizationProblem(
-                config=l2(1.0, 20), task=TaskType.LOGISTIC_REGRESSION)),
+                config=_l2_config(1.0, 20),
+                task=TaskType.LOGISTIC_REGRESSION)),
     }
-    import jax.numpy as jnp
 
     t0 = time.perf_counter()
     result = run_coordinate_descent(
@@ -387,8 +455,6 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
     CD sweep plus a matrix-factorization scoring pass (the MovieLens-20M
     recipe at a 1-core-host-sized row count; per-coordinate structure, not
     scale, is what config 5 adds over config 4)."""
-    import scipy.sparse as sp
-
     import jax
     import jax.numpy as jnp
 
@@ -398,7 +464,6 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
     )
     from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
     from photon_ml_tpu.game.dataset import (
-        GameDataset,
         RandomEffectDataConfiguration,
         build_fixed_effect_dataset,
         build_random_effect_dataset,
@@ -407,42 +472,15 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
     from photon_ml_tpu.game.random_effect import (
         RandomEffectOptimizationProblem,
     )
-    from photon_ml_tpu.optimize.config import (
-        GLMOptimizationConfiguration,
-        OptimizerType,
-        RegularizationContext,
-        RegularizationType,
-        TaskType,
-    )
+    from photon_ml_tpu.optimize.config import TaskType
     from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
 
     rng = np.random.default_rng(11)
     t0 = time.perf_counter()
-    users = (rng.zipf(1.3, size=n) % n_users).astype(np.int64)
-    movies = rng.integers(0, n_movies, n)
-    Xg = (rng.normal(size=(n, d_global)) / np.sqrt(d_global)).astype(
-        np.float32)
-    wg = rng.normal(size=d_global).astype(np.float32)
-    logits = (Xg @ wg + 0.4 * rng.normal(size=n_users)[users]
-              + 0.4 * rng.normal(size=n_movies)[movies])
-    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
-    one = np.ones(n, np.float32)
-    data = GameDataset(responses=y, feature_shards={
-        "global": sp.csr_matrix(Xg),
-        "per_user": sp.csr_matrix(
-            (one, (np.arange(n), movies)), shape=(n, n_movies)),
-        "per_item": sp.csr_matrix(
-            (one, (np.arange(n), users)), shape=(n, n_users)),
-    })
-    data.encode_ids("userId", users)
-    data.encode_ids("movieId", movies)
-
-    def l2(lam, iters):
-        return GLMOptimizationConfiguration(
-            max_iterations=iters, tolerance=1e-7, regularization_weight=lam,
-            optimizer_type=OptimizerType.LBFGS,
-            regularization_context=RegularizationContext(
-                RegularizationType.L2))
+    data = _movielens_data(rng, n, n_users, n_movies, d_global,
+                           with_item_effect=True)
+    users = np.asarray(data.id_columns["userId"])
+    movies = np.asarray(data.id_columns["movieId"])
 
     fixed_ds = build_fixed_effect_dataset(data, "global")
     user_ds = build_random_effect_dataset(data, RandomEffectDataConfiguration(
@@ -460,15 +498,16 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
     coords = {
         "fixed": FixedEffectCoordinate(
             dataset=fixed_ds,
-            problem=GLMOptimizationProblem(config=l2(10.0, 30), task=task)),
+            problem=GLMOptimizationProblem(
+                config=_l2_config(10.0, 30), task=task)),
         "per-user": RandomEffectCoordinate(
             dataset=user_ds,
             problem=RandomEffectOptimizationProblem(
-                config=l2(1.0, 15), task=task)),
+                config=_l2_config(1.0, 15), task=task)),
         "per-item": RandomEffectCoordinate(
             dataset=item_ds,
             problem=RandomEffectOptimizationProblem(
-                config=l2(1.0, 15), task=task)),
+                config=_l2_config(1.0, 15), task=task)),
     }
     t0 = time.perf_counter()
     result = run_coordinate_descent(
@@ -581,6 +620,8 @@ def main():
     parity = check_pallas_parity(batch, w)
     _progress("value+gradient bench")
     vg = bench_value_gradient(batch, w, peak)
+    _progress("value+gradient bf16 bench")
+    vg_bf16 = bench_value_gradient_bf16(batch, w, peak)
     _progress("hvp bench")
     hvp = bench_hvp(batch, w, peak)
     del batch
@@ -603,6 +644,7 @@ def main():
         "hbm_peak_gbps": peak,
         **parity,
         "value_gradient": vg,
+        "value_gradient_bf16": vg_bf16,
         "hvp": hvp,
         "owlqn": owlqn,
         "glmix": glmix,

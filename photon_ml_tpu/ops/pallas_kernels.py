@@ -55,8 +55,8 @@ MAX_PALLAS_DIM = 4096
 MIN_PALLAS_ELEMENTS = 1 << 21
 
 
-def _tile_rows(d: int) -> int:
-    rows = _TILE_BYTES // (d * 4)
+def _tile_rows(d: int, itemsize: int = 4) -> int:
+    rows = _TILE_BYTES // (d * itemsize)
     return int(max(256, min(1024, (rows // 8) * 8)))
 
 
@@ -66,14 +66,20 @@ def pallas_supported(n: int, d: int, dtype,
     shard_map the computation is manually partitioned and per-shard shapes
     are local, so the kernel is safe on any device count; OUTSIDE one, a
     pallas_call is opaque to GSPMD (no partitioning rule) and would force a
-    full replication of X onto every device — only allow it single-device."""
+    full replication of X onto every device — only allow it single-device.
+
+    X may be f32 or bf16: a bf16 design matrix halves the HBM stream (the
+    kernel's whole cost) while the MXU multiplies bf16 natively and every
+    accumulator stays f32. Storing X in bf16 is the caller's opt-in
+    precision choice (build the batch with dtype=bfloat16)."""
     if os.environ.get("PHOTON_DISABLE_PALLAS"):
         return False
     if pltpu is None or jax.default_backend() != "tpu":
         return False
     if not inside_shard_map and jax.device_count() > 1:
         return False
-    if dtype not in (jnp.float32, jnp.dtype("float32")):
+    if jnp.dtype(dtype) not in (jnp.dtype("float32"),
+                                jnp.dtype("bfloat16")):
         return False
     return d <= MAX_PALLAS_DIM and n * d >= MIN_PALLAS_ELEMENTS
 
@@ -99,10 +105,12 @@ def _kernel(loss: PointwiseLoss, n_rows: int,
 
     # Zero padded edge rows by SELECTION, not multiplication — out-of-bounds
     # block rows may be NaN (interpret mode pads with NaN) and 0*NaN = NaN.
-    X = jnp.where(mask_col > 0.0, x_ref[...], 0.0)
+    x_dtype = x_ref.dtype
+    X = jnp.where(mask_col > 0.0, x_ref[...], jnp.zeros((), x_dtype))
     # Mosaic wants 2D operands on both matmuls: [T,D]@[D,1] and [1,T]@[T,D].
-    # w arrives as a [1, D] block; transpose is a relayout Mosaic handles.
-    w_col = jnp.transpose(w_ref[...], (1, 0))  # [D, 1]
+    # w arrives as a [1, D] f32 block; cast to X's dtype so a bf16 X rides
+    # the MXU's native bf16 path. Accumulation is f32 either way.
+    w_col = jnp.transpose(w_ref[...], (1, 0)).astype(x_dtype)  # [D, 1]
     z = (jax.lax.dot_general(
         X, w_col, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).reshape(-1)
@@ -117,7 +125,7 @@ def _kernel(loss: PointwiseLoss, n_rows: int,
     val_ref[0, 0] += jnp.sum(wl)
     pre_ref[0, 0] += jnp.sum(wd)
     vec_ref[...] += jax.lax.dot_general(
-        wd.reshape(1, -1), X, (((1,), (0,)), ((), ())),
+        wd.reshape(1, -1).astype(x_dtype), X, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
@@ -153,8 +161,11 @@ def fused_value_gradient_sums(
     recomputes the backward pass through the XLA formulation (used by
     second-order callers like jax.hessian over the objective value).
     """
+    if jnp.dtype(X.dtype) not in (jnp.dtype("float32"),
+                                  jnp.dtype("bfloat16")):
+        X = X.astype(jnp.float32)  # f64 callers (x64 tests) compute in f32
     n, d = X.shape
-    tile_rows = _tile_rows(d)
+    tile_rows = _tile_rows(d, jnp.dtype(X.dtype).itemsize)
     num_tiles = pl.cdiv(n, tile_rows)
     grid = (num_tiles,)
     n_pad = num_tiles * tile_rows
@@ -195,7 +206,7 @@ def fused_value_gradient_sums(
         ],
         interpret=interpret,
     )(
-        X.astype(jnp.float32),
+        X,
         _rows_2d(labels),
         _rows_2d(offsets),
         _rows_2d(weights),

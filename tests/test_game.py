@@ -369,6 +369,72 @@ class TestCoordinateDescent:
         assert np.isfinite(np.asarray(s)).all()
 
 
+class _RecordingCoordinate:
+    """Mock coordinate (algorithm/CoordinateDescentTest.scala's Mockito
+    analog): scores a constant vector, records every partial-score offset
+    handed to update()."""
+
+    def __init__(self, n, constant):
+        self._n = n
+        self._constant = constant
+        self.seen_partials = []
+        self.update_count = 0
+
+    @property
+    def num_samples(self):
+        return self._n
+
+    def initial_state(self):
+        return jnp.zeros(1)
+
+    def update(self, state, extra_scores):
+        self.seen_partials.append(np.asarray(extra_scores).copy())
+        self.update_count += 1
+
+        class _Tracker:
+            def summary(self):
+                return "mock"
+
+        return state + 1.0, _Tracker()
+
+    def score(self, state):
+        return jnp.full(self._n, self._constant) * jnp.minimum(state[0], 1.0)
+
+    def regularization_value(self, state):
+        return 0.25
+
+    def publish(self, state):
+        return ("mock-model", float(state[0]))
+
+
+class TestCoordinateDescentContract:
+    def test_partial_score_injection_and_objective(self):
+        """CoordinateDescent.scala:143-151: each coordinate's update sees
+        EXACTLY the sum of the other coordinates' current scores; :199-205:
+        the logged objective is lossEval(Σ scores) + Σ regularization."""
+        n = 16
+        a = _RecordingCoordinate(n, 2.0)
+        b = _RecordingCoordinate(n, 3.0)
+        labels = jnp.zeros(n)
+        res = run_coordinate_descent(
+            {"A": a, "B": b}, 2, TaskType.LINEAR_REGRESSION,
+            labels, jnp.ones(n), jnp.zeros(n))
+        assert a.update_count == b.update_count == 2
+        # sweep 1: A sees zeros (B not yet scored), B sees A's fresh score
+        np.testing.assert_allclose(a.seen_partials[0], np.zeros(n))
+        np.testing.assert_allclose(b.seen_partials[0], np.full(n, 2.0))
+        # sweep 2: A sees only B's score, B sees only A's
+        np.testing.assert_allclose(a.seen_partials[1], np.full(n, 3.0))
+        np.testing.assert_allclose(b.seen_partials[1], np.full(n, 2.0))
+        # objective after the final update: squared loss of total score 5
+        # against zero labels plus the two coordinates' reg values
+        expected = 0.5 * n * 5.0 ** 2 + 0.5
+        assert res.states[-1].objective == pytest.approx(expected)
+        # publish() receives each coordinate's final state
+        assert res.model.models["A"] == ("mock-model", 2.0)
+        assert res.model.models["B"] == ("mock-model", 2.0)
+
+
 class TestGameModels:
     def test_projected_model_raw_conversion_consistent(self, rng):
         data, *_ = make_game_data(rng, n=200, n_entities=5, task="linear")

@@ -65,6 +65,24 @@ def test_gate_disabled_on_cpu():
     # Tests run on CPU, so the production gate must refuse (interpret mode
     # is only for testing).
     assert not pallas_supported(1 << 20, 1024, jnp.float32)
+    assert not pallas_supported(1 << 20, 1024, jnp.bfloat16)
+
+
+def test_fused_bf16_matches_f32_reference():
+    """bf16 X (half the HBM stream) with f32 accumulators: sums must land
+    within bf16 input-rounding distance of the f32 two-pass reference."""
+    loss = get_loss("logistic")
+    X, y, off, wt, w = _case(700, 128, seed=3)
+    v, vec, pre = fused_value_gradient_sums(
+        loss, True, jnp.asarray(X, jnp.bfloat16), jnp.asarray(y),
+        jnp.asarray(off), jnp.asarray(wt), jnp.asarray(w),
+        jnp.float32(0.1))
+    assert v.dtype == jnp.float32 and vec.dtype == jnp.float32
+    v_ref, vec_ref, pre_ref = _xla_sums(loss, X, y, off, wt, w, 0.1)
+    assert float(v) == pytest.approx(v_ref, rel=2e-2)
+    assert float(pre) == pytest.approx(pre_ref, rel=5e-2, abs=0.5)
+    np.testing.assert_allclose(np.asarray(vec), vec_ref, rtol=5e-2,
+                               atol=0.5)
 
 
 def test_custom_vjp_differentiable():
