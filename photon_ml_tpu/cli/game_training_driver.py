@@ -368,6 +368,15 @@ class GameTrainingDriver:
         """Grid over opt-config combinations; each runs coordinate descent
         (Driver.train :324-350)."""
         evaluator, first_spec = self._validation_evaluator()
+        if evaluator is not None:
+            # Random-guess baseline per evaluator before training
+            # (Driver.scala:307-311) — the floor every model must beat.
+            rand = jnp.asarray(np.random.default_rng(0).uniform(
+                size=self.validate_data.num_samples))
+            for name, value in evaluator(rand).items():
+                self.logger.info(
+                    f"Random guessing based baseline evaluation metric for "
+                    f"{name}: {value:.6f}")
         best = None  # (metric, result, combo_desc)
         results = []
         combos = list(itertools.product(

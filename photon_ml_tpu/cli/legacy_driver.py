@@ -55,7 +55,7 @@ from photon_ml_tpu.io.data_format import (
     parse_constraint_map,
 )
 from photon_ml_tpu.io.index_map import OffHeapIndexMap
-from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.io.model_io import write_models_text
 from photon_ml_tpu.ops.normalization import (
     NormalizationContext,
@@ -386,7 +386,11 @@ class LegacyDriver(EventEmitter):
                 tolerance=p.convergence_tolerance,
                 normalization=self.normalization,
                 box=self.box,
-                compute_variances=p.compute_variance)
+                compute_variances=p.compute_variance,
+                # snapshots are only ever read by validate(); without a
+                # validation split they'd be dead [max_iter+1, d] carry
+                track_iterates=(p.validate_per_iteration
+                                and self.validate_data is not None))
             for tm in self.models:
                 self.logger.info(
                     f"lambda={tm.regularization_weight:g} "
@@ -413,12 +417,37 @@ class LegacyDriver(EventEmitter):
                 self.per_lambda_metrics[tm.regularization_weight] = metrics
                 self.logger.info(
                     f"lambda={tm.regularization_weight:g} metrics={metrics}")
+                per_iteration = None
+                if p.validate_per_iteration and tm.result.iterates is not None:
+                    per_iteration = self._per_iteration_metrics(tm, batch)
                 self.send_event(PhotonOptimizationLogEvent(
-                    tm.regularization_weight, tm.result, metrics))
+                    tm.regularization_weight, tm.result, metrics,
+                    per_iteration_metrics=per_iteration))
             self.best_lambda = select_best_model(self.per_lambda_metrics,
                                                  p.task)
             self.logger.info(f"best lambda: {self.best_lambda:g}")
         self._advance(DriverStage.VALIDATED)
+
+    def _per_iteration_metrics(self, tm, batch) -> list[dict[str, float]]:
+        """Metrics of every per-iteration model snapshot, logged like the
+        reference (Driver.computeAndLogModelMetrics :330-349): the iterate
+        stack is evaluated as ONE fused grid call — the snapshots are just
+        more rows of the lambda grid to the evaluator kernel."""
+        iterate_models = [
+            GeneralizedLinearModel(
+                Coefficients(
+                    means=self.normalization.transform_model_coefficients(
+                        jnp.asarray(x))),
+                self.params.task)
+            for x in tm.result.iterates
+        ]
+        per_iteration = evaluate_model_grid(iterate_models, batch)
+        for i, metrics in enumerate(per_iteration):
+            for name in sorted(metrics):
+                self.logger.info(
+                    f"Iteration: [{i:6d}] Metric: [{name}] value: "
+                    f"{metrics[name]}")
+        return per_iteration
 
     def diagnose(self) -> None:
         """Driver.diagnose :525 → HTML/text report :618-638."""

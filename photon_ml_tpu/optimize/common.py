@@ -73,6 +73,11 @@ class RunHistory(NamedTuple):
     values: Array  # [max_iter + 1]
     grad_norms: Array  # [max_iter + 1]
     num_iterations: Array  # scalar int32: last completed iteration index
+    # Per-iteration coefficient snapshots [max_iter + 1, d], recorded only
+    # when the solver runs with track_iterates=True (the reference's
+    # ModelTracker.models, Optimizer.scala state tracking) — None otherwise
+    # so the untracked compile carries no [k, d] buffer.
+    iterates: Optional[Array] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +91,7 @@ class OptimizationResult:
     convergence_reason: ConvergenceReason
     values: np.ndarray  # trajectory f_0..f_k
     grad_norms: np.ndarray  # trajectory ||g_0||..||g_k||
+    iterates: Optional[np.ndarray] = None  # [k+1, d] when tracked
 
     @staticmethod
     def from_history(
@@ -109,6 +115,8 @@ class OptimizationResult:
             convergence_reason=reason,
             values=values,
             grad_norms=grad_norms,
+            iterates=(None if history.iterates is None
+                      else np.asarray(history.iterates)[: k + 1]),
         )
 
 

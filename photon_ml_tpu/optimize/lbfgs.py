@@ -52,6 +52,7 @@ class _LBFGSCarry(NamedTuple):
     made_progress: Array  # bool: last line search succeeded
     values: Array
     grad_norms: Array
+    iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
 def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
@@ -89,7 +90,7 @@ def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
     return -r
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7))
 def _minimize_lbfgs_impl(
     value_and_grad_fn,
     x0: Array,
@@ -98,6 +99,7 @@ def _minimize_lbfgs_impl(
     m: int,
     tolerance: float,
     box: Optional[BoxConstraints] = None,
+    track_iterates: bool = False,
 ):
     # ``data`` is a traced pytree (the batch): one compiled kernel per
     # function object serves every batch of the same shape — critical for the
@@ -113,6 +115,8 @@ def _minimize_lbfgs_impl(
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype)
     values = values.at[0].set(f0)
     grad_norms = grad_norms.at[0].set(g0n)
+    iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+                 if track_iterates else None)
 
     init = _LBFGSCarry(
         it=jnp.int32(0), x=x0, f=f0, g=g0,
@@ -120,7 +124,7 @@ def _minimize_lbfgs_impl(
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
         rho=jnp.zeros(m, dtype), valid=jnp.zeros(m, bool),
         head=jnp.int32(0), made_progress=jnp.bool_(True),
-        values=values, grad_norms=grad_norms,
+        values=values, grad_norms=grad_norms, iterates=iterates0,
     )
 
     def cond(c: _LBFGSCarry) -> Array:
@@ -177,21 +181,24 @@ def _minimize_lbfgs_impl(
         values = c.values.at[it_new].set(jnp.where(ls.ok, f_new, c.f))
         grad_norms = c.grad_norms.at[it_new].set(
             jnp.linalg.norm(jnp.where(ls.ok, g_new, c.g)))
+        x_acc = jnp.where(ls.ok, x_new, c.x)
+        iterates = (c.iterates.at[it_new].set(x_acc)
+                    if track_iterates else None)
 
         return _LBFGSCarry(
             it=it_new,
-            x=jnp.where(ls.ok, x_new, c.x),
+            x=x_acc,
             f=jnp.where(ls.ok, f_new, c.f),
             g=jnp.where(ls.ok, g_new, c.g),
             prev_f=c.f,
             S=S, Y=Y, rho=rho, valid=valid, head=head,
             made_progress=ls.ok,
-            values=values, grad_norms=grad_norms,
+            values=values, grad_norms=grad_norms, iterates=iterates,
         )
 
     final = lax.while_loop(cond, body, init)
     history = RunHistory(values=final.values, grad_norms=final.grad_norms,
-                         num_iterations=final.it)
+                         num_iterations=final.it, iterates=final.iterates)
     return final.x, history, final.made_progress
 
 
@@ -203,6 +210,7 @@ def minimize_lbfgs(
     m: int = DEFAULT_M,
     tolerance: float = DEFAULT_TOLERANCE,
     box: Optional[BoxConstraints] = None,
+    track_iterates: bool = False,
 ):
     """Minimize ``f(x, data)`` from ``x0``; returns (x, RunHistory, made_progress).
 
@@ -210,7 +218,8 @@ def minimize_lbfgs(
     ``data`` (a pytree), NOT by closing over it: the function object is a
     static jit argument, so reusing one function across many batches hits the
     compile cache, while a fresh closure per batch would retrace and pin the
-    captured arrays in the cache.
+    captured arrays in the cache. ``track_iterates`` records per-iteration
+    coefficient snapshots into the history (ModelTracker analog).
     """
     return _minimize_lbfgs_impl(value_and_grad_fn, x0, data, max_iter, m,
-                                tolerance, box)
+                                tolerance, box, track_iterates)

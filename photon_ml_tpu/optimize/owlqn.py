@@ -66,9 +66,10 @@ class _OWLQNCarry(NamedTuple):
     made_progress: Array
     values: Array
     grad_norms: Array  # pseudo-gradient norms
+    iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8))
 def _minimize_owlqn_impl(
     value_and_grad_fn,
     x0: Array,
@@ -78,6 +79,7 @@ def _minimize_owlqn_impl(
     tolerance: float,
     l1: Array = 0.0,
     box: Optional[BoxConstraints] = None,
+    track_iterates: bool = False,
 ):
     d = x0.shape[0]
     dtype = x0.dtype
@@ -93,6 +95,8 @@ def _minimize_owlqn_impl(
 
     values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f0)
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(pg0n)
+    iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+                 if track_iterates else None)
 
     init = _OWLQNCarry(
         it=jnp.int32(0), x=x0, f=f0, g=g0,
@@ -100,7 +104,7 @@ def _minimize_owlqn_impl(
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
         rho=jnp.zeros(m, dtype), valid=jnp.zeros(m, bool),
         head=jnp.int32(0), made_progress=jnp.bool_(True),
-        values=values, grad_norms=grad_norms,
+        values=values, grad_norms=grad_norms, iterates=iterates0,
     )
 
     def cond(c: _OWLQNCarry) -> Array:
@@ -170,21 +174,24 @@ def _minimize_owlqn_impl(
         values = c.values.at[it_new].set(jnp.where(accepted, f_new, c.f))
         grad_norms = c.grad_norms.at[it_new].set(jnp.linalg.norm(
             jnp.where(accepted, pg_new, pg)))
+        x_acc = jnp.where(accepted, x_new, c.x)
+        iterates = (c.iterates.at[it_new].set(x_acc)
+                    if track_iterates else None)
 
         return _OWLQNCarry(
             it=it_new,
-            x=jnp.where(accepted, x_new, c.x),
+            x=x_acc,
             f=jnp.where(accepted, f_new, c.f),
             g=jnp.where(accepted, g_new, c.g),
             prev_f=c.f,
             S=S, Y=Y, rho=rho, valid=valid, head=head,
             made_progress=accepted,
-            values=values, grad_norms=grad_norms,
+            values=values, grad_norms=grad_norms, iterates=iterates,
         )
 
     final = lax.while_loop(cond, body, init)
     history = RunHistory(values=final.values, grad_norms=final.grad_norms,
-                         num_iterations=final.it)
+                         num_iterations=final.it, iterates=final.iterates)
     return final.x, history, final.made_progress
 
 
@@ -197,6 +204,7 @@ def minimize_owlqn(
     m: int = DEFAULT_M,
     tolerance: float = DEFAULT_TOLERANCE,
     box: Optional[BoxConstraints] = None,
+    track_iterates: bool = False,
 ):
     """Minimize f(x, data) + l1 ||x||_1; returns (x, RunHistory, made_progress).
 
@@ -204,4 +212,4 @@ def minimize_owlqn(
     term is handled here. ``l1`` may be scalar or per-coordinate (length d).
     """
     return _minimize_owlqn_impl(value_and_grad_fn, x0, data, max_iter, m,
-                                tolerance, l1, box)
+                                tolerance, l1, box, track_iterates)

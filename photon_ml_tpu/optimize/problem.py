@@ -75,6 +75,9 @@ class GLMOptimizationProblem:
     compute_variances: bool = False
     # L1 exemption mask applied to the intercept by callers who add one.
     l1_mask: Optional[Array] = None
+    # Record per-iteration coefficient snapshots in the result (the
+    # reference's ModelTracker.models, consumed by --validate-per-iteration).
+    track_iterates: bool = False
 
     def __post_init__(self):
         if (self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
@@ -115,17 +118,17 @@ class GLMOptimizationProblem:
             return minimize_owlqn(
                 _objective_vg, x0, payload, l1=l1_arr,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
-                box=self.box)
+                box=self.box, track_iterates=self.track_iterates)
         if cfg.optimizer_type == OptimizerType.LBFGS:
             return minimize_lbfgs(
                 _objective_vg, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
-                box=self.box)
+                box=self.box, track_iterates=self.track_iterates)
         if cfg.optimizer_type == OptimizerType.TRON:
             return minimize_tron(
                 _objective_vg, _objective_hvp, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
-                box=self.box)
+                box=self.box, track_iterates=self.track_iterates)
         raise ValueError(f"unknown optimizer {cfg.optimizer_type}")
 
     def publish(self, x: Array, history, progressed,

@@ -126,9 +126,10 @@ class _TRONCarry(NamedTuple):
     made_progress: Array
     values: Array
     grad_norms: Array
+    iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
-@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6))
+@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8))
 def _minimize_tron_impl(
     value_and_grad_fn,
     hvp_fn,
@@ -138,6 +139,7 @@ def _minimize_tron_impl(
     tolerance: float,
     max_failures: int,
     box: Optional[BoxConstraints] = None,
+    track_iterates: bool = False,
 ):
     dtype = x0.dtype
     f0, g0 = value_and_grad_fn(x0, data)
@@ -145,12 +147,14 @@ def _minimize_tron_impl(
 
     values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f0)
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(g0n)
+    iterates0 = (jnp.zeros((max_iter + 1,) + x0.shape, dtype).at[0].set(x0)
+                 if track_iterates else None)
 
     init = _TRONCarry(
         it=jnp.int32(0), x=x0, f=f0, g=g0,
         prev_f=f0 + jnp.asarray(jnp.inf, dtype),
         delta=g0n, failures=jnp.int32(0), made_progress=jnp.bool_(True),
-        values=values, grad_norms=grad_norms,
+        values=values, grad_norms=grad_norms, iterates=iterates0,
     )
 
     def cond(c: _TRONCarry) -> Array:
@@ -215,6 +219,11 @@ def _minimize_tron_impl(
         grad_norms = jnp.where(
             improved,
             c.grad_norms.at[c.it + 1].set(jnp.linalg.norm(g_try)), c.grad_norms)
+        # unconditional write: when not improved, x_new == c.x and it does
+        # not advance, so the slot is overwritten by the next accepted step
+        # or sliced off by from_history — no whole-buffer select needed
+        iterates = (c.iterates.at[c.it + 1].set(x_new)
+                    if track_iterates else None)
 
         return _TRONCarry(
             it=it_new, x=x_new, f=f_new, g=g_new,
@@ -222,12 +231,12 @@ def _minimize_tron_impl(
             delta=delta,
             failures=jnp.where(improved, 0, c.failures + 1),
             made_progress=improved | (c.failures + 1 < max_failures),
-            values=values, grad_norms=grad_norms,
+            values=values, grad_norms=grad_norms, iterates=iterates,
         )
 
     final = lax.while_loop(cond, body, init)
     history = RunHistory(values=final.values, grad_norms=final.grad_norms,
-                         num_iterations=final.it)
+                         num_iterations=final.it, iterates=final.iterates)
     return final.x, history, final.made_progress
 
 
@@ -240,6 +249,7 @@ def minimize_tron(
     tolerance: float = DEFAULT_TOLERANCE,
     max_failures: int = DEFAULT_MAX_FAILURES,
     box: Optional[BoxConstraints] = None,
+    track_iterates: bool = False,
 ):
     """Trust-region Newton; returns (x, RunHistory, made_progress).
 
@@ -249,4 +259,4 @@ def minimize_tron(
     reference's OptimizerFactory does (OptimizerFactory.scala:78-79).
     """
     return _minimize_tron_impl(value_and_grad_fn, hvp_fn, x0, data, max_iter,
-                               tolerance, max_failures, box)
+                               tolerance, max_failures, box, track_iterates)

@@ -56,6 +56,7 @@ def train_glm_grid(
     warm_start: bool = True,
     l1_mask: Optional[Array] = None,
     initial_by_weight: Optional[Mapping[float, Array]] = None,
+    track_iterates: bool = False,
 ) -> list[TrainedModel]:
     """Train one GLM per regularization weight, descending, warm-started.
 
@@ -83,7 +84,8 @@ def train_glm_grid(
         )
         problem = GLMOptimizationProblem(
             config=cfg, task=task, normalization=normalization, box=box,
-            compute_variances=compute_variances, l1_mask=l1_mask)
+            compute_variances=compute_variances, l1_mask=l1_mask,
+            track_iterates=track_iterates)
         start = init
         if initial_by_weight is not None and lam in initial_by_weight:
             start = jnp.asarray(initial_by_weight[lam])

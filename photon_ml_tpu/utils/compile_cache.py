@@ -59,8 +59,19 @@ def _machine_fingerprint(jax) -> str:
                  or str(jax.config.jax_platforms or "default"))
     try:
         with open("/proc/cpuinfo") as f:
-            flags = next((ln for ln in f if ln.startswith("flags")), "")
-        parts.append(flags.strip())
+            block = []
+            for ln in f:
+                if not ln.strip():
+                    break  # end of first processor block
+                # Model identity matters beyond the flag list: LLVM enables
+                # tuning "features" like prefer-no-gather per CPU *model*
+                # (Downfall-affected parts), so two hosts with identical
+                # flags can still produce mutually-incompatible AOT code.
+                if ln.split(":")[0].strip() in (
+                        "vendor_id", "cpu family", "model", "model name",
+                        "stepping", "microcode", "flags"):
+                    block.append(ln.strip())
+        parts.extend(block)
     except OSError:  # pragma: no cover - non-Linux
         pass
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]

@@ -46,6 +46,9 @@ class PhotonOptimizationLogEvent(Event):
     regularization_weight: float
     states: Any  # OptimizationResult / tracker
     metrics: Optional[dict[str, float]] = None
+    # Metrics of every per-iteration model snapshot when the driver ran
+    # with --validate-per-iteration (Event.scala:60-66 perIterationMetrics).
+    per_iteration_metrics: Optional[list[dict[str, float]]] = None
 
 
 EventListener = Callable[[Event], None]

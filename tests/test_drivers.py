@@ -136,6 +136,43 @@ class TestLegacyDriver:
                 "--regularization-type", "L1",
             ])
 
+    def test_validate_per_iteration(self, tmp_path):
+        """testRunWithDataValidationPerIteration analog: every optimizer
+        iteration's model snapshot is evaluated on the validation split and
+        logged; the event carries the per-iteration metric list."""
+        from photon_ml_tpu.cli.legacy_driver import LegacyDriver, parse_args
+        from photon_ml_tpu.utils.events import PhotonOptimizationLogEvent
+
+        w = np.random.default_rng(999).normal(size=5)
+        train = str(tmp_path / "train.avro")
+        _make_binary_avro(train, n=250, seed=4, w=w)
+        validate = str(tmp_path / "validate.avro")
+        _make_binary_avro(validate, n=120, seed=5, w=w)
+        driver = LegacyDriver(parse_args([
+            "--training-data-directory", train,
+            "--validating-data-directory", validate,
+            "--output-directory", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "1",
+            "--num-iterations", "25",
+            "--validate-per-iteration", "true",
+        ]))
+        events = []
+        driver.register_listener(events.append)
+        driver.run()
+        opt_events = [e for e in events
+                      if isinstance(e, PhotonOptimizationLogEvent)]
+        assert len(opt_events) == 1
+        per_iter = opt_events[0].per_iteration_metrics
+        k = driver.models[0].result.iterations
+        assert per_iter is not None and len(per_iter) == k + 1
+        key = "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+        # training improves the metric from the zero model to the optimum
+        assert per_iter[-1][key] > per_iter[0][key]
+        # final snapshot's metrics == the model's validation metrics
+        assert per_iter[-1][key] == pytest.approx(
+            driver.per_lambda_metrics[1.0][key], abs=1e-6)
+
     def test_diagnostics_produced(self, tmp_path):
         train = str(tmp_path / "train.avro")
         validate = str(tmp_path / "validate.avro")

@@ -136,3 +136,57 @@ def test_history_trajectory_is_monotone_decreasing(rng):
     assert np.all(np.isfinite(vals))
     assert np.all(np.diff(vals) <= 1e-12), "objective must not increase"
     assert np.all(np.isnan(np.asarray(hist.values)[k + 1:]))
+
+
+def test_track_iterates_records_trajectory(rng):
+    """track_iterates records x_0..x_k (ModelTracker.models analog); the
+    last snapshot equals the returned optimum, and re-evaluating the
+    recorded values matches the history."""
+    import numpy as np
+
+    from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
+    from photon_ml_tpu.optimize.owlqn import minimize_owlqn
+    from photon_ml_tpu.optimize.tron import minimize_tron
+
+    d = 5
+    A = jnp.asarray(np.diag(rng.uniform(1.0, 4.0, size=d)))
+    b = jnp.asarray(rng.normal(size=d))
+
+    def vg(x, _):
+        r = A @ x - b
+        return 0.5 * jnp.dot(r, A @ x - b), A.T @ r
+
+    def hvp(x, v, _):
+        return A.T @ (A @ v)
+
+    x0 = jnp.zeros(d)
+    l1 = 0.01
+    for name, run in [
+        ("lbfgs", lambda: minimize_lbfgs(vg, x0, None, max_iter=20,
+                                         track_iterates=True)),
+        ("owlqn", lambda: minimize_owlqn(vg, x0, None, l1=l1, max_iter=20,
+                                         track_iterates=True)),
+        ("tron", lambda: minimize_tron(vg, hvp, x0, None, max_iter=20,
+                                       track_iterates=True)),
+    ]:
+        x, hist, _ = run()
+        k = int(hist.num_iterations)
+        assert hist.iterates is not None, name
+        its = np.asarray(hist.iterates)
+        np.testing.assert_allclose(its[0], np.zeros(d), err_msg=name)
+        np.testing.assert_allclose(its[k], np.asarray(x), rtol=1e-6,
+                                   err_msg=name)
+        # values in the history correspond to the recorded iterates
+        # (OWL-QN tracks the FULL objective f + l1 |x|)
+        for i in (0, k):
+            v, _ = vg(jnp.asarray(its[i]), None)
+            v = float(v)
+            if name == "owlqn":
+                v += l1 * float(np.abs(its[i]).sum())
+            assert v == pytest.approx(
+                float(np.asarray(hist.values)[i]), rel=1e-5, abs=1e-8), \
+                (name, i)
+
+    # default: no iterates recorded
+    _, hist, _ = minimize_lbfgs(vg, x0, None, max_iter=5)
+    assert hist.iterates is None
