@@ -599,7 +599,34 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     }
 
 
+def _ensure_live_backend(timeout_secs: int = 180) -> None:
+    """Probe the accelerator backend in a SUBPROCESS with a hard timeout and
+    fall back to CPU when it hangs or fails. The axon device tunnel can wedge
+    at backend init (observed: a killed client leaves the remote chip grant
+    stuck and every jax.devices() blocks forever) — a CPU-measured record
+    with a visible fallback marker beats a bench that never prints."""
+    import subprocess
+    import sys
+
+    if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_secs)
+        if proc.returncode == 0:
+            return
+        reason = f"backend probe rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = f"backend probe hung > {timeout_secs}s"
+    _progress(f"{reason}; falling back to CPU for this run")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main():
+    _ensure_live_backend()
     # Persistent XLA compile cache (machine-fingerprinted): the tunnel's
     # remote compiles cost tens of seconds each, and the cache makes every
     # rerun (including the driver's recording run) warm-start.
@@ -635,12 +662,15 @@ def main():
     ingest = bench_ingest()
     _progress("done")
 
+    import jax
+
     print(json.dumps({
         "metric": "logistic_grad_evals_per_sec",
         "value": vg["evals_per_sec"],
         "unit": f"evals/s (N={N_ROWS}, D={DIM}, f32)",
         "vs_baseline": round(vg["evals_per_sec"] / cpu_evals, 2),
         "baseline_evals_per_sec": round(cpu_evals, 2),
+        "backend": jax.default_backend(),
         "hbm_peak_gbps": peak,
         **parity,
         "value_gradient": vg,

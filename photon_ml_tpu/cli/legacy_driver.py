@@ -432,16 +432,28 @@ class LegacyDriver(EventEmitter):
         """Metrics of every per-iteration model snapshot, logged like the
         reference (Driver.computeAndLogModelMetrics :330-349): the iterate
         stack is evaluated as ONE fused grid call — the snapshots are just
-        more rows of the lambda grid to the evaluator kernel."""
+        more rows of the lambda grid to the evaluator kernel.
+
+        The stack is padded back to the fixed [max_iter+1, d] shape (last
+        row repeated) so every lambda and every run hits ONE compiled grid
+        kernel, and de-normalization is a single vmapped call instead of
+        k+1 host-loop dispatches."""
+        import jax
+
+        its = np.asarray(tm.result.iterates)  # [k+1, d]
+        k = its.shape[0] - 1
+        rows = self.params.num_iterations + 1
+        if its.shape[0] < rows:
+            its = np.vstack([its, np.repeat(its[-1:],
+                                            rows - its.shape[0], axis=0)])
+        W = jax.vmap(self.normalization.transform_model_coefficients)(
+            jnp.asarray(its))
         iterate_models = [
-            GeneralizedLinearModel(
-                Coefficients(
-                    means=self.normalization.transform_model_coefficients(
-                        jnp.asarray(x))),
-                self.params.task)
-            for x in tm.result.iterates
+            GeneralizedLinearModel(Coefficients(means=W[i]),
+                                   self.params.task)
+            for i in range(rows)
         ]
-        per_iteration = evaluate_model_grid(iterate_models, batch)
+        per_iteration = evaluate_model_grid(iterate_models, batch)[: k + 1]
         for i, metrics in enumerate(per_iteration):
             for name in sorted(metrics):
                 self.logger.info(
