@@ -599,26 +599,37 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     }
 
 
-def _ensure_live_backend(timeout_secs: int = 180) -> None:
+def _ensure_live_backend(timeout_secs: int = 300) -> None:
     """Probe the accelerator backend in a SUBPROCESS with a hard timeout and
     fall back to CPU when it hangs or fails. The axon device tunnel can wedge
     at backend init (observed: a killed client leaves the remote chip grant
     stuck and every jax.devices() blocks forever) — a CPU-measured record
-    with a visible fallback marker beats a bench that never prints."""
+    with a visible fallback marker beats a bench that never prints.
+
+    The timeout is generous (well past a cold tunnel's normal init) and the
+    probe is TERMinated with a grace period rather than SIGKILLed: killing a
+    client mid-grant-acquisition is exactly what wedges the tunnel."""
     import subprocess
     import sys
 
     if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
         return
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=timeout_secs)
-        if proc.returncode == 0:
+        rc = proc.wait(timeout=timeout_secs)
+        if rc == 0:
             return
-        reason = f"backend probe rc={proc.returncode}"
+        reason = f"backend probe rc={rc}"
     except subprocess.TimeoutExpired:
         reason = f"backend probe hung > {timeout_secs}s"
+        proc.terminate()  # SIGTERM first: let the client release its grant
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
     _progress(f"{reason}; falling back to CPU for this run")
     import jax
 

@@ -136,6 +136,41 @@ class TestLegacyDriver:
                 "--regularization-type", "L1",
             ])
 
+    def test_box_constraints_end_to_end(self, tmp_path):
+        """DriverIntegTest constraint combos: --coefficient-box-constraints
+        bounds are enforced on the published raw-space model."""
+        import json as _json
+
+        from photon_ml_tpu.cli.legacy_driver import LegacyDriver, parse_args
+
+        train = str(tmp_path / "train.avro")
+        _make_binary_avro(train, n=250, seed=6)
+        constraints = _json.dumps([
+            {"name": "f0", "term": "", "lowerBound": -0.05,
+             "upperBound": 0.05},
+            {"name": "f1", "term": "", "upperBound": 0.0},
+        ])
+        driver = LegacyDriver(parse_args([
+            "--training-data-directory", train,
+            "--output-directory", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "0.01",
+            "--num-iterations", "50",
+            "--coefficient-box-constraints", constraints,
+        ]))
+        driver.run()
+        glm = driver.models[0].model
+        imap = driver.train_data.index_map
+        w = np.asarray(glm.coefficients.means)
+        from photon_ml_tpu.io.index_map import feature_key
+        i0 = imap.index_of(feature_key("f0"))
+        i1 = imap.index_of(feature_key("f1"))
+        assert i0 >= 0 and i1 >= 0  # -1 would silently index w[-1]
+        assert -0.05 - 1e-6 <= w[i0] <= 0.05 + 1e-6
+        assert w[i1] <= 1e-6
+        # unconstrained features moved freely
+        assert np.abs(w).max() > 0.06
+
     def test_validate_per_iteration(self, tmp_path):
         """testRunWithDataValidationPerIteration analog: every optimizer
         iteration's model snapshot is evaluated on the validation split and

@@ -159,3 +159,33 @@ def test_tron_values_monotone(rng):
     vals = np.asarray(hist.values)[: k + 1]
     assert np.all(np.isfinite(vals))
     assert np.all(np.diff(vals) <= 1e-10)
+
+
+def test_all_optimizers_agree_from_random_starts(rng):
+    """OptimizerIntegTest analog: on a strongly-convex L2 logistic
+    objective, LBFGS and TRON land on the SAME optimum from several random
+    starting points (and OWL-QN with l1=0 degenerates to it too)."""
+    n, d = 400, 6
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(float)
+    batch = dense_batch(X, y, dtype=jnp.float64)
+    obj = GLMObjective(loss=get_loss("logistic"), l2_lambda=0.5)
+    payload = (obj, batch)
+
+    optima = []
+    for s in range(3):
+        x0 = jnp.asarray(rng.normal(size=d))
+        for run in (
+            lambda: minimize_lbfgs(_obj_vg, x0, payload, max_iter=200,
+                                   tolerance=1e-12),
+            lambda: minimize_tron(_obj_vg, _obj_hvp, x0, payload,
+                                  max_iter=60, tolerance=1e-12),
+            lambda: minimize_owlqn(_obj_vg, x0, payload, l1=0.0,
+                                   max_iter=300, tolerance=1e-12),
+        ):
+            x, _, _ = run()
+            optima.append(np.asarray(x))
+    ref = optima[0]
+    for w in optima[1:]:
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-6)

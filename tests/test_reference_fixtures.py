@@ -302,6 +302,26 @@ def test_heart_avro_sweep(tmp_path, task, opt, reg, norm):
     assert os.path.isdir(os.path.join(out, "output"))
 
 
+@pytest.mark.parametrize("norm", ["SCALE_WITH_STANDARD_DEVIATION",
+                                  "SCALE_WITH_MAX_MAGNITUDE"])
+def test_heart_avro_scaling_normalizations(tmp_path, norm):
+    """testRuntWithFeatureScaling analog: the scale-only normalization
+    types train end-to-end and the back-transformed model still scores
+    raw-space validation data sensibly."""
+    driver, _ = _run_legacy(tmp_path, "scale", [
+        "--task", "LOGISTIC_REGRESSION",
+        "--optimizer", "TRON",
+        "--regularization-type", "L2",
+        "--regularization-weights", "0.01",
+        "--num-iterations", "100",
+        "--normalization-type", norm,
+    ])
+    key = "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+    assert driver.per_lambda_metrics[0.01][key] > 0.7
+    w = np.asarray(driver.models[0].model.coefficients.means)
+    assert np.all(np.isfinite(w))
+
+
 @pytest.mark.parametrize("opt,reg", [("TRON", "L1"), ("TRON", "ELASTIC_NET")])
 def test_invalid_regularization_optimizer_combos(opt, reg):
     """DriverIntegTest.testInvalidRegularizationAndOptimizer analog."""
