@@ -386,6 +386,58 @@ class TestOffHeapIndexMapFlow:
                                           "fixed"))
 
 
+class TestScoringOffHeap:
+    def test_scoring_driver_consumes_offheap_store(self, tmp_path):
+        """The scoring driver's --offheap-indexmap-dir path: train with
+        in-heap maps, score with the pre-built off-heap store — scores
+        must match an in-heap scoring run exactly."""
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=150, seed=31)
+        index_dir = str(tmp_path / "index")
+        index_main([
+            "--input-paths", train,
+            "--output-dir", index_dir,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--num-partitions", "2",
+            "--offheap", "true",
+        ])
+        out = str(tmp_path / "game-out")
+        game_main([
+            "--train-input-dirs", train,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:15,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations", "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:15,1e-7,1.0,1,LBFGS,L2",
+            "--offheap-indexmap-dir", index_dir,
+        ])
+        common = [
+            "--input-data-dirs", train,
+            "--game-model-input-dir", os.path.join(out, "best"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--random-effect-id-set", "userId",
+        ]
+        score_main(common + ["--output-dir", str(tmp_path / "s1"),
+                             "--offheap-indexmap-dir", index_dir])
+        score_main(common + ["--output-dir", str(tmp_path / "s2")])
+        s1 = load_scored_items(
+            os.path.join(str(tmp_path / "s1"), "scores", "part-00000.avro"))
+        s2 = load_scored_items(
+            os.path.join(str(tmp_path / "s2"), "scores", "part-00000.avro"))
+        np.testing.assert_allclose(
+            [r["predictionScore"] for r in s1],
+            [r["predictionScore"] for r in s2], rtol=1e-6)
+
+
 class TestMultipleEvaluators:
     """DriverTest.multipleEvaluatorTypeProvider analog: every requested
     evaluator runs per CD sweep and lands in validation_metrics; the FIRST
