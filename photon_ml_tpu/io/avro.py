@@ -351,11 +351,14 @@ def compile_reader(schema: Any, names: dict) -> Any:
         if isinstance(s, str) and s not in PRIMITIVES:
             name = s
 
+            # reference memo lives under "ref:" so an inline record whose
+            # FULLNAME equals this short name can never shadow the
+            # names-table resolution read_datum uses
             def named(dec, _n=name):
-                r = memo.get(_n)
+                r = memo.get("ref:" + _n)
                 if r is None:
                     r = build(names[_n])
-                    memo[_n] = r
+                    memo["ref:" + _n] = r
                 return r(dec)
 
             return named
@@ -375,7 +378,7 @@ def compile_reader(schema: Any, names: dict) -> Any:
         if t == "string":
             return BinaryDecoder.read_string
         if t == "union":
-            branches = s if isinstance(s, list) else s["type"]
+            branches = s  # _schema_type says "union" only for list nodes
             readers = tuple(build(b) for b in branches)
 
             def r_union(dec):
@@ -462,6 +465,128 @@ def compile_reader(schema: Any, names: dict) -> Any:
     return build(schema)
 
 
+def compile_writer(schema: Any, names: dict) -> Any:
+    """Schema → specialized encode closure tree (write-side analog of
+    :func:`compile_reader`; used by ``write_container`` so score/model
+    output files aren't bottlenecked on per-datum schema dispatch)."""
+    memo: dict[str, Any] = {}
+
+    def build(s):
+        if isinstance(s, str) and s not in PRIMITIVES:
+            name = s
+
+            def named(enc, datum, _n=name):
+                w = memo.get("ref:" + _n)
+                if w is None:
+                    w = build(names[_n])
+                    memo["ref:" + _n] = w
+                return w(enc, datum)
+
+            return named
+        t = _schema_type(s)
+        if t == "null":
+            return lambda enc, datum: None
+        if t == "boolean":
+            return lambda enc, datum: enc.write_boolean(bool(datum))
+        if t in ("int", "long"):
+            return lambda enc, datum: enc.write_long(int(datum))
+        if t == "float":
+            return lambda enc, datum: enc.write_float(float(datum))
+        if t == "double":
+            return lambda enc, datum: enc.write_double(float(datum))
+        if t == "bytes":
+            return lambda enc, datum: enc.write_bytes(bytes(datum))
+        if t == "string":
+            return lambda enc, datum: enc.write_string(str(datum))
+        if t == "union":
+            branches = s  # _schema_type says "union" only for list nodes
+            writers = tuple(build(b) for b in branches)
+            kinds = [_schema_type(names.get(b, b) if isinstance(b, str)
+                                  else b) for b in branches]
+            if len(branches) == 2 and kinds.count("null") == 1:
+                # the reference schemas' dominant shape: [null, X] — skip
+                # the per-datum type-matching walk entirely
+                ni = kinds.index("null")
+                oi = 1 - ni
+
+                def w_union2(enc, datum):
+                    if datum is None:
+                        enc.write_long(ni)
+                    else:
+                        enc.write_long(oi)
+                        writers[oi](enc, datum)
+
+                return w_union2
+
+            def w_union(enc, datum):
+                i = _union_branch(branches, datum, names)
+                enc.write_long(i)
+                writers[i](enc, datum)
+
+            return w_union
+        if t == "record":
+            nm = s.get("name")
+            ns = s.get("namespace")
+            full = (f"{ns}.{nm}" if ns and nm and "." not in nm else nm)
+            if full and full in memo:
+                return memo[full]
+            if full:
+                def forward(enc, datum, _n=full):
+                    return memo[_n](enc, datum)
+
+                memo[full] = forward
+            field_writers = tuple(
+                (f["name"], f.get("default"), "default" in f,
+                 build(f["type"]))
+                for f in s["fields"])
+
+            def w_record(enc, datum):
+                for name, default, has_default, wr in field_writers:
+                    if name in datum:
+                        wr(enc, datum[name])
+                    elif has_default:
+                        wr(enc, default)
+                    else:
+                        raise ValueError(
+                            f"missing field {name!r} with no default")
+
+            if full:
+                memo[full] = w_record
+            return w_record
+        if t == "array":
+            item = build(s["items"])
+
+            def w_array(enc, datum):
+                items = list(datum)
+                if items:
+                    enc.write_long(len(items))
+                    for x in items:
+                        item(enc, x)
+                enc.write_long(0)
+
+            return w_array
+        if t == "map":
+            value = build(s["values"])
+
+            def w_map(enc, datum):
+                if datum:
+                    enc.write_long(len(datum))
+                    for k, v in datum.items():
+                        enc.write_string(str(k))
+                        value(enc, v)
+                enc.write_long(0)
+
+            return w_map
+        if t == "enum":
+            index_of = {sym: i for i, sym in enumerate(s["symbols"])}
+            return lambda enc, datum: enc.write_long(index_of[datum])
+        if t == "fixed":
+            return lambda enc, datum: enc.out.write(bytes(datum))
+        raise ValueError(f"unsupported schema type {t!r}")
+
+    return build(schema)
+
+
 # ---------------------------------------------------------------------------
 # Object container files
 # ---------------------------------------------------------------------------
@@ -473,6 +598,7 @@ def write_container(path: str, schema: Any, records: Iterable[dict],
     """Write an Avro object container file (spec: header + data blocks)."""
     schema = parse_schema(schema)
     names = _names_index(schema)
+    writer = compile_writer(schema, names)
     sync = os.urandom(SYNC_SIZE)
 
     with open(path, "wb") as fh:
@@ -512,7 +638,7 @@ def write_container(path: str, schema: Any, records: Iterable[dict],
             count = 0
 
         for rec in records:
-            write_datum(benc, schema, rec, names)
+            writer(benc, rec)
             count += 1
             if count >= sync_interval:
                 flush()

@@ -14,6 +14,7 @@ from photon_ml_tpu.io.avro import (
     BinaryEncoder,
     _names_index,
     compile_reader,
+    compile_writer,
     read_datum,
     write_datum,
 )
@@ -24,6 +25,10 @@ def _roundtrip(schema, datum):
     buf = io.BytesIO()
     write_datum(BinaryEncoder(buf), schema, datum, names)
     raw = buf.getvalue()
+    # the compiled writer must emit byte-identical output
+    buf2 = io.BytesIO()
+    compile_writer(schema, names)(BinaryEncoder(buf2), datum)
+    assert buf2.getvalue() == raw
     interpreted = read_datum(BinaryDecoder(raw), schema, names)
     compiled_fn = compile_reader(schema, names)
     compiled = compiled_fn(BinaryDecoder(raw))
@@ -72,6 +77,28 @@ def test_nested_record_with_named_reference():
     datum = {"f": {"name": "a", "value": 1.0},
              "more": [{"name": "b", "value": 2.0}],
              "meta": {"k": "v"}}
+    assert _roundtrip(schema, datum) == datum
+
+
+def test_bare_reference_resolves_like_read_datum():
+    """A namespace-less inline record must not shadow a bare short-name
+    reference whose names-table entry points at a different (namespaced)
+    type — both decoders must resolve the reference identically."""
+    schema = {
+        "name": "Top", "type": "record",
+        "fields": [
+            {"name": "a", "type": {
+                "name": "X", "type": "record",
+                "fields": [{"name": "f", "type": "long"}]}},
+            {"name": "b", "type": {
+                "name": "X", "namespace": "ns", "type": "record",
+                "fields": [{"name": "g", "type": "string"}]}},
+            {"name": "c", "type": "X"},  # bare reference
+        ],
+    }
+    names = _names_index(schema)
+    # names-table precedence: last definition wins for the short key
+    datum = {"a": {"f": 3}, "b": {"g": "hi"}, "c": {"g": "ref"}}
     assert _roundtrip(schema, datum) == datum
 
 
