@@ -122,6 +122,133 @@ def build_index_map_from_records(
     return IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
 
 
+def _columnar_parts(path: str):
+    """Per-part columnar reads for a file or part directory, or None when
+    any part can't take the native columnar path."""
+    from photon_ml_tpu.io.native_avro import read_columnar
+
+    if os.path.isdir(path):
+        # EXACTLY read_directory's filter (avro.py read_directory): the
+        # two paths must always see the same file set
+        paths = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.endswith(".avro")]
+    else:
+        paths = [path]
+    out = []
+    for p in paths:
+        r = read_columnar(p)
+        if r is None:
+            return None
+        out.append(r)
+    return out or None
+
+
+def _feature_triples(col, num_prior_rows_total: int):
+    """array<record> feature column → (row_of_entry, key_of_entry arrays).
+
+    Names/terms arrive INTERNED from the native decoder (int32 codes +
+    unique tables), so keys are composed once per unique (name, term)
+    pair; the per-entry work is integer arithmetic only."""
+    lengths = col["lengths"]
+    name_codes = col["subs"][NAME]["codes"].astype(np.int64)
+    name_uniq = col["subs"][NAME]["uniq"]
+    term_codes = col["subs"][TERM]["codes"].astype(np.int64)
+    term_uniq = col["subs"][TERM]["uniq"]
+    values = col["subs"][VALUE]["values"]
+    rows = np.repeat(
+        np.arange(len(lengths), dtype=np.int64) + num_prior_rows_total,
+        lengths)
+    nt = max(len(term_uniq), 1)
+    pair = name_codes * nt + term_codes
+    upair, inv_p = np.unique(pair, return_inverse=True)
+    ukeys = [feature_key(str(name_uniq[p // nt]), str(term_uniq[p % nt]))
+             for p in upair]
+    return rows, inv_p, ukeys, values
+
+
+def _columnar_labeled_points(
+        path: str,
+        field_names: FieldNames,
+        index_map: Optional[IndexMap],
+        selected: Optional[set],
+        add_intercept: bool) -> Optional[LabeledData]:
+    """Vectorized assembly from native columnar reads; None → caller falls
+    back to the per-record interpreted path."""
+    parts = _columnar_parts(path)
+    if parts is None:
+        return None
+    for _, _, cols in parts:
+        r = cols.get(field_names.response)
+        if r is None or "values" not in r:
+            return None
+        if r.get("nulls") is not None and r["nulls"].any():
+            # interpreted path raises on a null response — keep that
+            return None
+        feats = cols.get(field_names.features)
+        if (feats is None or "subs" not in feats
+                or any(k not in feats["subs"] for k in (NAME, TERM, VALUE))
+                or any("codes" not in feats["subs"][k]
+                       for k in (NAME, TERM))):
+            return None
+
+    n = sum(count for _, count, _ in parts)
+    labels = np.zeros(n)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    all_rows, all_keyid, all_vals = [], [], []
+    key_tables = []
+    base = 0
+    for _, count, cols in parts:
+        labels[base:base + count] = cols[field_names.response]["values"]
+        off = cols.get(field_names.offset)
+        if off is not None and "values" in off:
+            offsets[base:base + count] = off["values"]  # nulls decode as 0
+        wt = cols.get(field_names.weight)
+        if wt is not None and "values" in wt:
+            weights[base:base + count] = np.where(
+                wt["nulls"] == 1, 1.0, wt["values"])
+        rows, keyid, ukeys, values = _feature_triples(
+            cols[field_names.features], base)
+        all_rows.append(rows)
+        all_keyid.append(keyid + sum(len(t) for t in key_tables))
+        all_vals.append(values)
+        key_tables.append(ukeys)
+        base += count
+
+    rows = np.concatenate(all_rows) if all_rows else np.zeros(0, np.int64)
+    keyid = np.concatenate(all_keyid) if all_keyid else np.zeros(0, np.int64)
+    vals = np.concatenate(all_vals) if all_vals else np.zeros(0)
+    ukeys: list[str] = [k for t in key_tables for k in t]
+
+    if selected is not None:
+        kept = np.asarray([k in selected for k in ukeys])
+    else:
+        kept = np.ones(len(ukeys), bool)
+    if index_map is None:
+        index_map = IndexMap.from_keys(
+            [k for k, keep in zip(ukeys, kept) if keep],
+            add_intercept=add_intercept)
+    ucol = np.asarray([index_map.index_of(k) if keep else -1
+                       for k, keep in zip(ukeys, kept)], np.int64)
+    cols_of = ucol[keyid]
+    ok = cols_of >= 0
+    rows, cols_of, vals = rows[ok], cols_of[ok], vals[ok]
+
+    d = len(index_map)
+    rc = rows * np.int64(d) + cols_of
+    if len(np.unique(rc)) != len(rc):
+        raise ValueError("Duplicate feature in a record (same name+term "
+                         "appears twice)")
+    intercept_idx = index_map.intercept_index
+    if intercept_idx is not None:
+        rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+        cols_of = np.concatenate(
+            [cols_of, np.full(n, intercept_idx, np.int64)])
+        vals = np.concatenate([vals, np.ones(n)])
+    features = sp.csr_matrix((vals, (rows, cols_of)), shape=(n, d))
+    return LabeledData(features, labels, offsets, weights, index_map)
+
+
 def load_labeled_points_avro(
         path: str,
         field_names: FieldNames = TRAINING_EXAMPLE_FIELD_NAMES,
@@ -130,10 +257,19 @@ def load_labeled_points_avro(
         add_intercept: bool = True) -> LabeledData:
     """Legacy avro ingestion (io/GLMSuite.scala:98-137 + toLabeledPoints):
     per record sparse features via the index map, intercept column set to 1
-    when the map carries the intercept key, offset/weight defaults 0/1."""
+    when the map carries the intercept key, offset/weight defaults 0/1.
+
+    Dispatches to the native columnar decoder (native/avro_columnar.cpp,
+    ~20x at ingestion scale) and falls back to the interpreted per-record
+    path when the library or schema shape is unavailable."""
+    selected_early = (load_selected_features(selected_features_file)
+                      if selected_features_file else None)
+    fast = _columnar_labeled_points(path, field_names, index_map,
+                                    selected_early, add_intercept)
+    if fast is not None:
+        return fast
     records = _read_records(path)
-    selected = (load_selected_features(selected_features_file)
-                if selected_features_file else None)
+    selected = selected_early
     if index_map is None:
         index_map = build_index_map_from_records(
             records, field_names, selected, add_intercept)
@@ -372,6 +508,184 @@ def _id_from_record(rec: dict, id_type: str) -> str:
     return str(v)
 
 
+def _columnar_game_dataset(
+        paths: Sequence[str],
+        feature_shard_sections: dict[str, Sequence[str]],
+        index_maps: dict[str, IndexMap],
+        id_types: Sequence[str],
+        response_required: bool) -> Optional[GameDataset]:
+    """Vectorized GAME assembly from native columnar reads (the 20M-row
+    ingestion path); None → interpreted fallback."""
+    from photon_ml_tpu.io.native_avro import arena_strings
+
+    all_parts = []
+    for p in paths:
+        parts = _columnar_parts(p)
+        if parts is None:
+            return None
+        all_parts.extend(parts)
+    if not all_parts:
+        return None
+    sections_needed = sorted({s for secs in feature_shard_sections.values()
+                              for s in secs})
+    for schema, _, cols in all_parts:
+        field_types = {f["name"]: f["type"]
+                       for f in (schema.get("fields", [])
+                                 if isinstance(schema, dict) else [])}
+        for sec in sections_needed:
+            c = cols.get(sec)
+            if (c is None or "subs" not in c
+                    or any(k not in c["subs"] for k in (NAME, TERM, VALUE))
+                    or any("codes" not in c["subs"][k]
+                           for k in (NAME, TERM))):
+                return None
+            if isinstance(field_types.get(sec), list):
+                # nullable section: the interpreted path raises a
+                # per-record error for null sections — keep that contract
+                return None
+        u = cols.get(UID)
+        if u is not None and "arena" not in u:
+            # numeric uid: the interpreted path stringifies it — fall back
+            return None
+        # top-level id fields: strings, or integer columns (str(int)
+        # matches the interpreted path's str(v) exactly); float ids keep
+        # the interpreted path
+        from photon_ml_tpu.io.native_avro import OP_LONG as _OP_LONG
+        for t in id_types:
+            c = cols.get(t)
+            if (c is not None and "arena" not in c
+                    and c.get("op") != _OP_LONG):
+                return None
+        if response_required and (RESPONSE not in cols
+                                  or "values" not in cols[RESPONSE]):
+            return None
+
+    n = sum(c for _, c, _ in all_parts)
+    responses = np.full(n, np.nan)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    uids_parts = []
+    have_uid = False
+    ids_obj = {t: np.full(n, None, dtype=object) for t in id_types}
+
+    shard_acc: dict[str, list] = {s: [] for s in feature_shard_sections}
+    base = 0
+    for _, count, cols in all_parts:
+        r = cols.get(RESPONSE)
+        if r is not None and "values" in r:
+            vals = r["values"].copy()
+            null_mask = r["nulls"] == 1
+            if response_required and null_mask.any():
+                raise ValueError(
+                    f"record {base + int(np.argmax(null_mask))} has no "
+                    f"response field")
+            vals[null_mask] = np.nan
+            responses[base:base + count] = vals
+        elif response_required:
+            raise ValueError(f"record {base} has no response field")
+        off = cols.get(OFFSET)
+        if off is not None and "values" in off:
+            offsets[base:base + count] = off["values"]
+        wt = cols.get(WEIGHT)
+        if wt is not None and "values" in wt:
+            weights[base:base + count] = np.where(
+                wt["nulls"] == 1, 1.0, wt["values"])
+        u = cols.get(UID)
+        if u is not None and "arena" in u:
+            s = arena_strings(u["arena"], u["offsets"])
+            if (u["nulls"] == 0).any():
+                have_uid = True
+            s[u["nulls"] == 1] = ""
+            uids_parts.append(s)
+        else:
+            uids_parts.append(np.full(count, "", dtype=object))
+
+        for t in id_types:
+            c = cols.get(t)
+            if c is None:
+                continue
+            if "arena" in c:
+                s = arena_strings(c["arena"], c["offsets"])
+                ok = (c["nulls"] == 0) & (s != "")
+                ids_obj[t][base:base + count][ok] = s[ok]
+            elif "values" in c:
+                iv = c["values"].astype(np.int64)
+                uniq, inv = np.unique(iv, return_inverse=True)
+                s = np.asarray([str(int(u)) for u in uniq],
+                               dtype=object)[inv]
+                ok = c["nulls"] == 0
+                ids_obj[t][base:base + count][ok] = s[ok]
+        m = cols.get(META_DATA_MAP)
+        if m is not None and "key_codes" in m:
+            pair_rows = np.repeat(
+                np.arange(count, dtype=np.int64) + base, m["lengths"])
+            key_uniq = m["key_uniq"]
+            for t in id_types:
+                matches = np.flatnonzero(key_uniq == t)
+                if len(matches) == 0:
+                    continue
+                hit = m["key_codes"] == matches[0]
+                if hit.any():
+                    rows_t = pair_rows[hit]
+                    vals_t = m["val_uniq"][m["val_codes"][hit]]
+                    still = np.asarray(
+                        [ids_obj[t][rr] is None for rr in rows_t])
+                    # later map entries win like dict construction did
+                    ids_obj[t][rows_t[still]] = vals_t[still]
+
+        for shard, sections in feature_shard_sections.items():
+            for sec in sections:
+                rows, keyid, ukeys, values = _feature_triples(
+                    cols[sec], base)
+                shard_acc[shard].append((rows, keyid, ukeys, values))
+        base += count
+
+    for t in id_types:
+        missing = np.asarray([v is None for v in ids_obj[t]])
+        if missing.any():
+            raise ValueError(
+                f"Cannot find id in either record field {t!r} or in "
+                f"metadataMap with key {t!r}")
+
+    shards = {}
+    for shard, acc in shard_acc.items():
+        imap = index_maps[shard]
+        rows_l, cols_l, vals_l = [], [], []
+        for rows, keyid, ukeys, values in acc:
+            ucol = np.asarray([imap.index_of(k) for k in ukeys], np.int64)
+            c = ucol[keyid]
+            ok = c >= 0
+            rows_l.append(rows[ok])
+            cols_l.append(c[ok])
+            vals_l.append(values[ok])
+        rows = (np.concatenate(rows_l) if rows_l
+                else np.zeros(0, np.int64))
+        cvec = (np.concatenate(cols_l) if cols_l
+                else np.zeros(0, np.int64))
+        vals = np.concatenate(vals_l) if vals_l else np.zeros(0)
+        d = len(imap)
+        rc = rows * np.int64(d) + cvec
+        if len(np.unique(rc)) != len(rc):
+            raise ValueError(
+                f"Duplicate feature in a record for shard {shard!r}")
+        intercept_idx = imap.intercept_index
+        if intercept_idx is not None:
+            rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+            cvec = np.concatenate(
+                [cvec, np.full(n, intercept_idx, np.int64)])
+            vals = np.concatenate([vals, np.ones(n)])
+        shards[shard] = sp.csr_matrix((vals, (rows, cvec)), shape=(n, d))
+
+    ds = GameDataset(responses=responses, feature_shards=shards,
+                     offsets=offsets, weights=weights)
+    for t in id_types:
+        ds.encode_ids(t, np.asarray([str(v) for v in ids_obj[t]],
+                                    dtype=object))
+    if have_uid:
+        ds.uids = np.concatenate(uids_parts).astype(object)
+    return ds
+
+
 def load_game_dataset_avro(
         path: str | Sequence[str],
         feature_shard_sections: dict[str, Sequence[str]],
@@ -384,7 +698,14 @@ def load_game_dataset_avro(
     columns, dictionary-encoded id columns, uids kept when present.
 
     ``path`` may be a single file/directory or a list of them (the dated
-    daily-partition layout resolves to several directories)."""
+    daily-partition layout resolves to several directories). Dispatches to
+    the native columnar decoder when available (falls back per schema
+    shape)."""
+    paths = [path] if isinstance(path, str) else list(path)
+    fast = _columnar_game_dataset(paths, feature_shard_sections,
+                                  index_maps, id_types, response_required)
+    if fast is not None:
+        return fast
     if isinstance(path, str):
         records = _read_records(path)
     else:

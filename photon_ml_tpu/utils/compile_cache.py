@@ -86,6 +86,21 @@ def enable_persistent_compile_cache() -> bool:
         return True
     if os.environ.get("PHOTON_DISABLE_COMPILE_CACHE"):
         return False
+    # CPU-only processes skip persistence: XLA:CPU AOT reloads warn on the
+    # loader's own tuning-flag set (prefer-no-gather/scatter) even for
+    # self-compiled entries, and CPU compiles are seconds — the cache
+    # exists for the remote accelerator's tens-of-seconds compiles.
+    try:
+        import jax as _jax
+
+        # an in-process jax_platforms override (scripts pin "cpu" before
+        # first backend use) wins over the environment's default
+        platforms = (str(_jax.config.jax_platforms or "")
+                     or os.environ.get("JAX_PLATFORMS", "")).strip().lower()
+    except Exception:  # pragma: no cover
+        platforms = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
+    if platforms.startswith("cpu"):
+        return False
     base_dir = os.environ.get("PHOTON_COMPILE_CACHE_DIR", _DEFAULT_DIR)
     try:
         import jax

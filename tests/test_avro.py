@@ -7,6 +7,8 @@ the schema feature matrix so the two can never drift silently.
 
 import io
 
+import numpy as np
+
 import pytest
 
 from photon_ml_tpu.io.avro import (
@@ -119,3 +121,42 @@ def test_same_short_name_across_namespaces_not_conflated():
     }
     datum = {"x": {"a": 5}, "y": {"b": "hi"}}
     assert _roundtrip(schema, datum) == datum
+
+
+def test_columnar_nullable_numeric_subfield(tmp_path):
+    """Null entries in a nullable NUMERIC sub-field of a feature array must
+    decode as 0.0 without touching the (empty) string-intern tables — the
+    pass-asymmetric interning regression corrupted the heap here."""
+    pytest.importorskip("photon_ml_tpu.io.native_loader")
+    from photon_ml_tpu.io.avro import write_container
+    from photon_ml_tpu.io.native_avro import read_columnar
+    from photon_ml_tpu.io.native_loader import get_native_lib
+
+    if get_native_lib() is None:
+        pytest.skip("native library unavailable")
+    schema = {
+        "name": "R", "type": "record",
+        "fields": [
+            {"name": "feats", "type": {"type": "array", "items": {
+                "name": "F", "type": "record",
+                "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "value", "type": ["null", "double"],
+                     "default": None},
+                ]}}},
+        ],
+    }
+    recs = [{"feats": [{"name": "a", "value": 1.5},
+                       {"name": "b", "value": None}]},
+            {"feats": [{"name": "a", "value": None}]}]
+    path = str(tmp_path / "x.avro")
+    write_container(path, schema, recs)
+    out = read_columnar(path)
+    assert out is not None
+    _, n, cols = out
+    assert n == 2
+    f = cols["feats"]
+    assert list(f["lengths"]) == [2, 1]
+    np.testing.assert_allclose(f["subs"]["value"]["values"], [1.5, 0.0, 0.0])
+    name_strs = f["subs"]["name"]["uniq"][f["subs"]["name"]["codes"]]
+    assert list(name_strs) == ["a", "b", "a"]
