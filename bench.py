@@ -549,6 +549,36 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
     }
 
 
+def bench_avro_ingest(n=200_000, d=30) -> dict:
+    """Avro container → LabeledData through the native columnar decoder
+    (native/avro_columnar.cpp; DataProcessingUtils.scala's JVM decode is
+    the reference analog)."""
+    import tempfile
+
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro import write_container
+    from photon_ml_tpu.io.data_format import load_labeled_points_avro
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(float)
+    recs = [{"uid": f"r{i}", "label": float(y[i]),
+             "features": [{"name": f"f{j}", "term": "",
+                           "value": float(X[i, j])} for j in range(d)],
+             "metadataMap": None, "weight": None, "offset": None}
+            for i in range(n)]
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bench.avro")
+        write_container(path, schemas.TRAINING_EXAMPLE, recs)
+        del recs
+        t0 = time.perf_counter()
+        data = load_labeled_points_avro(path)
+        dt = time.perf_counter() - t0
+    return {"rows": n, "nnz": int(data.features.nnz),
+            "records_per_sec": round(n / dt, 0),
+            "features_per_sec": round(data.features.nnz / dt, 0)}
+
+
 def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
                  n_entities=50_000) -> dict:
     """10M-row ingestion: vectorized ELL pack + random-effect block build
@@ -669,6 +699,8 @@ def main():
     glmix = bench_glmix()
     _progress("full-GAME bench")
     game_full = bench_game_full()
+    _progress("avro ingest bench")
+    avro_ingest = bench_avro_ingest()
     _progress("ingest bench")
     ingest = bench_ingest()
     _progress("done")
@@ -690,6 +722,7 @@ def main():
         "owlqn": owlqn,
         "glmix": glmix,
         "game_full": game_full,
+        "avro_ingest": avro_ingest,
         "ingest": ingest,
     }))
 

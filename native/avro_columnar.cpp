@@ -262,7 +262,13 @@ bool do_scalar(Cursor& cur, const Field& f, FieldOut& out, int64_t row,
                                     static_cast<size_t>(len));
                     char* endp = nullptr;
                     v = std::strtod(tmp.c_str(), &endp);
-                    if (endp == tmp.c_str()) return false;  // not numeric
+                    // Python float() strictness: the WHOLE string must
+                    // parse (trailing whitespace tolerated); a partial
+                    // parse fails the decode, which the caller turns into
+                    // an interpreted-path fallback
+                    while (endp && *endp == ' ') ++endp;
+                    if (endp == tmp.c_str() || (endp && *endp != '\0'))
+                        return false;
                     break;
                 }
                 case OP_NULL:

@@ -143,6 +143,21 @@ def _columnar_parts(path: str):
     return out or None
 
 
+def _feature_col_ok(col) -> bool:
+    """A feature array column usable by :func:`_feature_triples`: record
+    items with STRING name/term (interned codes) and a numeric value."""
+    from photon_ml_tpu.io.native_avro import OP_STRING as _OP_STRING
+
+    if col is None or "subs" not in col:
+        return False
+    subs = col["subs"]
+    if any(k not in subs for k in (NAME, TERM, VALUE)):
+        return False
+    if any(subs[k].get("op") != _OP_STRING for k in (NAME, TERM)):
+        return False
+    return subs[VALUE].get("op") != _OP_STRING
+
+
 def _feature_triples(col, num_prior_rows_total: int):
     """array<record> feature column → (row_of_entry, key_of_entry arrays).
 
@@ -184,12 +199,14 @@ def _columnar_labeled_points(
         if r.get("nulls") is not None and r["nulls"].any():
             # interpreted path raises on a null response — keep that
             return None
-        feats = cols.get(field_names.features)
-        if (feats is None or "subs" not in feats
-                or any(k not in feats["subs"] for k in (NAME, TERM, VALUE))
-                or any("codes" not in feats["subs"][k]
-                       for k in (NAME, TERM))):
+        if not _feature_col_ok(cols.get(field_names.features)):
             return None
+        for aux in (field_names.offset, field_names.weight):
+            c = cols.get(aux)
+            if c is not None and "values" not in c:
+                # e.g. a string-typed offset the interpreted path parses —
+                # silent 0/1 defaults would be wrong; fall back
+                return None
 
     n = sum(count for _, count, _ in parts)
     labels = np.zeros(n)
@@ -533,11 +550,7 @@ def _columnar_game_dataset(
                        for f in (schema.get("fields", [])
                                  if isinstance(schema, dict) else [])}
         for sec in sections_needed:
-            c = cols.get(sec)
-            if (c is None or "subs" not in c
-                    or any(k not in c["subs"] for k in (NAME, TERM, VALUE))
-                    or any("codes" not in c["subs"][k]
-                           for k in (NAME, TERM))):
+            if not _feature_col_ok(cols.get(sec)):
                 return None
             if isinstance(field_types.get(sec), list):
                 # nullable section: the interpreted path raises a
@@ -547,6 +560,10 @@ def _columnar_game_dataset(
         if u is not None and "arena" not in u:
             # numeric uid: the interpreted path stringifies it — fall back
             return None
+        for aux in (OFFSET, WEIGHT):
+            c = cols.get(aux)
+            if c is not None and "values" not in c:
+                return None
         # top-level id fields: strings, or integer columns (str(int)
         # matches the interpreted path's str(v) exactly); float ids keep
         # the interpreted path
@@ -592,7 +609,7 @@ def _columnar_game_dataset(
                 wt["nulls"] == 1, 1.0, wt["values"])
         u = cols.get(UID)
         if u is not None and "arena" in u:
-            s = arena_strings(u["arena"], u["offsets"])
+            s = arena_strings(u["arena"], u["offsets"], dedup=False)
             if (u["nulls"] == 0).any():
                 have_uid = True
             s[u["nulls"] == 1] = ""

@@ -229,6 +229,11 @@ def read_columnar(path: str) -> Optional[tuple[Any, int, dict]]:
     sizes = np.zeros(len(descs) * sstride, np.int64)
     rc = lib.photon_avro_count(data_arr, len(data), n, prog, len(prog),
                                max_subs, sizes)
+    if rc == 1:
+        # data the program can't walk (e.g. a non-numeric string in a
+        # scalar union the interpreted path would have kept as a str) —
+        # fall back rather than fail the load
+        return None
     if rc != 0:
         raise ValueError(f"native avro count failed rc={rc} for {path!r}")
 
@@ -240,7 +245,7 @@ def read_columnar(path: str) -> Optional[tuple[Any, int, dict]]:
         return a.ctypes.data_as(ctypes.c_void_p)
 
     scratch = []  # backing arrays that outlive the fill call
-    for i, (name, op, sub_names, _sub_nulls) in enumerate(descs):
+    for i, (name, op, sub_names, sub_ops) in enumerate(descs):
         row = sizes[i * sstride:(i + 1) * sstride]
         col: dict[str, Any] = {"op": op}
         base = i * pstride
@@ -288,7 +293,7 @@ def read_columnar(path: str) -> Optional[tuple[Any, int, dict]]:
             ptrs[base + 4] = vp(col["lengths"])
             subs: dict[str, dict] = {}
             for s, sname in enumerate(sub_names):
-                sub: dict[str, Any] = {}
+                sub: dict[str, Any] = {"op": sub_ops[s]}
                 nuniq = int(row[7 + 2 * s])
                 ubytes = int(row[7 + 2 * s + 1])
                 sub["values"] = np.zeros(total, np.float64)
@@ -322,18 +327,27 @@ def read_columnar(path: str) -> Optional[tuple[Any, int, dict]]:
     return schema, n, columns
 
 
-def arena_strings(arena: np.ndarray, offsets: np.ndarray) -> np.ndarray:
-    """Offsets+arena → object array of python strings, decoded ONCE per
-    unique byte run (ingestion files repeat a few thousand feature names
-    millions of times)."""
+def arena_strings(arena: np.ndarray, offsets: np.ndarray,
+                  dedup: bool = True) -> np.ndarray:
+    """Offsets+arena → object array of python strings.
+
+    ``dedup`` caches decoded runs (unique tables and repeated values);
+    pass False for near-unique columns like uids, where a one-entry-per-
+    row cache is pure overhead."""
     n = len(offsets) - 1
     if n <= 0:
         return np.zeros(0, dtype=object)
     b = arena.tobytes()
     lengths = np.diff(offsets.astype(np.int64))
     out = np.empty(n, dtype=object)
-    cache: dict[bytes, str] = {}
     pos = 0
+    if not dedup:
+        for i in range(n):
+            ln = int(lengths[i])
+            out[i] = b[pos:pos + ln].decode("utf-8")
+            pos += ln
+        return out
+    cache: dict[bytes, str] = {}
     for i in range(n):
         ln = int(lengths[i])
         raw = b[pos:pos + ln]

@@ -90,6 +90,9 @@ def enable_persistent_compile_cache() -> bool:
     # loader's own tuning-flag set (prefer-no-gather/scatter) even for
     # self-compiled entries, and CPU compiles are seconds — the cache
     # exists for the remote accelerator's tens-of-seconds compiles.
+    # Known gap: a host with NO platform pin that resolves to CPU by
+    # default still persists — resolving the real backend here would force
+    # the init this function must avoid (see the fingerprint note below).
     try:
         import jax as _jax
 
