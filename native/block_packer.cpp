@@ -19,6 +19,7 @@
 // and the loop is memory-bound on the CSR stream.
 
 #include <cstdint>
+#include <cstring>
 
 extern "C" {
 
@@ -63,6 +64,37 @@ int photon_pack_projected_rows(
                 dst[lo] = data[k];
             }
         }
+    }
+    return 0;
+}
+
+// ELL pack: CSR rows -> fixed-width [n, k] index/value planes (the
+// photon_ml_tpu/data/batch.py ell_from_csr hot loop without the two
+// nnz-length fancy-index scatters). Rows longer than k are an error (the
+// caller sizes k = max row length, padded).
+int photon_pack_ell(
+    int64_t n_rows,
+    const int64_t* indptr,   // [n_rows + 1]
+    const int32_t* indices,  // [nnz]
+    const float* data,       // [nnz]
+    int64_t k,
+    int32_t* out_idx,        // [n_rows * k], zero-initialized
+    float* out_val)          // [n_rows * k], zero-initialized
+{
+    if (n_rows < 0 || k <= 0 || !indptr || !indices || !data ||
+        !out_idx || !out_val) {
+        return 1;
+    }
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const int64_t start = indptr[r];
+        const int64_t len = indptr[r + 1] - start;
+        if (len < 0 || len > k) return 1;
+        int32_t* di = out_idx + r * k;
+        float* dv = out_val + r * k;
+        std::memcpy(di, indices + start,
+                    static_cast<size_t>(len) * sizeof(int32_t));
+        std::memcpy(dv, data + start,
+                    static_cast<size_t>(len) * sizeof(float));
     }
     return 0;
 }

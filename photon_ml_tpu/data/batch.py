@@ -187,10 +187,17 @@ def ell_from_csr(
     indices = np.zeros((n, k), dtype=np.int32)
     values = np.zeros((n, k), dtype=stage)
     if mat.nnz:
-        row_of = np.repeat(np.arange(n), lens)
-        slot_of = np.arange(mat.nnz) - np.repeat(indptr[:-1], lens)
-        indices[row_of, slot_of] = mat.indices
-        values[row_of, slot_of] = mat.data
+        packed = False
+        if stage == np.float32:
+            from photon_ml_tpu.io.native_loader import pack_ell_native
+
+            packed = pack_ell_native(indptr, mat.indices, mat.data, k,
+                                     indices, values)
+        if not packed:
+            row_of = np.repeat(np.arange(n), lens)
+            slot_of = np.arange(mat.nnz) - np.repeat(indptr[:-1], lens)
+            indices[row_of, slot_of] = mat.indices
+            values[row_of, slot_of] = mat.data
     return EllBatch(
         indices=jnp.asarray(indices),
         values=jnp.asarray(values, dtype),

@@ -102,6 +102,16 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
             ]
+            lib.photon_pack_ell.restype = ctypes.c_int
+            lib.photon_pack_ell.argtypes = [
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ]
         except (OSError, AttributeError):
             # unloadable lib OR a stale lib missing a newer entry point —
             # degrade to the Python paths rather than crashing every call
@@ -109,6 +119,37 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
             return None
         _lib = lib
         return _lib
+
+
+def pack_ell_native(indptr: np.ndarray, indices: np.ndarray,
+                    data: np.ndarray, k: int,
+                    out_idx: np.ndarray, out_val: np.ndarray) -> bool:
+    """CSR → fixed-width ELL planes (native/block_packer.cpp). Both outputs
+    must be zeroed C-contiguous [n, k]; returns False when the native
+    library is unavailable (callers use the numpy scatter fallback)."""
+    lib = get_native_lib()
+    if lib is None:
+        return False
+    n = len(indptr) - 1
+    for a in (out_idx, out_val):
+        if not a.flags.c_contiguous:
+            raise ValueError("ELL outputs must be C-contiguous")
+        if a.shape != (n, k):
+            # hard check: the C loop strides r*k through the buffer and
+            # would write past a smaller allocation
+            raise ValueError(
+                f"ELL output shape {a.shape} != ({n}, {k})")
+    nnz = int(indptr[-1]) if n >= 0 and len(indptr) else 0
+    if len(indices) < nnz or len(data) < nnz:
+        raise ValueError("indices/data shorter than indptr[-1]")
+    rc = lib.photon_pack_ell(
+        n, np.ascontiguousarray(indptr, np.int64),
+        np.ascontiguousarray(indices, np.int32),
+        np.ascontiguousarray(data, np.float32), k,
+        out_idx.reshape(-1), out_val.reshape(-1))
+    if rc != 0:
+        raise ValueError(f"native ELL pack failed with code {rc}")
+    return True
 
 
 def pack_projected_rows_native(

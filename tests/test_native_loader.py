@@ -188,3 +188,31 @@ def test_native_block_packer_matches_numpy(monkeypatch):
     assert ds_np.num_passive and ds_nat.num_passive
     np.testing.assert_array_equal(np.asarray(ds_np.passive_X),
                                   np.asarray(ds_nat.passive_X))
+
+
+@requires_native
+def test_native_ell_pack_matches_numpy(monkeypatch):
+    """native photon_pack_ell vs the numpy fancy-index scatter: identical
+    ELL planes, including ragged rows and empty rows."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.batch import ell_from_csr
+
+    r = np.random.default_rng(7)
+    rows, cols, vals = [], [], []
+    for i in range(200):
+        for _ in range(int(r.integers(0, 9))):
+            rows.append(i)
+            cols.append(int(r.integers(0, 50)))
+            vals.append(float(r.random()))
+    mat = sp.csr_matrix((vals, (rows, cols)), shape=(200, 50))
+    y = np.zeros(200)
+
+    monkeypatch.delenv("PHOTON_DISABLE_NATIVE", raising=False)
+    e_nat = ell_from_csr(mat, y)
+    monkeypatch.setenv("PHOTON_DISABLE_NATIVE", "1")
+    e_np = ell_from_csr(mat, y)
+    np.testing.assert_array_equal(np.asarray(e_nat.indices),
+                                  np.asarray(e_np.indices))
+    np.testing.assert_array_equal(np.asarray(e_nat.values),
+                                  np.asarray(e_np.values))
