@@ -254,14 +254,12 @@ class GameTrainingDriver:
             sets = NameAndTermFeatureSets.load(
                 self.ns.feature_name_and_term_set_path, all_sections)
         else:
-            from photon_ml_tpu.io.avro import read_records
             from photon_ml_tpu.utils.date_range import resolve_input_paths
 
             paths = resolve_input_paths(
                 self.ns.train_input_dirs, self.ns.train_date_range,
                 self.ns.train_date_range_days_ago)
-            sets = NameAndTermFeatureSets.from_records(
-                [r for p in paths for r in read_records(p)], all_sections)
+            sets = NameAndTermFeatureSets.from_paths(paths, all_sections)
         for shard, sections in self.section_keys.items():
             self.index_maps[shard] = sets.index_map(
                 sections, add_intercept=self.intercept_map.get(shard, True))

@@ -158,6 +158,22 @@ def _feature_col_ok(col) -> bool:
     return subs[VALUE].get("op") != _OP_STRING
 
 
+def _unique_name_terms(subs):
+    """Interned name/term sub-columns → (per-entry unique-pair ids,
+    unique (name, term) pair list) — ONE encode/decode of the pair trick
+    shared by the loaders and the feature-map scan."""
+    name_codes = subs[NAME]["codes"].astype(np.int64)
+    name_uniq = subs[NAME]["uniq"]
+    term_codes = subs[TERM]["codes"]
+    term_uniq = subs[TERM]["uniq"]
+    nt = max(len(term_uniq), 1)
+    pair = name_codes * nt + term_codes
+    upair, inv_p = np.unique(pair, return_inverse=True)
+    upairs = [(str(name_uniq[p // nt]), str(term_uniq[p % nt]))
+              for p in upair]
+    return inv_p, upairs
+
+
 def _feature_triples(col, num_prior_rows_total: int):
     """array<record> feature column → (row_of_entry, key_of_entry arrays).
 
@@ -165,19 +181,12 @@ def _feature_triples(col, num_prior_rows_total: int):
     unique tables), so keys are composed once per unique (name, term)
     pair; the per-entry work is integer arithmetic only."""
     lengths = col["lengths"]
-    name_codes = col["subs"][NAME]["codes"].astype(np.int64)
-    name_uniq = col["subs"][NAME]["uniq"]
-    term_codes = col["subs"][TERM]["codes"].astype(np.int64)
-    term_uniq = col["subs"][TERM]["uniq"]
     values = col["subs"][VALUE]["values"]
     rows = np.repeat(
         np.arange(len(lengths), dtype=np.int64) + num_prior_rows_total,
         lengths)
-    nt = max(len(term_uniq), 1)
-    pair = name_codes * nt + term_codes
-    upair, inv_p = np.unique(pair, return_inverse=True)
-    ukeys = [feature_key(str(name_uniq[p // nt]), str(term_uniq[p % nt]))
-             for p in upair]
+    inv_p, upairs = _unique_name_terms(col["subs"])
+    ukeys = [feature_key(n, t) for n, t in upairs]
     return rows, inv_p, ukeys, values
 
 
@@ -823,6 +832,42 @@ class NameAndTermFeatureSets:
                 for f in rec.get(k) or []:
                     sets[k].add((f[NAME], f.get(TERM) or ""))
         return NameAndTermFeatureSets(sets)
+
+    @staticmethod
+    def from_paths(paths: Sequence[str], section_keys: Sequence[str]
+                   ) -> "NameAndTermFeatureSets":
+        """Feature-map scan over data files: columnar fast path when the
+        native decoder handles every part (the unique name/term tables ARE
+        the name-term sets — the scan never touches per-entry data), else
+        the per-record loop (GAMEDriver.prepareFeatureMapsDefault's
+        distinct() scan)."""
+        sets: dict[str, set[tuple[str, str]]] = {
+            k: set() for k in section_keys}
+        ok = True
+        # one path decoded at a time: the scan only keeps the (tiny)
+        # name-term sets, never a whole decoded dataset
+        for p in paths:
+            parts = _columnar_parts(p)
+            if parts is None:
+                ok = False
+                break
+            for _, _, cols in parts:
+                for k in section_keys:
+                    if not _feature_col_ok(cols.get(k)):
+                        ok = False
+                        break
+                    _, upairs = _unique_name_terms(cols[k]["subs"])
+                    sets[k].update(upairs)
+                if not ok:
+                    break
+            if not ok:
+                break
+        if ok:
+            return NameAndTermFeatureSets(sets)
+        from photon_ml_tpu.io.avro import read_records as _rr
+
+        return NameAndTermFeatureSets.from_records(
+            (r for p in paths for r in _rr(p)), section_keys)
 
     def index_map(self, section_keys: Sequence[str],
                   add_intercept: bool) -> IndexMap:

@@ -342,3 +342,43 @@ def test_selected_features_respected_with_index_map(tmp_path):
     X = data.features.toarray()
     assert X[0, imap.index_of(feature_key("a"))] == 1.0
     assert X[0, imap.index_of(feature_key("b"))] == 0.0  # filtered out
+
+
+def test_name_term_sets_from_paths_matches_from_records(tmp_path):
+    """The columnar feature-map scan must produce exactly the per-record
+    scan's name-term sets (incl. null terms, empty arrays, multi-part
+    dirs) — a divergence here corrupts every downstream index map."""
+    from photon_ml_tpu.io.avro import read_records, write_container
+    from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+    schema = {
+        "name": "G", "type": "record",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {"name": "secA", "type": {"type": "array",
+                                      "items": schemas.FEATURE}},
+            {"name": "secB", "type": {"type": "array",
+                                      "items": "FeatureAvro"}},
+        ],
+    }
+    d = tmp_path / "parts"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    for part in range(2):
+        recs = []
+        for i in range(40):
+            recs.append({
+                "response": float(i),
+                "secA": [{"name": f"a{int(rng.integers(5))}",
+                          "term": ["", "t1"][int(rng.integers(2))],
+                          "value": 1.0}
+                         for _ in range(int(rng.integers(0, 4)))],
+                "secB": [{"name": f"b{part}", "term": "", "value": 2.0}],
+            })
+        write_container(str(d / f"part-{part:05d}.avro"), schema, recs)
+
+    secs = ["secA", "secB"]
+    fast = NameAndTermFeatureSets.from_paths([str(d)], secs)
+    slow = NameAndTermFeatureSets.from_records(read_records(str(d)), secs)
+    assert fast.sets == slow.sets
+    assert fast.sets["secB"] == {("b0", ""), ("b1", "")}
