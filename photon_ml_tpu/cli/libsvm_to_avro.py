@@ -1,0 +1,72 @@
+"""LibSVM text → TrainingExampleAvro converter.
+
+Analog of the reference's dev script
+(reference: photon-ml/dev-scripts/libsvm_text_to_trainingexample_avro.py):
+turn a LibSVM file (or part directory) into the Avro container the legacy
+driver trains on. Features are named by their LibSVM index (term empty),
+matching the identity index-map convention the LibSVM loader uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import write_container
+from photon_ml_tpu.io.data_format import load_libsvm
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="libsvm-to-avro",
+        description="Convert LibSVM text data to TrainingExampleAvro")
+    p.add_argument("--input-path", required=True,
+                   help="LibSVM file or part directory")
+    p.add_argument("--output-path", required=True,
+                   help="Avro container file to write")
+    p.add_argument("--feature-dimension", type=int, required=True)
+    p.add_argument("--zero-based", default="false",
+                   help="LibSVM indices start at 0 instead of 1")
+    p.add_argument("--binarize-labels", default="true",
+                   help="map labels >0 to 1 else 0 (the reference script "
+                        "does this for integer labels; pass false to keep "
+                        "raw regression targets)")
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    from photon_ml_tpu.utils import parse_flag
+
+    ns = parse_args(argv if argv is not None else sys.argv[1:])
+    zero_based = parse_flag(ns.zero_based)
+    data = load_libsvm(ns.input_path, ns.feature_dimension,
+                       zero_based=zero_based, use_intercept=False,
+                       binarize_labels=parse_flag(ns.binarize_labels))
+    csr = data.features.tocsr()
+    # feature names carry the LITERAL index from the file (1-based unless
+    # --zero-based), matching the reference dev-script's naming
+    name_shift = 0 if zero_based else 1
+
+    def records():
+        for i in range(data.num_samples):
+            row = csr[i]
+            yield {
+                "uid": str(i),
+                "label": float(data.labels[i]),
+                "features": [
+                    {"name": str(int(j) + name_shift), "term": "",
+                     "value": float(v)}
+                    for j, v in zip(row.indices, row.data)],
+                "metadataMap": None,
+                "weight": float(data.weights[i]),
+                "offset": float(data.offsets[i]),
+            }
+
+    write_container(ns.output_path, schemas.TRAINING_EXAMPLE, records())
+    print(f"{data.num_samples} records -> {ns.output_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -158,17 +158,21 @@ def _feature_col_ok(col) -> bool:
     return subs[VALUE].get("op") != _OP_STRING
 
 
-def _unique_name_terms(subs):
+def _unique_name_terms(subs, with_inverse: bool = True):
     """Interned name/term sub-columns → (per-entry unique-pair ids,
     unique (name, term) pair list) — ONE encode/decode of the pair trick
-    shared by the loaders and the feature-map scan."""
+    shared by the loaders and the feature-map scan. ``with_inverse=False``
+    (the scan) skips the per-entry inverse array entirely."""
     name_codes = subs[NAME]["codes"].astype(np.int64)
     name_uniq = subs[NAME]["uniq"]
     term_codes = subs[TERM]["codes"]
     term_uniq = subs[TERM]["uniq"]
     nt = max(len(term_uniq), 1)
     pair = name_codes * nt + term_codes
-    upair, inv_p = np.unique(pair, return_inverse=True)
+    if with_inverse:
+        upair, inv_p = np.unique(pair, return_inverse=True)
+    else:
+        upair, inv_p = np.unique(pair), None
     upairs = [(str(name_uniq[p // nt]), str(term_uniq[p % nt]))
               for p in upair]
     return inv_p, upairs
@@ -346,10 +350,12 @@ def load_labeled_points_avro(
 
 def load_libsvm(path: str, feature_dimension: int,
                 use_intercept: bool = True, zero_based: bool = False,
-                delim: str = " ", idx_value_delim: str = ":") -> LabeledData:
+                delim: str = " ", idx_value_delim: str = ":",
+                binarize_labels: bool = True) -> LabeledData:
     """LibSVM text → LabeledData. Labels are binarized (>0 → 1) like the
-    reference; the intercept occupies the LAST column when enabled
-    (IdentityIndexMapLoader semantics).
+    reference (``binarize_labels=False`` keeps the raw values, for format
+    conversion of regression data); the intercept occupies the LAST column
+    when enabled (IdentityIndexMapLoader semantics).
 
     Parsing dispatches to the native C++ parser (io/native_loader.py,
     mmap + multithreaded) when available and custom delimiters aren't
@@ -364,7 +370,8 @@ def load_libsvm(path: str, feature_dimension: int,
 
     if delim == " " and idx_value_delim == ":":
         native = _load_libsvm_native(paths, feature_dimension,
-                                     use_intercept, zero_based)
+                                     use_intercept, zero_based,
+                                     binarize_labels)
         if native is not None:
             return native
 
@@ -383,7 +390,8 @@ def load_libsvm(path: str, feature_dimension: int,
                 # keep literal splitting.
                 ts = line.split() if delim == " " else line.split(delim)
                 label = float(ts[0])
-                labels_list.append(1.0 if label > 0 else 0.0)
+                labels_list.append((1.0 if label > 0 else 0.0)
+                                   if binarize_labels else label)
                 for item in ts[1:]:
                     item = item.strip()
                     if not item:
@@ -428,7 +436,9 @@ def _libsvm_labeled_data(features: sp.csr_matrix, labels: np.ndarray,
 
 
 def _load_libsvm_native(paths, feature_dimension: int, use_intercept: bool,
-                        zero_based: bool) -> Optional[LabeledData]:
+                        zero_based: bool,
+                        binarize_labels: bool = True
+                        ) -> Optional[LabeledData]:
     """Native-parser path of :func:`load_libsvm`; None → use Python loop."""
     from photon_ml_tpu.io.native_loader import parse_libsvm_native
 
@@ -453,7 +463,9 @@ def _load_libsvm_native(paths, feature_dimension: int, use_intercept: bool,
         if use_intercept:
             mat = sp.hstack([mat, np.ones((n, 1))], format="csr")
         mats.append(mat)
-        labels_all.append((raw_labels > 0).astype(np.float64))
+        labels_all.append((raw_labels > 0).astype(np.float64)
+                          if binarize_labels
+                          else np.asarray(raw_labels, np.float64))
     features = sp.vstack(mats, format="csr") if len(mats) > 1 else mats[0]
     return _libsvm_labeled_data(features, np.concatenate(labels_all),
                                 feature_dimension, use_intercept)
@@ -841,28 +853,38 @@ class NameAndTermFeatureSets:
         the name-term sets — the scan never touches per-entry data), else
         the per-record loop (GAMEDriver.prepareFeatureMapsDefault's
         distinct() scan)."""
+        from photon_ml_tpu.io.native_avro import read_columnar
+
+        # one FILE decoded at a time (directories expand to their part
+        # files): the scan only keeps the (tiny) name-term sets, never a
+        # whole decoded dataset
+        files: list[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                files.extend(os.path.join(p, f)
+                             for f in sorted(os.listdir(p))
+                             if f.endswith(".avro"))
+            else:
+                files.append(p)
         sets: dict[str, set[tuple[str, str]]] = {
             k: set() for k in section_keys}
         ok = True
-        # one path decoded at a time: the scan only keeps the (tiny)
-        # name-term sets, never a whole decoded dataset
-        for p in paths:
-            parts = _columnar_parts(p)
-            if parts is None:
+        for f in files:
+            part = read_columnar(f)
+            if part is None:
                 ok = False
                 break
-            for _, _, cols in parts:
-                for k in section_keys:
-                    if not _feature_col_ok(cols.get(k)):
-                        ok = False
-                        break
-                    _, upairs = _unique_name_terms(cols[k]["subs"])
-                    sets[k].update(upairs)
-                if not ok:
+            _, _, cols = part
+            for k in section_keys:
+                if not _feature_col_ok(cols.get(k)):
+                    ok = False
                     break
+                _, upairs = _unique_name_terms(cols[k]["subs"],
+                                               with_inverse=False)
+                sets[k].update(upairs)
             if not ok:
                 break
-        if ok:
+        if ok and files:
             return NameAndTermFeatureSets(sets)
         from photon_ml_tpu.io.avro import read_records as _rr
 

@@ -351,14 +351,21 @@ def test_name_term_sets_from_paths_matches_from_records(tmp_path):
     from photon_ml_tpu.io.avro import read_records, write_container
     from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
 
+    nullable_feature = {
+        "name": "NF", "type": "record",
+        "fields": [
+            {"name": "name", "type": "string"},
+            {"name": "term", "type": ["null", "string"], "default": None},
+            {"name": "value", "type": "double"},
+        ],
+    }
     schema = {
         "name": "G", "type": "record",
         "fields": [
             {"name": "response", "type": "double"},
             {"name": "secA", "type": {"type": "array",
-                                      "items": schemas.FEATURE}},
-            {"name": "secB", "type": {"type": "array",
-                                      "items": "FeatureAvro"}},
+                                      "items": nullable_feature}},
+            {"name": "secB", "type": {"type": "array", "items": "NF"}},
         ],
     }
     d = tmp_path / "parts"
@@ -370,10 +377,10 @@ def test_name_term_sets_from_paths_matches_from_records(tmp_path):
             recs.append({
                 "response": float(i),
                 "secA": [{"name": f"a{int(rng.integers(5))}",
-                          "term": ["", "t1"][int(rng.integers(2))],
+                          "term": [None, "", "t1"][int(rng.integers(3))],
                           "value": 1.0}
                          for _ in range(int(rng.integers(0, 4)))],
-                "secB": [{"name": f"b{part}", "term": "", "value": 2.0}],
+                "secB": [{"name": f"b{part}", "term": None, "value": 2.0}],
             })
         write_container(str(d / f"part-{part:05d}.avro"), schema, recs)
 
