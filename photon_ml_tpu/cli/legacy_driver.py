@@ -36,9 +36,9 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from photon_ml_tpu.data.batch import dense_batch
 from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
 from photon_ml_tpu.diagnostics import diagnostics as diag
+from photon_ml_tpu.game.dataset import csr_to_batch
 from photon_ml_tpu.diagnostics.reporting import render_html, render_text
 from photon_ml_tpu.diagnostics.transformers import build_diagnostic_document
 from photon_ml_tpu.evaluation.model_evaluation import (
@@ -331,7 +331,7 @@ class LegacyDriver(EventEmitter):
                         p.data_validation_type, logger=self.logger):
                     raise ValueError("validation data failed validation")
 
-            self.summary = summarize(self.train_data.features.toarray())
+            self.summary = summarize(self.train_data.features)
             if p.summarization_output_dir:
                 self._write_summary(p.summarization_output_dir)
             self.normalization = NormalizationContext.build(
@@ -367,8 +367,20 @@ class LegacyDriver(EventEmitter):
                         schemas.FEATURE_SUMMARIZATION_RESULT, rows)
 
     def _batch(self, data: LabeledData):
-        return dense_batch(data.features.toarray(), data.labels,
-                           data.offsets, data.weights)
+        # sparse-aware: wide shards (beyond the dense threshold) ride the
+        # ELL layout instead of densifying N x 200k on the host
+        return csr_to_batch(data.features.tocsr(),
+                            np.asarray(data.labels),
+                            np.asarray(data.offsets),
+                            np.asarray(data.weights))
+
+    def _validation_batch(self):
+        """Device batch of the validation split, built ONCE (validate and
+        diagnose both need it; a wide shard's ELL pack + transfer is not
+        free)."""
+        if getattr(self, "_vbatch_cache", None) is None:
+            self._vbatch_cache = self._batch(self.validate_data)
+        return self._vbatch_cache
 
     def train(self) -> None:
         """Driver.train :294 → ModelTraining.trainGeneralizedLinearModel."""
@@ -407,7 +419,7 @@ class LegacyDriver(EventEmitter):
             self._advance(DriverStage.VALIDATED)
             return
         with timed_phase("validate", self.logger):
-            batch = self._batch(self.validate_data)
+            batch = self._validation_batch()
             # Whole lambda grid in ONE jitted call + one host fetch
             # (Evaluation.scala:100-152 runs one Spark job per metric per
             # model; on a remote chip those tiny dispatches dominated).
@@ -484,9 +496,12 @@ class LegacyDriver(EventEmitter):
             importance = []
             if do_validate and self.validate_data is not None:
                 best = self._best_model()
-                vbatch = self._batch(self.validate_data)
-                margins = np.asarray(best.model.compute_score(
-                    vbatch.X, vbatch.offsets))
+                vbatch = self._validation_batch()
+                # batch.margins works for dense AND ELL layouts (a wide
+                # validation shard has no .X to densify)
+                margins = np.asarray(vbatch.margins(
+                    jnp.asarray(best.model.coefficients.means,
+                                vbatch.labels.dtype), 0.0))
                 predictions = np.asarray(best.model.mean(jnp.asarray(margins)))
                 if p.task == TaskType.LOGISTIC_REGRESSION:
                     hl = diag.hosmer_lemeshow(self.validate_data.labels,
@@ -540,9 +555,10 @@ class LegacyDriver(EventEmitter):
         normalized_warm: dict[float, np.ndarray] = {}
 
         def _sub_batch(idx: np.ndarray):
-            return dense_batch(data.features[idx].toarray(),
-                               data.labels[idx], data.offsets[idx],
-                               data.weights[idx])
+            return csr_to_batch(data.features.tocsr()[idx],
+                                np.asarray(data.labels)[idx],
+                                np.asarray(data.offsets)[idx],
+                                np.asarray(data.weights)[idx])
 
         def factory(train_idx: np.ndarray, eval_idx, warm_start: dict):
             sub = _sub_batch(train_idx)

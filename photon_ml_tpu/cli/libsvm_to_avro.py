@@ -49,16 +49,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     # --zero-based), matching the reference dev-script's naming
     name_shift = 0 if zero_based else 1
 
+    indptr, idx, vals = csr.indptr, csr.indices, csr.data
+
     def records():
         for i in range(data.num_samples):
-            row = csr[i]
+            lo, hi = indptr[i], indptr[i + 1]
             yield {
                 "uid": str(i),
                 "label": float(data.labels[i]),
                 "features": [
                     {"name": str(int(j) + name_shift), "term": "",
                      "value": float(v)}
-                    for j, v in zip(row.indices, row.data)],
+                    for j, v in zip(idx[lo:hi], vals[lo:hi])],
                 "metadataMap": None,
                 "weight": float(data.weights[i]),
                 "offset": float(data.offsets[i]),

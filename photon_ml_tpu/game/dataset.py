@@ -149,7 +149,7 @@ class FixedEffectDataset:
         return self.batch._replace(offsets=self.base_offsets + extra_scores)
 
 
-def _csr_to_batch(
+def csr_to_batch(
     mat: sp.csr_matrix,
     labels: np.ndarray,
     offsets: np.ndarray,
@@ -167,6 +167,11 @@ def _csr_to_batch(
     return ell_from_csr(mat, labels, offsets, weights, dtype=dtype)
 
 
+# Back-compat alias (promoted to public API: the legacy driver shares the
+# same sparse-aware dispatch).
+_csr_to_batch = csr_to_batch
+
+
 def build_fixed_effect_dataset(
     data: GameDataset,
     shard_id: str,
@@ -174,8 +179,8 @@ def build_fixed_effect_dataset(
     dense_threshold: int = DENSE_FEATURE_THRESHOLD,
 ) -> FixedEffectDataset:
     mat = data.feature_shards[shard_id]
-    batch = _csr_to_batch(mat, data.responses, data.offsets, data.weights,
-                          dtype=dtype, dense_threshold=dense_threshold)
+    batch = csr_to_batch(mat, data.responses, data.offsets, data.weights,
+                         dtype=dtype, dense_threshold=dense_threshold)
     return FixedEffectDataset(shard_id=shard_id, batch=batch,
                               base_offsets=batch.offsets)
 

@@ -701,14 +701,21 @@ def read_records(path: str) -> list[Any]:
     return records
 
 
+def list_avro_parts(path: str) -> list[str]:
+    """The ``*.avro`` part files of a directory, sorted — THE definition of
+    which files a partitioned layout contains (every reader, interpreted or
+    columnar, must share it or they can load different datasets)."""
+    return [os.path.join(path, name) for name in sorted(os.listdir(path))
+            if name.endswith(".avro")]
+
+
 def read_directory(path: str) -> tuple[Any, list[Any]]:
     """Read all ``*.avro`` files under a directory (the reference's
     partitioned-output layout: part-*.avro shards)."""
     schema = None
     records: list[Any] = []
-    for name in sorted(os.listdir(path)):
-        if name.endswith(".avro"):
-            s, recs = read_container(os.path.join(path, name))
-            schema = schema or s
-            records.extend(recs)
+    for part in list_avro_parts(path):
+        s, recs = read_container(part)
+        schema = schema or s
+        records.extend(recs)
     return schema, records

@@ -128,10 +128,9 @@ def _columnar_parts(path: str):
     from photon_ml_tpu.io.native_avro import read_columnar
 
     if os.path.isdir(path):
-        # EXACTLY read_directory's filter (avro.py read_directory): the
-        # two paths must always see the same file set
-        paths = [os.path.join(path, f) for f in sorted(os.listdir(path))
-                 if f.endswith(".avro")]
+        from photon_ml_tpu.io.avro import list_avro_parts
+
+        paths = list_avro_parts(path)  # same file set as read_directory
     else:
         paths = [path]
     out = []
@@ -858,14 +857,11 @@ class NameAndTermFeatureSets:
         # one FILE decoded at a time (directories expand to their part
         # files): the scan only keeps the (tiny) name-term sets, never a
         # whole decoded dataset
+        from photon_ml_tpu.io.avro import list_avro_parts
+
         files: list[str] = []
         for p in paths:
-            if os.path.isdir(p):
-                files.extend(os.path.join(p, f)
-                             for f in sorted(os.listdir(p))
-                             if f.endswith(".avro"))
-            else:
-                files.append(p)
+            files.extend(list_avro_parts(p) if os.path.isdir(p) else [p])
         sets: dict[str, set[tuple[str, str]]] = {
             k: set() for k in section_keys}
         ok = True

@@ -699,3 +699,38 @@ class TestLibsvmToAvro:
         # literal 1-based feature names from the file
         assert recs[0]["features"][0]["name"] == "1"
         assert recs[1]["features"][0]["name"] == "2"
+
+
+class TestWideSparse:
+    def test_legacy_driver_wide_sparse_trains_via_ell(self, tmp_path):
+        """A feature space past the dense threshold must train through the
+        ELL layout — the driver never densifies N x D on the host."""
+        from photon_ml_tpu.data.batch import EllBatch
+        from photon_ml_tpu.game.dataset import DENSE_FEATURE_THRESHOLD
+
+        d = DENSE_FEATURE_THRESHOLD + 100
+        rng = np.random.default_rng(23)
+        n = 200
+        libsvm = str(tmp_path / "wide.libsvm")
+        w_true = rng.normal(size=8)
+        hot = rng.choice(d, size=8, replace=False) + 1  # 1-based
+        with open(libsvm, "w") as fh:
+            for i in range(n):
+                x = rng.normal(size=8)
+                y = 1 if (x @ w_true) > 0 else -1
+                feats = " ".join(f"{int(j)}:{v:.5f}"
+                                 for j, v in zip(hot, x))
+                fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
+        driver = LegacyDriver(parse_args([
+            "--training-data-directory", libsvm,
+            "--output-directory", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--input-file-format", "LIBSVM",
+            "--feature-dimension", str(d),
+            "--regularization-weights", "1",
+            "--num-iterations", "15",
+        ]))
+        driver.run()
+        assert isinstance(driver._batch(driver.train_data), EllBatch)
+        w = np.asarray(driver.models[0].model.coefficients.means)
+        assert np.all(np.isfinite(w)) and np.abs(w).max() > 0
