@@ -157,6 +157,12 @@ def csr_to_batch(
     dtype=jnp.float32,
     dense_threshold: int = DENSE_FEATURE_THRESHOLD,
 ) -> Batch:
+    if not mat.has_canonical_format:
+        # duplicate (row, col) entries must sum (toarray's implicit
+        # behavior); the ELL layout would otherwise split one cell across
+        # slots and corrupt Hessian-diagonal terms (sum(x^2) vs (sum x)^2)
+        mat = mat.copy()
+        mat.sum_duplicates()
     if mat.shape[1] <= dense_threshold:
         return DenseBatch(
             X=jnp.asarray(mat.toarray(), dtype),
@@ -165,11 +171,6 @@ def csr_to_batch(
             weights=jnp.asarray(weights, jnp.float32),
         )
     return ell_from_csr(mat, labels, offsets, weights, dtype=dtype)
-
-
-# Back-compat alias (promoted to public API: the legacy driver shares the
-# same sparse-aware dispatch).
-_csr_to_batch = csr_to_batch
 
 
 def build_fixed_effect_dataset(

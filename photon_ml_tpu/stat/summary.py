@@ -76,6 +76,10 @@ def summarize(X) -> BasicStatisticalSummary:
 def _summarize_sparse(csr) -> BasicStatisticalSummary:
     """Sparse-structure statistics, exactly matching the dense path
     (implicit zeros included in mean/var/min/max; unbiased variance)."""
+    if not csr.has_canonical_format:
+        # duplicate entries sum, exactly like the dense toarray() path
+        csr = csr.copy()
+        csr.sum_duplicates()
     n, d = csr.shape
     data = np.asarray(csr.data, dtype=np.float64)
     # bincount-with-weights: column sums with nnz-sized temporaries only

@@ -734,3 +734,37 @@ class TestWideSparse:
         assert isinstance(driver._batch(driver.train_data), EllBatch)
         w = np.asarray(driver.models[0].model.coefficients.means)
         assert np.all(np.isfinite(w)) and np.abs(w).max() > 0
+
+    def test_wide_sparse_with_standardization(self, tmp_path):
+        """Sparse summarization feeds STANDARDIZATION on a wide shard: the
+        normalization context builds from sparse statistics and training
+        stays in the ELL layout end-to-end."""
+        rng = np.random.default_rng(29)
+        d = 5000
+        libsvm = str(tmp_path / "wide.libsvm")
+        hot = rng.choice(d, size=6, replace=False) + 1
+        with open(libsvm, "w") as fh:
+            for i in range(150):
+                x = rng.normal(size=6) * 10.0 + 3.0  # badly scaled
+                y = 1 if x.sum() > 18 else -1
+                feats = " ".join(f"{int(j)}:{v:.5f}"
+                                 for j, v in zip(sorted(hot), x))
+                fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
+        driver = LegacyDriver(parse_args([
+            "--training-data-directory", libsvm,
+            "--output-directory", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--input-file-format", "LIBSVM",
+            "--feature-dimension", str(d),
+            "--regularization-weights", "0.1",
+            "--num-iterations", "20",
+            "--normalization-type", "STANDARDIZATION",
+        ]))
+        driver.run()
+        w = np.asarray(driver.models[0].model.coefficients.means)
+        assert np.all(np.isfinite(w))
+        # only the hot columns (and intercept) should carry weight
+        nz = np.flatnonzero(np.abs(w) > 1e-8)
+        expected = set((hot - 1).tolist()) | {d}  # intercept last
+        assert set(nz.tolist()) <= expected
+        assert len(nz) >= 6

@@ -119,13 +119,13 @@ def test_shard_map_backend_ell_batch(rng):
     padding + pytree row specs are layout-generic)."""
     import scipy.sparse as sp
 
-    from photon_ml_tpu.game.dataset import _csr_to_batch
+    from photon_ml_tpu.game.dataset import csr_to_batch
 
     n, d = 250, 40
     X = sp.random(n, d, density=0.2, random_state=7, format="csr")
     w = np.asarray(rng.normal(size=d))
     y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
-    ell = _csr_to_batch(X.tocsr(), y, np.zeros(n), np.ones(n),
+    ell = csr_to_batch(X.tocsr(), y, np.zeros(n), np.ones(n),
                         dense_threshold=8)  # force ELL
     problem = _problem()
     model_local, _ = problem.run(ell)

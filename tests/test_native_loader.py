@@ -216,3 +216,29 @@ def test_native_ell_pack_matches_numpy(monkeypatch):
                                   np.asarray(e_np.indices))
     np.testing.assert_array_equal(np.asarray(e_nat.values),
                                   np.asarray(e_np.values))
+
+
+@requires_native
+def test_duplicate_libsvm_entries_sum_in_sparse_paths(tmp_path):
+    """A row with a duplicated feature index must behave as the SUMMED cell
+    through the sparse batch and the sparse summary (toarray's implicit
+    behavior; the native parser keeps both stored entries)."""
+    from photon_ml_tpu.game.dataset import csr_to_batch
+    from photon_ml_tpu.io.data_format import load_libsvm
+    from photon_ml_tpu.stat.summary import summarize
+
+    p = str(tmp_path / "dup.libsvm")
+    _write(p, ["+1 2:1.5 2:1.5", "-1 1:2.0"])
+    data = load_libsvm(p, feature_dimension=3, use_intercept=False)
+    s_sparse = summarize(data.features)
+    s_dense = summarize(data.features.toarray())
+    np.testing.assert_allclose(s_sparse.mean, s_dense.mean, rtol=1e-6)
+    np.testing.assert_allclose(s_sparse.variance, s_dense.variance,
+                               rtol=1e-5)
+    np.testing.assert_allclose(s_sparse.num_nonzeros, s_dense.num_nonzeros)
+    batch = csr_to_batch(data.features.tocsr(), data.labels,
+                         data.offsets, data.weights, dense_threshold=0)
+    # ELL layout: the duplicated cell occupies ONE slot with value 3.0
+    vals = np.asarray(batch.values)
+    assert 3.0 in vals[0]
+    assert np.count_nonzero(vals[0]) == 1
