@@ -161,6 +161,17 @@ def dense_batch(
     )
 
 
+def canonicalized_csr(mat):
+    """CSR with duplicate (row, col) entries summed — the dense toarray()
+    behavior every sparse consumer must match. No copy when already
+    canonical; copies before mutating otherwise (callers may not own the
+    matrix)."""
+    if not mat.has_canonical_format:
+        mat = mat.copy()
+        mat.sum_duplicates()
+    return mat
+
+
 def ell_from_csr(
     mat,
     labels: np.ndarray,

@@ -36,7 +36,12 @@ import scipy.sparse as sp
 
 import jax.numpy as jnp
 
-from photon_ml_tpu.data.batch import Batch, DenseBatch, ell_from_csr
+from photon_ml_tpu.data.batch import (
+    Batch,
+    DenseBatch,
+    canonicalized_csr,
+    ell_from_csr,
+)
 from photon_ml_tpu.io.native_loader import pack_projected_rows_native
 from photon_ml_tpu.projector.projectors import (
     IndexMapProjectors,
@@ -89,14 +94,10 @@ class GameDataset:
                 mat = sp.csr_matrix(np.asarray(mat))
             else:
                 mat = mat.tocsr()
-            # Canonicalize: downstream block fills scatter `mat.data` by
-            # (row, col) — duplicate entries must be pre-summed or the
-            # scatter keeps only the last write. tocsr() on an existing CSR
-            # aliases it, so copy before mutating caller-owned data.
-            if not mat.has_canonical_format:
-                mat = mat.copy()
-                mat.sum_duplicates()
-            self.feature_shards[name] = mat
+            # downstream block fills scatter `mat.data` by (row, col) —
+            # duplicate entries must be pre-summed or the scatter keeps
+            # only the last write
+            self.feature_shards[name] = canonicalized_csr(mat)
 
     @property
     def num_samples(self) -> int:
@@ -157,12 +158,6 @@ def csr_to_batch(
     dtype=jnp.float32,
     dense_threshold: int = DENSE_FEATURE_THRESHOLD,
 ) -> Batch:
-    if not mat.has_canonical_format:
-        # duplicate (row, col) entries must sum (toarray's implicit
-        # behavior); the ELL layout would otherwise split one cell across
-        # slots and corrupt Hessian-diagonal terms (sum(x^2) vs (sum x)^2)
-        mat = mat.copy()
-        mat.sum_duplicates()
     if mat.shape[1] <= dense_threshold:
         return DenseBatch(
             X=jnp.asarray(mat.toarray(), dtype),
@@ -170,7 +165,11 @@ def csr_to_batch(
             offsets=jnp.asarray(offsets, jnp.float32),
             weights=jnp.asarray(weights, jnp.float32),
         )
-    return ell_from_csr(mat, labels, offsets, weights, dtype=dtype)
+    # the ELL layout would split a duplicated cell across slots and
+    # corrupt Hessian-diagonal terms (sum(x^2) vs (sum x)^2); toarray()
+    # above sums implicitly so only this branch needs the canonical form
+    return ell_from_csr(canonicalized_csr(mat), labels, offsets, weights,
+                        dtype=dtype)
 
 
 def build_fixed_effect_dataset(

@@ -76,10 +76,9 @@ def summarize(X) -> BasicStatisticalSummary:
 def _summarize_sparse(csr) -> BasicStatisticalSummary:
     """Sparse-structure statistics, exactly matching the dense path
     (implicit zeros included in mean/var/min/max; unbiased variance)."""
-    if not csr.has_canonical_format:
-        # duplicate entries sum, exactly like the dense toarray() path
-        csr = csr.copy()
-        csr.sum_duplicates()
+    from photon_ml_tpu.data.batch import canonicalized_csr
+
+    csr = canonicalized_csr(csr)  # duplicates sum, like the dense path
     n, d = csr.shape
     data = np.asarray(csr.data, dtype=np.float64)
     # bincount-with-weights: column sums with nnz-sized temporaries only
