@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.data.batch import canonicalized_csr
+
 Array = jnp.ndarray
 
 
@@ -76,8 +78,6 @@ def summarize(X) -> BasicStatisticalSummary:
 def _summarize_sparse(csr) -> BasicStatisticalSummary:
     """Sparse-structure statistics, exactly matching the dense path
     (implicit zeros included in mean/var/min/max; unbiased variance)."""
-    from photon_ml_tpu.data.batch import canonicalized_csr
-
     csr = canonicalized_csr(csr)  # duplicates sum, like the dense path
     n, d = csr.shape
     data = np.asarray(csr.data, dtype=np.float64)

@@ -160,3 +160,37 @@ def test_columnar_nullable_numeric_subfield(tmp_path):
     np.testing.assert_allclose(f["subs"]["value"]["values"], [1.5, 0.0, 0.0])
     name_strs = f["subs"]["name"]["uniq"][f["subs"]["name"]["codes"]]
     assert list(name_strs) == ["a", "b", "a"]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_columnar_codecs_and_empty_container(tmp_path, codec):
+    """Both container codecs decode columnar-identically; a zero-record
+    container yields n=0 with well-formed empty columns."""
+    from photon_ml_tpu.io.avro import write_container
+    from photon_ml_tpu.io.native_avro import read_columnar
+    from photon_ml_tpu.io.native_loader import get_native_lib
+
+    if get_native_lib() is None:
+        pytest.skip("native library unavailable")
+    schema = {
+        "name": "R", "type": "record",
+        "fields": [{"name": "x", "type": "double"},
+                   {"name": "s", "type": "string"}],
+    }
+    path = str(tmp_path / f"{codec}.avro")
+    write_container(path, schema,
+                    [{"x": 1.5, "s": "a"}, {"x": -2.0, "s": "bb"}],
+                    codec=codec)
+    out = read_columnar(path)
+    assert out is not None
+    _, n, cols = out
+    assert n == 2
+    np.testing.assert_allclose(cols["x"]["values"], [1.5, -2.0])
+
+    empty = str(tmp_path / f"empty-{codec}.avro")
+    write_container(empty, schema, [], codec=codec)
+    out = read_columnar(empty)
+    assert out is not None
+    _, n, cols = out
+    assert n == 0
+    assert cols["x"]["values"].shape == (0,)
