@@ -46,9 +46,16 @@ class DenseBatch(NamedTuple):
     def num_features(self) -> int:
         return self.X.shape[-1]
 
-    def _acc_dtype(self):
-        # Accumulate bf16/f16 data in f32 on the MXU; never downcast f64.
+    @property
+    def acc_dtype(self):
+        """Solver/accumulator dtype for this batch: at least f32 even over
+        a bf16 design matrix (mixed precision keeps parameters and sums
+        full-precision; only the X stream is low-precision), never
+        downcasting f64."""
         return jnp.promote_types(self.X.dtype, jnp.float32)
+
+    def _acc_dtype(self):
+        return self.acc_dtype
 
     def margins(self, w_eff: Array, margin_shift: Array) -> Array:
         """x_i . w_eff + margin_shift + offset_i, batched on the MXU."""
@@ -114,6 +121,11 @@ class EllBatch:
     @property
     def num_features(self) -> int:
         return self.dim
+
+    @property
+    def acc_dtype(self):
+        """Solver/accumulator dtype (see DenseBatch.acc_dtype)."""
+        return jnp.promote_types(self.values.dtype, jnp.float32)
 
     def margins(self, w_eff: Array, margin_shift: Array) -> Array:
         gathered = w_eff[self.indices]  # [N, K]

@@ -134,8 +134,11 @@ class RandomEffectOptimizationProblem:
         """
         cfg = self.config
         e, _, d = dataset.X.shape
-        x0 = (jnp.zeros((e, d), dataset.X.dtype)
-              if initial is None else initial)
+        acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
+        if initial is not None:
+            acc = jnp.promote_types(acc, jnp.asarray(initial).dtype)
+        x0 = (jnp.zeros((e, d), acc)
+              if initial is None else jnp.asarray(initial, acc))
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         if cfg.optimizer_type == OptimizerType.TRON:
             if self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:

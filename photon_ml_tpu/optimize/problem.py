@@ -175,8 +175,15 @@ class GLMOptimizationProblem:
 
             return run_glm_shard_map(self, batch, mesh, initial=initial)
         dim = batch.num_features
-        dtype = batch.X.dtype if hasattr(batch, "X") else batch.values.dtype
-        x0 = jnp.zeros(dim, dtype) if initial is None else initial
+        # coefficients stay at least f32 even over a bf16 design matrix
+        # (batch.acc_dtype); a warm start can only UPCAST the state (a
+        # bf16 initial is promoted to f32, an f64 initial keeps the whole
+        # solve in f64 — x64 callers rely on that)
+        dtype = batch.acc_dtype
+        if initial is not None:
+            dtype = jnp.promote_types(dtype, jnp.asarray(initial).dtype)
+        x0 = (jnp.zeros(dim, dtype) if initial is None
+              else jnp.asarray(initial, dtype))
         obj = self.objective()
         x, history, progressed = self.solve(obj, batch, x0)
         return self.publish(x, history, progressed, obj, batch)

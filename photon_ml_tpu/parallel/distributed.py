@@ -82,9 +82,13 @@ def run_glm_shard_map(
         batch = pad_batch(batch, padded)
 
     dim = batch.num_features
-    dtype = batch.X.dtype if hasattr(batch, "X") else batch.values.dtype
+    # solver state stays at least f32 over a bf16 design matrix, exactly
+    # like the single-chip path; warm starts only ever upcast
+    dtype = batch.acc_dtype
+    if initial is not None:
+        dtype = jnp.promote_types(dtype, jnp.asarray(initial).dtype)
     x0 = (jnp.zeros(dim, dtype) if initial is None
-          else jnp.asarray(initial))
+          else jnp.asarray(initial, dtype))
     # psum-ing objective: every reduction crosses the data axis.
     obj = dataclasses.replace(problem.objective(), axis_name=DATA_AXIS)
 

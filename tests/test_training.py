@@ -203,3 +203,21 @@ def test_per_task_prediction_validators(rng, task):
         cls = np.asarray(model.predict_class(jnp.asarray(X)))
         assert set(np.unique(cls)) <= {0, 1}
         assert np.mean(cls == y) > 0.7
+
+
+def test_bf16_batch_trains_close_to_f32(rng):
+    """A bf16-stored design matrix (half the HBM stream on chip) trains
+    through the same solver to within bf16 input-rounding of the f32
+    optimum — accumulation stays f32 via the batch's promote rule."""
+    X, y = _binary_data(rng, n=500, d=6)
+    f32 = train_glm_grid(dense_batch(X, y, dtype=jnp.float32),
+                         TaskType.LOGISTIC_REGRESSION,
+                         regularization_weights=[1.0], tolerance=1e-9)
+    bf16 = train_glm_grid(dense_batch(X, y, dtype=jnp.bfloat16),
+                          TaskType.LOGISTIC_REGRESSION,
+                          regularization_weights=[1.0], tolerance=1e-9)
+    w32 = np.asarray(f32[0].model.coefficients.means, np.float64)
+    wbf = np.asarray(bf16[0].model.coefficients.means, np.float64)
+    assert np.all(np.isfinite(wbf))
+    scale = max(1.0, np.abs(w32).max())
+    assert np.abs(wbf - w32).max() / scale < 3e-2
