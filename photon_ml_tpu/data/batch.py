@@ -54,14 +54,11 @@ class DenseBatch(NamedTuple):
         downcasting f64."""
         return jnp.promote_types(self.X.dtype, jnp.float32)
 
-    def _acc_dtype(self):
-        return self.acc_dtype
-
     def margins(self, w_eff: Array, margin_shift: Array) -> Array:
         """x_i . w_eff + margin_shift + offset_i, batched on the MXU."""
         return (
             jnp.einsum(
-                "nd,d->n", self.X, w_eff, preferred_element_type=self._acc_dtype()
+                "nd,d->n", self.X, w_eff, preferred_element_type=self.acc_dtype
             )
             + margin_shift
             + self.offsets
@@ -70,14 +67,14 @@ class DenseBatch(NamedTuple):
     def weighted_feature_sum(self, row_scalars: Array) -> Array:
         """sum_i row_scalars_i * x_i — the gradient's vector sum (X^T r)."""
         return jnp.einsum(
-            "nd,n->d", self.X, row_scalars, preferred_element_type=self._acc_dtype()
+            "nd,n->d", self.X, row_scalars, preferred_element_type=self.acc_dtype
         )
 
     def hadamard_square_sum(self, row_scalars: Array) -> Array:
         """sum_i row_scalars_i * x_i**2 — Hessian-diagonal inner sum."""
         return jnp.einsum(
             "nd,n->d", self.X * self.X, row_scalars,
-            preferred_element_type=self._acc_dtype(),
+            preferred_element_type=self.acc_dtype,
         )
 
 

@@ -34,6 +34,7 @@ from photon_ml_tpu.data.batch import DenseBatch
 from photon_ml_tpu.game.dataset import RandomEffectDataset
 from photon_ml_tpu.ops.aggregators import GLMObjective
 from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.optimize.common import solver_x0
 from photon_ml_tpu.optimize.config import (
     GLMOptimizationConfiguration,
     OptimizerType,
@@ -135,10 +136,7 @@ class RandomEffectOptimizationProblem:
         cfg = self.config
         e, _, d = dataset.X.shape
         acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
-        if initial is not None:
-            acc = jnp.promote_types(acc, jnp.asarray(initial).dtype)
-        x0 = (jnp.zeros((e, d), acc)
-              if initial is None else jnp.asarray(initial, acc))
+        x0 = solver_x0(acc, (e, d), initial)
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         if cfg.optimizer_type == OptimizerType.TRON:
             if self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
@@ -153,7 +151,7 @@ class RandomEffectOptimizationProblem:
             solver = "lbfgs"
         coefs, iters, values = _fit_blocks(
             dataset.X, dataset.labels, offsets, dataset.weights, x0,
-            self.objective(), jnp.full(d, l1, dataset.X.dtype),
+            self.objective(), jnp.full(d, l1, x0.dtype),
             solver, cfg.max_iterations, float(cfg.tolerance))
         return coefs, iters, values
 

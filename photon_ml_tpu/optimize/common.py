@@ -55,6 +55,18 @@ class BoxConstraints(NamedTuple):
         return BoxConstraints(jnp.asarray(lower), jnp.asarray(upper))
 
 
+def solver_x0(acc_dtype, shape, initial: Optional[Array]) -> Array:
+    """Initial solver state under the mixed-precision invariant: at least
+    ``acc_dtype`` (f32 over low-precision data), and a warm start can only
+    UPCAST — a bf16 initial promotes, an f64 initial keeps the whole solve
+    in f64 (x64 callers rely on that). ONE definition for every solve
+    entry point (single-chip, shard_map, per-entity vmapped)."""
+    if initial is None:
+        return jnp.zeros(shape, acc_dtype)
+    initial = jnp.asarray(initial)
+    return initial.astype(jnp.promote_types(acc_dtype, initial.dtype))
+
+
 def project_box(x: Array, box: Optional[BoxConstraints]) -> Array:
     if box is None:
         return x

@@ -37,6 +37,7 @@ from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.optimize.common import (
     BoxConstraints,
     OptimizationResult,
+    solver_x0,
 )
 from photon_ml_tpu.optimize.config import (
     GLMOptimizationConfiguration,
@@ -175,15 +176,7 @@ class GLMOptimizationProblem:
 
             return run_glm_shard_map(self, batch, mesh, initial=initial)
         dim = batch.num_features
-        # coefficients stay at least f32 even over a bf16 design matrix
-        # (batch.acc_dtype); a warm start can only UPCAST the state (a
-        # bf16 initial is promoted to f32, an f64 initial keeps the whole
-        # solve in f64 — x64 callers rely on that)
-        dtype = batch.acc_dtype
-        if initial is not None:
-            dtype = jnp.promote_types(dtype, jnp.asarray(initial).dtype)
-        x0 = (jnp.zeros(dim, dtype) if initial is None
-              else jnp.asarray(initial, dtype))
+        x0 = solver_x0(batch.acc_dtype, dim, initial)
         obj = self.objective()
         x, history, progressed = self.solve(obj, batch, x0)
         return self.publish(x, history, progressed, obj, batch)

@@ -57,7 +57,7 @@ except ImportError:  # pragma: no cover
 
 from photon_ml_tpu.data.batch import Batch, pad_batch
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
-from photon_ml_tpu.optimize.common import OptimizationResult
+from photon_ml_tpu.optimize.common import OptimizationResult, solver_x0
 from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
 
@@ -82,13 +82,7 @@ def run_glm_shard_map(
         batch = pad_batch(batch, padded)
 
     dim = batch.num_features
-    # solver state stays at least f32 over a bf16 design matrix, exactly
-    # like the single-chip path; warm starts only ever upcast
-    dtype = batch.acc_dtype
-    if initial is not None:
-        dtype = jnp.promote_types(dtype, jnp.asarray(initial).dtype)
-    x0 = (jnp.zeros(dim, dtype) if initial is None
-          else jnp.asarray(initial, dtype))
+    x0 = solver_x0(batch.acc_dtype, dim, initial)
     # psum-ing objective: every reduction crosses the data axis.
     obj = dataclasses.replace(problem.objective(), axis_name=DATA_AXIS)
 
