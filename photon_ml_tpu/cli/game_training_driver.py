@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import argparse
 import itertools
+import json
+import math
 import os
 import sys
 from typing import Optional, Sequence
@@ -468,6 +470,35 @@ class GameTrainingDriver:
         best, results = self.train()
         _, best_result, best_desc = best
         self.logger.info(f"best model: {best_desc}")
+
+        # Persist the training/validation record per grid point (the GAME
+        # analog of the legacy driver's metrics.json; the reference only
+        # logs these — cli/game/training/Driver.scala:557-592).
+        def _finite(x):
+            # strict-JSON artifact: a diverged grid point's NaN objective
+            # must serialize as null, not the bare NaN token
+            x = None if x is None else float(x)
+            return x if x is not None and math.isfinite(x) else None
+
+        record = {
+            "best": {"description": best_desc,
+                     "metric": _finite(best_result.best_metric)},
+            "grid": [
+                {"description": desc,
+                 "states": [
+                     {"iteration": s.iteration,
+                      "coordinate": s.coordinate_id,
+                      "objective": _finite(s.objective),
+                      "seconds": round(float(s.seconds), 3),
+                      "validation_metrics": (
+                          None if s.validation_metrics is None else
+                          {k: _finite(v)
+                           for k, v in s.validation_metrics.items()})}
+                     for s in result.states]}
+                for desc, result in results],
+        }
+        with open(os.path.join(ns.output_dir, "metrics.json"), "w") as fh:
+            json.dump(record, fh, indent=1)
 
         if ns.model_output_mode != ModelOutputMode.NONE:
             entity_vocabs = dict(self.train_data.id_vocabs)

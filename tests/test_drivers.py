@@ -826,3 +826,37 @@ class TestFactoredDriver:
         assert model.projection.shape == (2, 4)
         assert np.all(np.isfinite(np.asarray(model.projection)))
         assert np.all(np.isfinite(np.asarray(model.coefficients_latent)))
+
+
+class TestGameMetricsOutput:
+    def test_metrics_json_written(self, tmp_path):
+        """GAME training persists the per-grid-point objective/validation
+        record (the legacy driver's metrics.json analog)."""
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game_avro(train, n=150, seed=51)
+        _make_game_avro(validate, n=80, seed=52)
+        out = str(tmp_path / "out")
+        game_main([
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures",
+            "--updating-sequence", "fixed",
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:10,1e-7,1,1,LBFGS,L2;fixed:10,1e-7,0.01,1,LBFGS,L2",
+            "--evaluator-type", "AUC",
+            "--model-output-mode", "NONE",
+        ])
+        rec = json.load(open(os.path.join(out, "metrics.json")))
+        assert rec["best"]["metric"] is not None
+        assert len(rec["grid"]) == 2
+        for g in rec["grid"]:
+            assert len(g["states"]) == 2  # 2 CD iterations x 1 coordinate
+            for s in g["states"]:
+                assert np.isfinite(s["objective"])
+                assert "AUC" in s["validation_metrics"]
