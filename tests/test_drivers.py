@@ -860,3 +860,36 @@ class TestGameMetricsOutput:
             for s in g["states"]:
                 assert np.isfinite(s["objective"])
                 assert "AUC" in s["validation_metrics"]
+
+
+class TestDownSampling:
+    def test_fixed_effect_down_sampling_via_cli(self, tmp_path):
+        """The opt-config's 4th field (downSamplingRate < 1) engages the
+        per-update sampler on the fixed coordinate
+        (DistributedOptimizationProblem.runWithSampling analog) and still
+        produces a learnable model."""
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game_avro(train, n=400, seed=61)
+        _make_game_avro(validate, n=150, seed=62)
+        out = str(tmp_path / "out")
+        game_main([
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures",
+            "--updating-sequence", "fixed",
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:25,1e-7,0.1,0.5,LBFGS,L2",
+            "--evaluator-type", "AUC",
+            "--model-output-mode", "NONE",
+        ])
+        rec = json.load(open(os.path.join(out, "metrics.json")))
+        aucs = [s["validation_metrics"]["AUC"]
+                for g in rec["grid"] for s in g["states"]]
+        assert all(np.isfinite(a) for a in aucs)
+        assert max(aucs) > 0.6  # half the negatives dropped, still learns
