@@ -592,6 +592,24 @@ def compile_writer(schema: Any, names: dict) -> Any:
 # ---------------------------------------------------------------------------
 
 
+def write_container_header(fh, schema: Any, codec: str,
+                           sync: bytes) -> None:
+    """Container file header: MAGIC + meta map (schema JSON, codec) +
+    sync marker — THE framing definition shared by every writer."""
+    fh.write(MAGIC)
+    header = io.BytesIO()
+    enc = BinaryEncoder(header)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    enc.write_long(len(meta))
+    for k, v in meta.items():
+        enc.write_string(k)
+        enc.write_bytes(v)
+    enc.write_long(0)
+    fh.write(header.getvalue())
+    fh.write(sync)
+
+
 def write_container(path: str, schema: Any, records: Iterable[dict],
                     codec: str = "deflate",
                     sync_interval: int = DEFAULT_SYNC_INTERVAL) -> None:
@@ -602,18 +620,7 @@ def write_container(path: str, schema: Any, records: Iterable[dict],
     sync = os.urandom(SYNC_SIZE)
 
     with open(path, "wb") as fh:
-        fh.write(MAGIC)
-        header = io.BytesIO()
-        enc = BinaryEncoder(header)
-        meta = {"avro.schema": json.dumps(schema).encode(),
-                "avro.codec": codec.encode()}
-        enc.write_long(len(meta))
-        for k, v in meta.items():
-            enc.write_string(k)
-            enc.write_bytes(v)
-        enc.write_long(0)
-        fh.write(header.getvalue())
-        fh.write(sync)
+        write_container_header(fh, schema, codec, sync)
 
         block = io.BytesIO()
         benc = BinaryEncoder(block)

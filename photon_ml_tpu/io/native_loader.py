@@ -112,6 +112,19 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
             ]
+            lib.photon_encode_scores.restype = ctypes.c_int64
+            lib.photon_encode_scores.argtypes = [
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_void_p,  # labels (nullable)
+                ctypes.c_void_p,  # weights (nullable)
+                ctypes.c_void_p,  # uid arena (nullable)
+                ctypes.c_void_p,  # uid offsets (nullable)
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                ctypes.c_int64,
+            ]
         except (OSError, AttributeError):
             # unloadable lib OR a stale lib missing a newer entry point —
             # degrade to the Python paths rather than crashing every call
@@ -220,3 +233,51 @@ def parse_libsvm_native(path: str, zero_based: bool
     mat = sp.csr_matrix((values[:k], indices[:k], indptr),
                         shape=(n, max(dim, 0)))
     return labels, mat, dim
+
+
+def encode_scores_native(scores: np.ndarray, model_id: str,
+                         uids=None, labels=None,
+                         weights=None) -> "Optional[bytes]":
+    """ScoringResultAvro record stream for a whole block
+    (native/score_encoder.cpp); None when the library is unavailable."""
+    lib = get_native_lib()
+    if lib is None:
+        return None
+    scores = np.ascontiguousarray(scores, np.float64)
+    n = len(scores)
+
+    def vp(a):
+        return (None if a is None
+                else a.ctypes.data_as(ctypes.c_void_p))
+
+    labels_a = (None if labels is None
+                else np.ascontiguousarray(labels, np.float64))
+    weights_a = (None if weights is None
+                 else np.ascontiguousarray(weights, np.float64))
+    uid_arena = uid_offsets = None
+    uid_bytes = 0
+    if uids is not None:
+        encoded = [str(u).encode("utf-8") for u in uids]
+        uid_offsets = np.zeros(n + 1, np.uint32)
+        np.cumsum([len(b) for b in encoded], out=uid_offsets[1:])
+        uid_arena = np.frombuffer(b"".join(encoded), np.uint8)
+        if uid_arena.size == 0:
+            uid_arena = np.zeros(1, np.uint8)
+        uid_bytes = int(uid_offsets[-1])
+    mid = model_id.encode("utf-8")
+    mid_arr = np.frombuffer(mid, np.uint8)
+    if mid_arr.size == 0:
+        mid_arr = np.zeros(1, np.uint8)
+    # worst case per record: 5-byte length varints for uid and modelId
+    # plus all value bytes; every byte up to `written` is overwritten so
+    # the buffer needs no zero-fill
+    cap = n * (38 + len(mid)) + uid_bytes + 64
+    out = np.empty(cap, np.uint8)
+    written = lib.photon_encode_scores(
+        n, scores, vp(labels_a), vp(weights_a), vp(uid_arena),
+        vp(uid_offsets), mid_arr, len(mid), out, cap)
+    if written < 0:
+        # encoder refused (should not happen with the exact cap) — let the
+        # caller fall back to the Python writer instead of failing the save
+        return None
+    return out[:written].tobytes()
