@@ -893,3 +893,39 @@ class TestDownSampling:
                 for g in rec["grid"] for s in g["states"]]
         assert all(np.isfinite(a) for a in aucs)
         assert max(aucs) > 0.6  # half the negatives dropped, still learns
+
+    def test_wide_sparse_validation_metrics(self, tmp_path):
+        """The validate stage's fused grid evaluator runs over the ELL
+        layout (wide validation shard) and produces sane AUC."""
+        rng = np.random.default_rng(31)
+        d = 5000
+        hot = rng.choice(d, size=6, replace=False) + 1
+        w_true = rng.normal(size=6)
+
+        def write(path, seed, n):
+            r = np.random.default_rng(seed)
+            with open(path, "w") as fh:
+                for i in range(n):
+                    x = r.normal(size=6)
+                    y = 1 if (x @ w_true) > 0 else -1
+                    feats = " ".join(f"{int(j)}:{v:.5f}"
+                                     for j, v in zip(sorted(hot), x))
+                    fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
+
+        train = str(tmp_path / "train.libsvm")
+        validate = str(tmp_path / "validate.libsvm")
+        write(train, 1, 250)
+        write(validate, 2, 120)
+        driver = LegacyDriver(parse_args([
+            "--training-data-directory", train,
+            "--validating-data-directory", validate,
+            "--output-directory", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--input-file-format", "LIBSVM",
+            "--feature-dimension", str(d),
+            "--regularization-weights", "0.1",
+            "--num-iterations", "25",
+        ]))
+        driver.run()
+        key = "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+        assert driver.per_lambda_metrics[0.1][key] > 0.8
