@@ -701,6 +701,21 @@ class TestLibsvmToAvro:
         assert recs[1]["features"][0]["name"] == "2"
 
 
+def _write_wide_libsvm(path, hot, w_true, seed, n, scale=1.0, shift=0.0,
+                       label_rule=None):
+    """Hot-column wide LibSVM fixture shared by the wide-sparse tests."""
+    r = np.random.default_rng(seed)
+    k = len(hot)
+    with open(path, "w") as fh:
+        for _ in range(n):
+            x = r.normal(size=k) * scale + shift
+            y = (1 if (x @ w_true) > 0 else -1) if label_rule is None \
+                else label_rule(x)
+            feats = " ".join(f"{int(j)}:{v:.5f}"
+                             for j, v in zip(sorted(hot), x))
+            fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
+
+
 class TestWideSparse:
     def test_legacy_driver_wide_sparse_trains_via_ell(self, tmp_path):
         """A feature space past the dense threshold must train through the
@@ -710,17 +725,10 @@ class TestWideSparse:
 
         d = DENSE_FEATURE_THRESHOLD + 100
         rng = np.random.default_rng(23)
-        n = 200
         libsvm = str(tmp_path / "wide.libsvm")
         w_true = rng.normal(size=8)
         hot = rng.choice(d, size=8, replace=False) + 1  # 1-based
-        with open(libsvm, "w") as fh:
-            for i in range(n):
-                x = rng.normal(size=8)
-                y = 1 if (x @ w_true) > 0 else -1
-                feats = " ".join(f"{int(j)}:{v:.5f}"
-                                 for j, v in zip(hot, x))
-                fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
+        _write_wide_libsvm(libsvm, hot, w_true, seed=23, n=200)
         driver = LegacyDriver(parse_args([
             "--training-data-directory", libsvm,
             "--output-directory", str(tmp_path / "out"),
@@ -743,13 +751,9 @@ class TestWideSparse:
         d = 5000
         libsvm = str(tmp_path / "wide.libsvm")
         hot = rng.choice(d, size=6, replace=False) + 1
-        with open(libsvm, "w") as fh:
-            for i in range(150):
-                x = rng.normal(size=6) * 10.0 + 3.0  # badly scaled
-                y = 1 if x.sum() > 18 else -1
-                feats = " ".join(f"{int(j)}:{v:.5f}"
-                                 for j, v in zip(sorted(hot), x))
-                fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
+        _write_wide_libsvm(libsvm, hot, np.ones(6), seed=29, n=150,
+                           scale=10.0, shift=3.0,
+                           label_rule=lambda x: 1 if x.sum() > 18 else -1)
         driver = LegacyDriver(parse_args([
             "--training-data-directory", libsvm,
             "--output-directory", str(tmp_path / "out"),
@@ -768,6 +772,36 @@ class TestWideSparse:
         expected = set((hot - 1).tolist()) | {d}  # intercept last
         assert set(nz.tolist()) <= expected
         assert len(nz) >= 6
+
+
+    def test_wide_sparse_validation_metrics(self, tmp_path):
+        """The validate stage's fused grid evaluator runs the whole lambda
+        grid over an ELL validation batch (wide shard) with sane AUC."""
+        from photon_ml_tpu.data.batch import EllBatch
+
+        rng = np.random.default_rng(31)
+        d = 5000
+        hot = rng.choice(d, size=6, replace=False) + 1
+        w_true = rng.normal(size=6)
+        train = str(tmp_path / "train.libsvm")
+        validate = str(tmp_path / "validate.libsvm")
+        _write_wide_libsvm(train, hot, w_true, seed=1, n=250)
+        _write_wide_libsvm(validate, hot, w_true, seed=2, n=120)
+        driver = LegacyDriver(parse_args([
+            "--training-data-directory", train,
+            "--validating-data-directory", validate,
+            "--output-directory", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--input-file-format", "LIBSVM",
+            "--feature-dimension", str(d),
+            "--regularization-weights", "10,1,0.1",
+            "--num-iterations", "25",
+        ]))
+        driver.run()
+        assert isinstance(driver._validation_batch(), EllBatch)
+        key = "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+        assert len(driver.per_lambda_metrics) == 3
+        assert max(m[key] for m in driver.per_lambda_metrics.values()) > 0.8
 
 
 class TestFactoredDriver:
@@ -893,39 +927,3 @@ class TestDownSampling:
                 for g in rec["grid"] for s in g["states"]]
         assert all(np.isfinite(a) for a in aucs)
         assert max(aucs) > 0.6  # half the negatives dropped, still learns
-
-    def test_wide_sparse_validation_metrics(self, tmp_path):
-        """The validate stage's fused grid evaluator runs over the ELL
-        layout (wide validation shard) and produces sane AUC."""
-        rng = np.random.default_rng(31)
-        d = 5000
-        hot = rng.choice(d, size=6, replace=False) + 1
-        w_true = rng.normal(size=6)
-
-        def write(path, seed, n):
-            r = np.random.default_rng(seed)
-            with open(path, "w") as fh:
-                for i in range(n):
-                    x = r.normal(size=6)
-                    y = 1 if (x @ w_true) > 0 else -1
-                    feats = " ".join(f"{int(j)}:{v:.5f}"
-                                     for j, v in zip(sorted(hot), x))
-                    fh.write(f"{'+1' if y > 0 else '-1'} {feats}\n")
-
-        train = str(tmp_path / "train.libsvm")
-        validate = str(tmp_path / "validate.libsvm")
-        write(train, 1, 250)
-        write(validate, 2, 120)
-        driver = LegacyDriver(parse_args([
-            "--training-data-directory", train,
-            "--validating-data-directory", validate,
-            "--output-directory", str(tmp_path / "out"),
-            "--task", "LOGISTIC_REGRESSION",
-            "--input-file-format", "LIBSVM",
-            "--feature-dimension", str(d),
-            "--regularization-weights", "0.1",
-            "--num-iterations", "25",
-        ]))
-        driver.run()
-        key = "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
-        assert driver.per_lambda_metrics[0.1][key] > 0.8
