@@ -1,0 +1,262 @@
+"""Shared multi-chip GAME acceptance scenario.
+
+One tiny-but-complete GAME training step — a data-sharded fixed effect, an
+entity-sharded vmapped random effect, a factored random-effect coordinate
+(latent per-entity refit + Kronecker projection fit), mesh-sharded
+matrix-factorization scoring, and the explicit shard_map+psum fixed-effect
+backend — runnable either over a (data x entity) device mesh or on a single
+device with IDENTICAL shapes and padding, so multi-device runs can be
+asserted equal to the single-device ground truth.
+
+Used by BOTH the committed multi-device pytest tier (tests/test_multichip.py)
+and the driver's ``__graft_entry__.dryrun_multichip`` gate, so the gate and
+the test suite witness the same code path — the analog of the reference's
+shared local[4] harness plus its GameTestUtils factories
+(photon-test/.../SparkTestUtils.scala:55-69,
+integTest/.../GameTestUtils.scala:36-270). Coordinate coverage matches the
+GAME decomposition (algorithm/FixedEffectCoordinate.scala,
+RandomEffectCoordinate.scala:104-113,
+FactoredRandomEffectCoordinate.scala:39-257,
+model/MatrixFactorizationModel.scala:50,141).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def run_game_step(
+    n_data: int = 4,
+    n_entity: int = 2,
+    mesh=None,
+    seed: int = 3,
+) -> dict:
+    """One full GAME coordinate-descent sweep on a tiny synthetic dataset.
+
+    ``n_data``/``n_entity`` fix the SHAPES (rows, entity padding) so a
+    ``mesh=None`` single-device run is bit-comparable to a mesh run over
+    an ``n_data x n_entity`` device mesh. When ``mesh`` is given it must
+    have axes sizes (n_data, n_entity); inputs are device_put onto it and
+    the fixed-effect solves route through the shard_map+psum backend.
+
+    Returns numpy results for parity assertions:
+    ``objectives`` (per-coordinate CD objective values), ``fixed``
+    (fixed-effect coefficients), ``re_coefficients`` ([E, D] random-effect
+    coefficients, raw space), ``projection`` (factored-RE projection
+    matrix), ``latent`` ([E, K] factored-RE latent coefficients),
+    ``mf_scores`` (matrix-factorization scores), ``shardmap_fixed``
+    (explicit-collectives fixed-effect fit).
+    """
+    import jax
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_ml_tpu.game.coordinate import (
+        FactoredRandomEffectCoordinate,
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+    from photon_ml_tpu.game.dataset import (
+        GameDataset,
+        RandomEffectDataConfiguration,
+        build_fixed_effect_dataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.models import MatrixFactorizationModel
+    from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem
+    from photon_ml_tpu.optimize.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+        TaskType,
+    )
+    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+    from photon_ml_tpu.parallel.mesh import (
+        DATA_AXIS,
+        ENTITY_AXIS,
+        get_default_mesh,
+        set_default_mesh,
+        shard_batch,
+    )
+    from photon_ml_tpu.projector.projectors import (
+        ProjectorConfig,
+        ProjectorType,
+    )
+
+    if mesh is not None:
+        assert mesh.shape[DATA_AXIS] == n_data, mesh.shape
+        assert mesh.shape[ENTITY_AXIS] == n_entity, mesh.shape
+
+    # --- tiny GAME dataset: global shard + per-user shard, rows divisible
+    # by the data axis, entities padded to the entity axis.
+    n_devices = n_data * n_entity
+    rng = np.random.default_rng(seed)
+    rows, d_g, d_u, n_users = 16 * n_devices, 12, 6, 4 * n_entity
+    n_items = 6
+    Xg = rng.normal(size=(rows, d_g))
+    Xu = rng.normal(size=(rows, d_u))
+    users = rng.integers(0, n_users, size=rows)
+    y = (rng.uniform(size=rows) < 0.5).astype(np.float64)
+    data = GameDataset(responses=y,
+                       feature_shards={"global": sp.csr_matrix(Xg),
+                                       "user": sp.csr_matrix(Xu)})
+    data.encode_ids("userId", users.astype(str))
+
+    task = TaskType.LOGISTIC_REGRESSION
+
+    def cfg(lam):
+        return GLMOptimizationConfiguration(
+            max_iterations=3, tolerance=1e-6, regularization_weight=lam,
+            optimizer_type=OptimizerType.LBFGS,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2))
+
+    fe_ds = build_fixed_effect_dataset(data, "global")
+    fe_batch = shard_batch(fe_ds.batch, mesh) if mesh is not None \
+        else fe_ds.batch
+    fixed = FixedEffectCoordinate(
+        dataset=fe_ds._replace(batch=fe_batch,
+                               base_offsets=fe_ds.base_offsets)
+        if hasattr(fe_ds, "_replace") else fe_ds,
+        problem=GLMOptimizationProblem(config=cfg(0.1), task=task))
+
+    ent = NamedSharding(mesh, P(ENTITY_AXIS)) if mesh is not None else None
+    re_ds = build_random_effect_dataset(
+        data, RandomEffectDataConfiguration("userId", "user", 1),
+        entity_axis_size=n_entity)
+    if ent is not None:
+        # entity-major blocks sharded over the entity axis
+        re_ds.X = jax.device_put(re_ds.X, ent)
+    rand = RandomEffectCoordinate(
+        dataset=re_ds,
+        problem=RandomEffectOptimizationProblem(config=cfg(0.5), task=task))
+
+    # Factored random effect: identity-projected raw blocks on the same
+    # entity sharding; the latent refit's Kronecker batch is sample-major
+    # and rides the data axis (FactoredRandomEffectCoordinate.scala:39-257).
+    fre_ds = build_random_effect_dataset(
+        data, RandomEffectDataConfiguration(
+            "userId", "user", 1,
+            projector=ProjectorConfig(ProjectorType.IDENTITY)),
+        entity_axis_size=n_entity)
+    if ent is not None:
+        fre_ds.X = jax.device_put(fre_ds.X, ent)
+    factored = FactoredRandomEffectCoordinate(
+        dataset=fre_ds,
+        problem=RandomEffectOptimizationProblem(config=cfg(0.5), task=task),
+        latent_problem=GLMOptimizationProblem(config=cfg(0.1), task=task),
+        latent_dim=2, num_inner_iterations=1)
+
+    coordinates = {"fixed": fixed, "perUser": rand,
+                   "perUserFactored": factored}
+    labels = jnp.asarray(data.responses)
+    weights = jnp.asarray(data.weights)
+    offsets = jnp.asarray(data.offsets)
+
+    # Route fixed-effect solves through the shard_map backend when a mesh
+    # is active, as the production drivers do (GLMOptimizationProblem.run's
+    # mesh check); restore whatever mesh the caller had.
+    prev_mesh = get_default_mesh()
+    set_default_mesh(mesh)
+    try:
+        if mesh is not None:
+            with mesh:
+                result = run_coordinate_descent(
+                    coordinates, 1, task, labels, weights, offsets)
+        else:
+            result = run_coordinate_descent(
+                coordinates, 1, task, labels, weights, offsets)
+    finally:
+        set_default_mesh(prev_mesh)
+
+    fre_model = result.model.models["perUserFactored"]
+    re_model = result.model.models["perUser"]
+
+    # Matrix-factorization scoring: replicated factor tables, data-sharded
+    # (row, col) code vectors, one jitted gather+dot
+    # (model/MatrixFactorizationModel.scala:50,141's join as a gather).
+    k_lat = 3
+    mf = MatrixFactorizationModel(
+        row_effect_type="userId", col_effect_type="itemId",
+        row_factors=jnp.asarray(
+            rng.normal(size=(n_users, k_lat)).astype(np.float32)),
+        col_factors=jnp.asarray(
+            rng.normal(size=(n_items, k_lat)).astype(np.float32)),
+    )
+    r_codes = jnp.asarray(users.astype(np.int32))
+    # every item id appears, so dictionary codes == raw ids below
+    items = rng.permutation(
+        np.resize(np.arange(n_items, dtype=np.int32), rows))
+    c_codes = jnp.asarray(items)
+    if mesh is not None:
+        data_sharded = NamedSharding(mesh, P((DATA_AXIS, ENTITY_AXIS)))
+        repl = NamedSharding(mesh, P())
+        r_codes = jax.device_put(r_codes, data_sharded)
+        c_codes = jax.device_put(c_codes, data_sharded)
+        rf = jax.device_put(mf.row_factors, repl)
+        cf = jax.device_put(mf.col_factors, repl)
+    else:
+        rf, cf = mf.row_factors, mf.col_factors
+
+    @jax.jit
+    def mf_score(rf, cf, r, c):
+        return jnp.sum(rf[r] * cf[c], axis=-1)
+
+    mf_scores = np.asarray(jax.device_get(mf_score(rf, cf, r_codes, c_codes)))
+    # parity with the model's host-side scoring path
+    data.encode_ids("itemId", items)
+    np.testing.assert_allclose(
+        mf_scores, np.asarray(mf.score(data)), rtol=1e-5, atol=1e-6)
+
+    # --- explicit collectives backend: shard_map + psum fixed-effect fit
+    # (mesh=None: the same problem solved locally — the parity referent).
+    sm_problem = GLMOptimizationProblem(config=cfg(0.1), task=task)
+    if mesh is not None:
+        sm_model, _ = run_glm_shard_map(
+            sm_problem, shard_batch(fe_ds.batch, mesh), mesh)
+    else:
+        sm_model, _ = sm_problem.run(fe_ds.batch)
+
+    return {
+        "objectives": np.asarray(
+            [s.objective for s in result.states], dtype=np.float64),
+        "fixed": np.asarray(
+            result.model.models["fixed"].coefficients.means),
+        "re_coefficients": np.asarray(re_model.to_raw().coefficients
+                                      if hasattr(re_model, "to_raw")
+                                      else re_model.coefficients),
+        "projection": np.asarray(fre_model.projection),
+        "latent": np.asarray(fre_model.coefficients_latent),
+        "mf_scores": mf_scores,
+        "shardmap_fixed": np.asarray(sm_model.coefficients.means),
+    }
+
+
+def check_game_step_multichip(n_devices: int, devices=None) -> dict:
+    """Build an (n_data x n_entity) mesh over ``n_devices`` devices, run the
+    GAME step on it, and sanity-assert finiteness. Returns the results dict
+    (the pytest tier additionally asserts parity vs ``run_game_step(mesh=None)``).
+    """
+    import jax
+
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    assert len(devs) >= n_devices, (
+        f"need {n_devices} devices, have {len(devs)}")
+    # Split the mesh: data-parallel fixed effects x entity-parallel random
+    # effects (e.g. 4x2 on 8 devices) — the GAME layout from SURVEY §5.8.
+    n_entity = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+    n_data = n_devices // n_entity
+    mesh = make_mesh(num_data=n_data, num_entity=n_entity,
+                     devices=devs[:n_devices])
+    out = run_game_step(n_data=n_data, n_entity=n_entity, mesh=mesh)
+    for key, val in out.items():
+        assert np.all(np.isfinite(val)), f"non-finite {key}"
+    return out

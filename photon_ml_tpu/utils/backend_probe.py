@@ -1,0 +1,72 @@
+"""Wedge-resilient probing of the default JAX backend.
+
+The accelerator device tunnel in some environments can wedge at backend
+init: a killed client leaves the remote chip grant stuck, after which
+every ``jax.devices()`` call in every new process blocks forever. Any
+code that must survive that (the bench recorder, the multi-chip dry-run
+gate) therefore probes the default backend in a SUBPROCESS with a hard
+timeout before initializing it in its own process, and falls back to CPU
+with a visible marker otherwise.
+
+The probe is SIGTERMed with a grace period rather than SIGKILLed on
+timeout: killing a tunnel client mid-grant-acquisition is exactly what
+wedges the tunnel in the first place.
+
+Reference analog: the Spark drivers assume a live cluster and fail fast
+(Driver.scala:149-151); here the "cluster" is a device tunnel that can
+hang rather than error, so liveness must be established out-of-process.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Callable, Optional
+
+#: Generous allowance for a healthy-but-cold tunnel's first backend init
+#: (observed: normal cold init well under this; wedged init never returns).
+DEFAULT_PROBE_TIMEOUT_SECS = 240
+
+
+def default_platform_is_cpu() -> bool:
+    """True when this process is already pinned to the CPU platform."""
+    return (os.environ.get("JAX_PLATFORMS") or "").split(",")[0] == "cpu"
+
+
+def probe_default_backend(
+    timeout_secs: int = DEFAULT_PROBE_TIMEOUT_SECS,
+    log: Callable[[str], None] = print,
+) -> Optional[int]:
+    """Count the default backend's devices from a timed subprocess.
+
+    Returns the device count on success, or ``None`` when the probe
+    failed, hung past ``timeout_secs``, or produced unparsable output —
+    in which case a reason is emitted through ``log`` so a fallback is
+    always visible in the run record.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_secs)
+        if proc.returncode == 0:
+            # parse only the LAST line: a site import hook may print to
+            # stdout before the count
+            last = out.strip().splitlines()[-1] if out.strip() else ""
+            try:
+                return int(last)
+            except ValueError:
+                log(f"backend probe returned unparsable output {last!r}")
+                return None
+        reason = f"backend probe rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = f"backend probe hung > {timeout_secs}s"
+        proc.terminate()  # SIGTERM first: let the client release its grant
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    log(reason)
+    return None
