@@ -178,8 +178,7 @@ class RandomEffectCoordinate:
 
     def update(self, coefs: Optional[Array], extra_scores: Array
                ) -> tuple[Array, Tracker]:
-        offsets = self.dataset.base_offsets + self.dataset.gather_offsets(
-            extra_scores)
+        offsets = self.dataset.offsets_with(extra_scores)
         new_coefs, iters, values = self.problem.run(
             self.dataset, offsets, initial=coefs)
         tracker = RandomEffectTracker(np.asarray(iters), np.asarray(values))
@@ -234,6 +233,11 @@ class FactoredRandomEffectCoordinate:
                 self.dataset.random_projector is not None:
             raise ValueError(
                 "factored coordinate needs an identity-projected dataset")
+        if self.dataset.buckets is not None:
+            raise ValueError(
+                "factored coordinate needs a single-block dataset "
+                "(build with num_buckets=1): the latent refit shares one "
+                "projection matrix across all entities")
 
     @property
     def num_samples(self) -> int:
@@ -252,7 +256,7 @@ class FactoredRandomEffectCoordinate:
                extra_scores: Array) -> tuple[tuple[Array, Array], Tracker]:
         coefs, B = state if state is not None else self.initial_state()
         ds = self.dataset
-        offsets = ds.base_offsets + ds.gather_offsets(extra_scores)
+        offsets = ds.offsets_with(extra_scores)
         inner: list = []
         for _ in range(self.num_inner_iterations):
             # (1) latent-space per-entity fits on projected blocks.

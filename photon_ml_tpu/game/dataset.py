@@ -330,6 +330,31 @@ class FixedEffectDataConfiguration:
 
 
 @dataclasses.dataclass
+class EntityBucket:
+    """One (N, D)-homogeneous slice of the entity axis.
+
+    SURVEY §7 hard part 1: padding every entity to a single global
+    (N_max, D_red) wastes FLOPs and HBM when entity sizes are skewed (the
+    MovieLens per-user block pads the median user ~20x). Entities are
+    grouped into a few size buckets; each bucket is padded only to ITS
+    (N_b, D_b), and the vmapped solver runs per bucket. Reference analog:
+    exactly-sized per-entity local datasets (data/LocalDataSet.scala:34-155).
+
+    ``entity_start``: first global (compact) entity index of this bucket;
+    bucket row ``i < num_real`` is global entity ``entity_start + i``; rows
+    beyond ``num_real`` are padding lanes for even mesh sharding.
+    """
+
+    entity_start: int
+    num_real: int
+    X: Array  # [E_b, N_b, D_b]
+    labels: Array  # [E_b, N_b]
+    base_offsets: Array  # [E_b, N_b]
+    weights: Array  # [E_b, N_b] (0 = padding)
+    row_ids: Array  # [E_b, N_b] int32 (num_samples = discard)
+
+
+@dataclasses.dataclass
 class RandomEffectDataset:
     """Entity-major active blocks + sample-major passive rows for one coordinate.
 
@@ -344,15 +369,21 @@ class RandomEffectDataset:
 
     ``entity_codes`` maps local entity index → dataset entity code;
     ``projectors`` maps reduced columns back to raw feature ids.
+
+    When built with ``num_buckets > 1`` the single global block is replaced
+    by ``buckets`` (each padded to its own (N_b, D_b) — see EntityBucket)
+    and ``X/labels/base_offsets/weights/row_ids`` are ``None``; global
+    coefficient blocks stay compact ``[num_entities, reduced_dim]`` with
+    entity order bucket-major.
     """
 
     config: RandomEffectDataConfiguration
     entity_codes: np.ndarray  # [E] codes into GameDataset vocab
-    X: Array  # [E, N_max, D_red]
-    labels: Array  # [E, N_max]
-    base_offsets: Array  # [E, N_max]
-    weights: Array  # [E, N_max] (0 = padding)
-    row_ids: Array  # [E, N_max] int32 (num_samples = discard)
+    X: Optional[Array]  # [E, N_max, D_red] (None when bucketed)
+    labels: Optional[Array]  # [E, N_max]
+    base_offsets: Optional[Array]  # [E, N_max]
+    weights: Optional[Array]  # [E, N_max] (0 = padding)
+    row_ids: Optional[Array]  # [E, N_max] int32 (num_samples = discard)
     num_samples: int  # N of the parent GameDataset
     projectors: Optional[IndexMapProjectors] = None
     random_projector: Optional[RandomProjector] = None
@@ -361,17 +392,26 @@ class RandomEffectDataset:
     passive_entity: Optional[Array] = None  # [P] int32
     passive_row_ids: Optional[Array] = None  # [P] int32
     passive_offsets: Optional[Array] = None  # [P]
+    # (N, D)-bucketed active blocks (replaces X... when present)
+    buckets: Optional[list[EntityBucket]] = None
+    _reduced_dim: Optional[int] = None  # set when bucketed
 
     @property
     def num_entities(self) -> int:
+        if self.buckets is not None:
+            return sum(b.num_real for b in self.buckets)
         return int(self.X.shape[0])
 
     @property
     def max_rows_per_entity(self) -> int:
+        if self.buckets is not None:
+            return max(int(b.X.shape[1]) for b in self.buckets)
         return int(self.X.shape[1])
 
     @property
     def reduced_dim(self) -> int:
+        if self.buckets is not None:
+            return int(self._reduced_dim)
         return int(self.X.shape[2])
 
     @property
@@ -384,6 +424,15 @@ class RandomEffectDataset:
         RandomEffectDataSet.addScoresToOffsets :55-74)."""
         padded = jnp.concatenate([scores, jnp.zeros(1, scores.dtype)])
         return padded[self.row_ids]
+
+    def offsets_with(self, extra_scores: Array):
+        """Per-block training offsets (base + other coordinates' scores):
+        one ``[E, N_max]`` array, or a list per bucket when bucketed."""
+        if self.buckets is None:
+            return self.base_offsets + self.gather_offsets(extra_scores)
+        padded = jnp.concatenate(
+            [extra_scores, jnp.zeros(1, extra_scores.dtype)])
+        return [b.base_offsets + padded[b.row_ids] for b in self.buckets]
 
     def gather_passive_offsets(self, scores: Array) -> Array:
         if self.passive_row_ids is None:
@@ -522,6 +571,138 @@ def _build_projectors_from_active(
     return IndexMapProjectors(raw_indices, reduced_dims, raw_dim)
 
 
+def _bucket_plan(counts: np.ndarray, num_buckets: int, multiple: int
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Optimal (N-threshold) bucketing of entities by active-row count.
+
+    Quantizes counts up to ``multiple`` (rows are padded to that multiple
+    anyway), then a small exact DP over the distinct quantized sizes picks
+    ≤ ``num_buckets`` contiguous groups minimizing the padded area
+    Σ_b E_b · N_b — the FLOP/HBM cost of the vmapped solve. Returns
+    ``(bucket_n_max desc [K], bucket_of [E])``.
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    q = np.maximum(multiple, -(-counts // multiple) * multiple)
+    uniq = np.unique(q)[::-1]  # descending sizes
+    m = len(uniq)
+    k = min(num_buckets, m)
+    if k >= m:
+        n_max = uniq
+        bucket_of = np.searchsorted(-uniq, -q)
+        return n_max, bucket_of
+    w = np.array([(q == u).sum() for u in uniq], dtype=np.int64)
+    prefix = np.concatenate([[0], np.cumsum(w)])
+    inf = np.iinfo(np.int64).max // 4
+    # f[j, t] = min padded area covering the j largest sizes with t buckets
+    f = np.full((m + 1, k + 1), inf, dtype=np.int64)
+    arg = np.zeros((m + 1, k + 1), dtype=np.int64)
+    f[0, 0] = 0
+    for t in range(1, k + 1):
+        for j in range(t, m + 1):
+            # bucket (i..j] has N = uniq[i] (largest member)
+            cand = f[:j, t - 1] + uniq[:j] * (prefix[j] - prefix[:j])
+            i = int(np.argmin(cand))
+            f[j, t], arg[j, t] = cand[i], i
+    cuts = []
+    j = m
+    for t in range(k, 0, -1):
+        i = int(arg[j, t])
+        cuts.append(i)
+        j = i
+    cuts = cuts[::-1]  # ascending segment starts into uniq
+    n_max = uniq[np.asarray(cuts)]
+    # entity -> bucket: the segment its quantized size falls in
+    seg_of_size = np.zeros(m, dtype=np.int64)
+    for b, start in enumerate(cuts):
+        seg_of_size[start:] = b
+    size_rank = np.searchsorted(-uniq, -q)
+    return n_max, seg_of_size[size_rank]
+
+
+def _pack_entity_buckets(
+    sub: sp.csr_matrix,
+    ent_of_act: np.ndarray,
+    slot_of_act: np.ndarray,
+    act_labels: np.ndarray,
+    act_offsets: np.ndarray,
+    act_weights: np.ndarray,
+    rows_act: np.ndarray,
+    n_samples: int,
+    bucket_sizes: np.ndarray,
+    bucket_n_max: np.ndarray,
+    entity_axis_size: int,
+    projectors: Optional[IndexMapProjectors],
+    random_projector: Optional[RandomProjector],
+    d_red: int,
+    dtype,
+    pad_dim_multiple: int = 8,
+) -> list[EntityBucket]:
+    """Pack active rows into per-bucket (N_b, D_b) blocks.
+
+    ``ent_of_act`` are GLOBAL compact entity indices (bucket-major order);
+    bucket b owns entities [starts[b], starts[b] + bucket_sizes[b]). Each
+    bucket's D_b is the max per-entity reduced dim within it (index-map
+    projection narrows tall-entity buckets too — that is the D half of the
+    (N, D) bucketing), padded for lane alignment.
+    """
+    starts = np.concatenate([[0], np.cumsum(bucket_sizes)])
+    bucket_of_act = np.searchsorted(starts, ent_of_act, side="right") - 1
+    buckets: list[EntityBucket] = []
+    for b in range(len(bucket_sizes)):
+        nr = int(bucket_sizes[b])
+        start = int(starts[b])
+        n_b = int(bucket_n_max[b])
+        if projectors is not None:
+            d_b = int(projectors.reduced_dims[start:start + nr].max())
+            d_b = max(1, -(-max(d_b, 1) // pad_dim_multiple)
+                      * pad_dim_multiple)
+            d_b = min(d_b, d_red)
+        else:
+            d_b = d_red
+        e_b = max(1, -(-nr // entity_axis_size) * entity_axis_size)
+
+        mask = bucket_of_act == b
+        loc = ent_of_act[mask] - start
+        slots = slot_of_act[mask]
+        X = np.zeros((e_b, n_b, d_b), dtype=np.float32)
+        labels = np.zeros((e_b, n_b), dtype=np.float32)
+        offsets = np.zeros((e_b, n_b), dtype=np.float32)
+        weights = np.zeros((e_b, n_b), dtype=np.float32)
+        row_ids = np.full((e_b, n_b), n_samples, dtype=np.int32)
+        labels[loc, slots] = act_labels[mask]
+        offsets[loc, slots] = act_offsets[mask]
+        weights[loc, slots] = act_weights[mask]
+        row_ids[loc, slots] = rows_act[mask]
+
+        sub_b = sub[mask]
+        if projectors is not None:
+            # Per-bucket table slice: every entity's valid columns sit in
+            # the first reduced_dims[e] <= D_b positions, so truncating to
+            # D_b only drops pad sentinels.
+            raw_idx_b = projectors.raw_indices[start:start + nr, :d_b]
+            if not pack_projected_rows_native(
+                    sub_b, loc, loc * n_b + slots, raw_idx_b, X):
+                nnz_row, nnz_j, nnz_ok = _project_nnz(
+                    sub_b, ent_of_act[mask], projectors)
+                X[loc[nnz_row[nnz_ok]], slots[nnz_row[nnz_ok]],
+                  nnz_j[nnz_ok]] = sub_b.data[nnz_ok]
+        elif random_projector is not None:
+            X[loc, slots] = (sub_b @ random_projector.matrix).astype(
+                np.float32)
+        else:
+            X[loc, slots] = _densify_chunked(sub_b)
+
+        buckets.append(EntityBucket(
+            entity_start=start, num_real=nr,
+            X=jnp.asarray(X, dtype),
+            labels=jnp.asarray(labels),
+            base_offsets=jnp.asarray(offsets),
+            weights=jnp.asarray(weights),
+            row_ids=jnp.asarray(row_ids),
+        ))
+    return buckets
+
+
 def build_random_effect_dataset(
     data: GameDataset,
     config: RandomEffectDataConfiguration,
@@ -529,6 +710,7 @@ def build_random_effect_dataset(
     pad_rows_multiple: int = 8,
     dtype=jnp.float32,
     entity_axis_size: int = 1,
+    num_buckets: int = 1,
 ) -> RandomEffectDataset:
     """Group rows per entity, cap/split, project, pad into device blocks.
 
@@ -536,6 +718,13 @@ def build_random_effect_dataset(
     multiple so the blocks shard evenly; entities are pre-permuted by the
     greedy load balancer (balanced_entity_order) so contiguous shards carry
     similar sample mass.
+
+    ``num_buckets > 1`` activates (N, D) size bucketing (SURVEY §7 hard
+    part 1): entities are grouped by active-row count into at most that
+    many buckets, each padded only to its own (N_b, D_b) — see
+    EntityBucket. Entity order becomes bucket-major (balanced within each
+    bucket) and the returned dataset carries ``buckets`` instead of one
+    global block.
     """
     id_type = config.random_effect_type
     if id_type not in data.id_columns:
@@ -574,8 +763,27 @@ def build_random_effect_dataset(
                           else pas_counts >= lo)
     passive_mask = ~active_mask & keep_passive_group[grp_of_sorted]
 
-    # --- load-balanced entity ordering for contiguous sharding.
-    perm = balanced_entity_order(act_counts, num_bins=max(1, entity_axis_size))
+    # --- load-balanced entity ordering for contiguous sharding. With
+    # bucketing the order is bucket-major (balanced within each bucket:
+    # members are within one padding quantum of each other, so contiguous
+    # entity-axis shards stay balanced).
+    bucket_sizes = bucket_n_max = None
+    if num_buckets > 1 and e_real > 1:
+        bucket_n_max, bucket_of = _bucket_plan(
+            act_counts, num_buckets, pad_rows_multiple)
+        parts = []
+        for b in range(len(bucket_n_max)):
+            idx = np.flatnonzero(bucket_of == b)
+            parts.append(idx[balanced_entity_order(
+                act_counts[idx], num_bins=max(1, entity_axis_size))])
+        kept = [(n, p) for n, p in zip(bucket_n_max, parts) if len(p)]
+        bucket_n_max = np.array([n for n, _ in kept], dtype=np.int64)
+        parts = [p for _, p in kept]
+        perm = np.concatenate(parts)
+        bucket_sizes = np.array([len(p) for p in parts], dtype=np.int64)
+    else:
+        perm = balanced_entity_order(act_counts,
+                                     num_bins=max(1, entity_axis_size))
     ent_codes = uniq[perm].astype(np.int64)
     inv_perm = np.empty(e_real, dtype=np.int64)
     inv_perm[perm] = np.arange(e_real)
@@ -602,37 +810,55 @@ def build_random_effect_dataset(
     else:  # IDENTITY
         d_red = raw_dim
 
-    # --- pad E to the entity axis and N to a stable multiple.
-    e_pad = max(1, -(-max(e_real, 1) // entity_axis_size) * entity_axis_size)
-    n_max = int(counts.max()) if e_real else 1
-    n_max = max(1, -(-n_max // pad_rows_multiple) * pad_rows_multiple)
+    act_weights = (data.weights[rows_act]
+                   * group_scale[grp_of_sorted[active_mask]])
 
-    X = np.zeros((e_pad, n_max, d_red), dtype=np.float32)
-    labels = np.zeros((e_pad, n_max), dtype=np.float32)
-    offsets = np.zeros((e_pad, n_max), dtype=np.float32)
-    weights = np.zeros((e_pad, n_max), dtype=np.float32)
-    row_ids = np.full((e_pad, n_max), n, dtype=np.int32)
-
-    labels[ent_of_act, slot_of_act] = data.responses[rows_act]
-    offsets[ent_of_act, slot_of_act] = data.offsets[rows_act]
-    weights[ent_of_act, slot_of_act] = (
-        data.weights[rows_act] * group_scale[grp_of_sorted[active_mask]])
-    row_ids[ent_of_act, slot_of_act] = rows_act
-
-    if projectors is not None:
-        # Native single-pass pack (no nnz-length temporaries); numpy
-        # searchsorted formulation as fallback.
-        if not pack_projected_rows_native(
-                sub, ent_of_act, ent_of_act * n_max + slot_of_act,
-                projectors.raw_indices, X):
-            nnz_row, nnz_j, nnz_ok = _project_nnz(sub, ent_of_act, projectors)
-            X[ent_of_act[nnz_row[nnz_ok]], slot_of_act[nnz_row[nnz_ok]],
-              nnz_j[nnz_ok]] = sub.data[nnz_ok]
-    elif random_projector is not None:
-        X[ent_of_act, slot_of_act] = (
-            sub @ random_projector.matrix).astype(np.float32)
+    if bucket_sizes is not None:
+        buckets = _pack_entity_buckets(
+            sub, ent_of_act, slot_of_act,
+            act_labels=data.responses[rows_act],
+            act_offsets=data.offsets[rows_act],
+            act_weights=act_weights,
+            rows_act=rows_act, n_samples=n,
+            bucket_sizes=bucket_sizes, bucket_n_max=bucket_n_max,
+            entity_axis_size=entity_axis_size,
+            projectors=projectors, random_projector=random_projector,
+            d_red=d_red, dtype=dtype)
+        X = None
     else:
-        X[ent_of_act, slot_of_act] = _densify_chunked(sub)
+        buckets = None
+        # --- pad E to the entity axis and N to a stable multiple.
+        e_pad = max(1,
+                    -(-max(e_real, 1) // entity_axis_size) * entity_axis_size)
+        n_max = int(counts.max()) if e_real else 1
+        n_max = max(1, -(-n_max // pad_rows_multiple) * pad_rows_multiple)
+
+        X = np.zeros((e_pad, n_max, d_red), dtype=np.float32)
+        labels = np.zeros((e_pad, n_max), dtype=np.float32)
+        offsets = np.zeros((e_pad, n_max), dtype=np.float32)
+        weights = np.zeros((e_pad, n_max), dtype=np.float32)
+        row_ids = np.full((e_pad, n_max), n, dtype=np.int32)
+
+        labels[ent_of_act, slot_of_act] = data.responses[rows_act]
+        offsets[ent_of_act, slot_of_act] = data.offsets[rows_act]
+        weights[ent_of_act, slot_of_act] = act_weights
+        row_ids[ent_of_act, slot_of_act] = rows_act
+
+        if projectors is not None:
+            # Native single-pass pack (no nnz-length temporaries); numpy
+            # searchsorted formulation as fallback.
+            if not pack_projected_rows_native(
+                    sub, ent_of_act, ent_of_act * n_max + slot_of_act,
+                    projectors.raw_indices, X):
+                nnz_row, nnz_j, nnz_ok = _project_nnz(sub, ent_of_act,
+                                                      projectors)
+                X[ent_of_act[nnz_row[nnz_ok]], slot_of_act[nnz_row[nnz_ok]],
+                  nnz_j[nnz_ok]] = sub.data[nnz_ok]
+        elif random_projector is not None:
+            X[ent_of_act, slot_of_act] = (
+                sub @ random_projector.matrix).astype(np.float32)
+        else:
+            X[ent_of_act, slot_of_act] = _densify_chunked(sub)
 
     # --- passive side (sample-major, already projected per entity).
     p_X = p_ent = p_rows = p_off = None
@@ -662,11 +888,11 @@ def build_random_effect_dataset(
     return RandomEffectDataset(
         config=config,
         entity_codes=ent_codes,
-        X=jnp.asarray(X, dtype),
-        labels=jnp.asarray(labels),
-        base_offsets=jnp.asarray(offsets),
-        weights=jnp.asarray(weights),
-        row_ids=jnp.asarray(row_ids),
+        X=None if buckets is not None else jnp.asarray(X, dtype),
+        labels=None if buckets is not None else jnp.asarray(labels),
+        base_offsets=None if buckets is not None else jnp.asarray(offsets),
+        weights=None if buckets is not None else jnp.asarray(weights),
+        row_ids=None if buckets is not None else jnp.asarray(row_ids),
         num_samples=n,
         projectors=projectors,
         random_projector=random_projector,
@@ -674,4 +900,6 @@ def build_random_effect_dataset(
         passive_entity=p_ent,
         passive_row_ids=p_rows,
         passive_offsets=p_off,
+        buckets=buckets,
+        _reduced_dim=d_red if buckets is not None else None,
     )

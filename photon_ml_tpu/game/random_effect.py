@@ -134,9 +134,6 @@ class RandomEffectOptimizationProblem:
         is rejected (OptimizerFactory.scala:78-79).
         """
         cfg = self.config
-        e, _, d = dataset.X.shape
-        acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
-        x0 = solver_x0(acc, (e, d), initial)
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         if cfg.optimizer_type == OptimizerType.TRON:
             if self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
@@ -149,10 +146,48 @@ class RandomEffectOptimizationProblem:
             solver = "owlqn"
         else:
             solver = "lbfgs"
+
+        if dataset.buckets is not None:
+            return self._run_bucketed(dataset, offsets, initial, solver, l1)
+
+        e, _, d = dataset.X.shape
+        acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
+        x0 = solver_x0(acc, (e, d), initial)
         coefs, iters, values = _fit_blocks(
             dataset.X, dataset.labels, offsets, dataset.weights, x0,
             self.objective(), jnp.full(d, l1, x0.dtype),
             solver, cfg.max_iterations, float(cfg.tolerance))
+        return coefs, iters, values
+
+    def _run_bucketed(self, dataset, offsets, initial, solver: str,
+                      l1: float):
+        """Per-bucket vmapped solves assembled into one compact global
+        block ``[num_entities, reduced_dim]`` (entity order is bucket-major;
+        pad lanes never leave the bucket)."""
+        cfg = self.config
+        e_tot, d_red = dataset.num_entities, dataset.reduced_dim
+        acc = jnp.promote_types(dataset.buckets[0].X.dtype, jnp.float32)
+        obj = self.objective()
+        coefs = jnp.zeros((e_tot, d_red), acc)
+        iters = jnp.zeros(e_tot, jnp.int32)
+        values = jnp.zeros(e_tot, acc)
+        for bucket, off_b in zip(dataset.buckets, offsets):
+            e_b, _, d_b = bucket.X.shape
+            nr, start = bucket.num_real, bucket.entity_start
+            # solver state policy: blocks are f32, solver state >= f32
+            # (optimize/common.solver_x0); offsets join at the same dtype
+            off_b = jnp.asarray(off_b, acc)
+            x0_b = jnp.zeros((e_b, d_b), acc)
+            if initial is not None:
+                x0_b = x0_b.at[:nr].set(
+                    jnp.asarray(initial, acc)[start:start + nr, :d_b])
+            c_b, it_b, v_b = _fit_blocks(
+                bucket.X, bucket.labels, off_b, bucket.weights, x0_b,
+                obj, jnp.full(d_b, l1, acc),
+                solver, cfg.max_iterations, float(cfg.tolerance))
+            coefs = coefs.at[start:start + nr, :d_b].set(c_b[:nr])
+            iters = iters.at[start:start + nr].set(it_b[:nr])
+            values = values.at[start:start + nr].set(v_b[:nr])
         return coefs, iters, values
 
     def regularization_value(self, coefs: Array) -> float:
@@ -203,9 +238,23 @@ def score_passive(passive_X: Array, passive_entity: Array, coefs: Array,
 
 
 def score_random_effect(dataset: RandomEffectDataset, coefs: Array) -> Array:
-    """Full sample-axis score vector (active + passive) for this coordinate."""
-    s = score_active(dataset.X, coefs, dataset.row_ids, dataset.weights,
-                     dataset.num_samples)
+    """Full sample-axis score vector (active + passive) for this coordinate.
+
+    ``coefs`` is the compact global block ``[num_entities, reduced_dim]``;
+    bucketed datasets score per bucket (row sets are disjoint, so the
+    per-bucket scatters sum without overlap)."""
+    if dataset.buckets is not None:
+        s = jnp.zeros(dataset.num_samples, jnp.float32)
+        for bucket in dataset.buckets:
+            e_b, _, d_b = bucket.X.shape
+            nr, start = bucket.num_real, bucket.entity_start
+            c_b = jnp.zeros((e_b, d_b), coefs.dtype)
+            c_b = c_b.at[:nr].set(coefs[start:start + nr, :d_b])
+            s = s + score_active(bucket.X, c_b, bucket.row_ids,
+                                 bucket.weights, dataset.num_samples)
+    else:
+        s = score_active(dataset.X, coefs, dataset.row_ids, dataset.weights,
+                         dataset.num_samples)
     if dataset.num_passive:
         s = s + score_passive(dataset.passive_X, dataset.passive_entity,
                               coefs, dataset.passive_row_ids,

@@ -183,6 +183,155 @@ class TestRandomEffectDataset:
         assert abs(loads[0] - loads[1]) <= 52  # near-balanced
 
 
+class TestEntityBucketing:
+    """(N, D) size bucketing of entity blocks (SURVEY §7 hard part 1;
+    reference analog: exactly-sized per-entity LocalDataSets,
+    data/LocalDataSet.scala:34-155)."""
+
+    @staticmethod
+    def _skewed_data(rng, d_entity=6, n_entities=24):
+        # zipf-ish entity sizes: one giant, a few medium, many tiny
+        sizes = np.maximum(1, (400 / np.arange(1, n_entities + 1) ** 1.3)
+                           .astype(int))
+        users = rng.permutation(np.repeat(np.arange(n_entities), sizes))
+        n = len(users)
+        Xe = rng.normal(size=(n, d_entity))
+        W = rng.normal(size=(n_entities, d_entity))
+        y = np.einsum("nd,nd->n", Xe, W[users]) + 0.01 * rng.normal(size=n)
+        data = GameDataset(responses=y,
+                           feature_shards={"s": sp.csr_matrix(Xe)})
+        data.encode_ids("u", users)
+        return data, W, users
+
+    def test_bucket_plan_minimizes_padded_area(self):
+        from photon_ml_tpu.game.dataset import _bucket_plan
+
+        counts = np.array([100] + [3] * 30)
+        n_max, bucket_of = _bucket_plan(counts, num_buckets=2, multiple=8)
+        assert list(n_max) == [104, 8]
+        assert bucket_of[0] == 0 and (bucket_of[1:] == 1).all()
+        # bucketed area far below the single-block padding
+        area = sum(int(n_max[b]) * (bucket_of == b).sum()
+                   for b in range(len(n_max)))
+        assert area == 104 + 30 * 8 < 31 * 104
+
+    def test_bucketed_build_covers_every_sample(self, rng):
+        data, _, users = self._skewed_data(rng)
+        cfg = RandomEffectDataConfiguration("u", "s", 1)
+        ds = build_random_effect_dataset(data, cfg, num_buckets=3)
+        assert ds.buckets is not None and 1 < len(ds.buckets) <= 3
+        ids = np.concatenate(
+            [np.asarray(b.row_ids).ravel() for b in ds.buckets])
+        real = ids[ids < data.num_samples]
+        assert sorted(real.tolist()) == list(range(data.num_samples))
+        # shrinking bucket shapes and a real padding win
+        single = build_random_effect_dataset(data, cfg)
+        area_bucketed = sum(int(np.prod(b.X.shape[:2])) for b in ds.buckets)
+        area_single = int(np.prod(np.asarray(single.X).shape[:2]))
+        assert area_bucketed < area_single
+        assert ds.num_entities == len(ds.entity_codes)
+
+    def test_bucketed_solve_matches_single_block(self, rng):
+        data, W, users = self._skewed_data(rng)
+        cfg = RandomEffectDataConfiguration("u", "s", 1)
+        prob = RandomEffectOptimizationProblem(
+            config=l2_config(lam=1e-3), task=TaskType.LINEAR_REGRESSION)
+
+        single = build_random_effect_dataset(data, cfg)
+        c1, *_ = prob.run(single, single.base_offsets)
+        bucketed = build_random_effect_dataset(data, cfg, num_buckets=3)
+        c2, *_ = prob.run(bucketed, bucketed.offsets_with(
+            jnp.zeros(data.num_samples)))
+
+        # entity order differs (bucket-major); compare per entity code
+        # after scattering each build's reduced space back to raw columns
+        raw1 = single.projectors.scatter_coefficients(np.asarray(c1)).dense()
+        raw2 = bucketed.projectors.scatter_coefficients(
+            np.asarray(c2)).dense()
+        row1 = {int(c): i for i, c in enumerate(single.entity_codes)}
+        for i, code in enumerate(bucketed.entity_codes):
+            np.testing.assert_allclose(raw2[i], raw1[row1[int(code)]],
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_bucketed_scoring_matches_single_block(self, rng):
+        data, W, users = self._skewed_data(rng)
+        cfg = RandomEffectDataConfiguration("u", "s", 1)
+        prob = RandomEffectOptimizationProblem(
+            config=l2_config(lam=1e-3), task=TaskType.LINEAR_REGRESSION)
+        single = build_random_effect_dataset(data, cfg)
+        c1, *_ = prob.run(single, single.base_offsets)
+        s1 = score_random_effect(single, c1)
+        bucketed = build_random_effect_dataset(data, cfg, num_buckets=3)
+        c2, *_ = prob.run(bucketed, bucketed.offsets_with(
+            jnp.zeros(data.num_samples)))
+        s2 = score_random_effect(bucketed, c2)
+        np.testing.assert_allclose(np.asarray(s2), np.asarray(s1),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_bucketed_cd_matches_single_block(self, rng):
+        """Full coordinate descent (fixed + bucketed RE) reaches the same
+        objective as the single-block build."""
+        data, *_ = make_game_data(rng, n=500, n_entities=16)
+        # skew the entity sizes so bucketing has something to do
+        fe_cfg = l2_config(lam=0.1, max_iter=15)
+        re_cfg = l2_config(lam=0.5, max_iter=15)
+
+        def run(num_buckets):
+            fe_ds = build_fixed_effect_dataset(data, "global")
+            fixed = FixedEffectCoordinate(
+                dataset=fe_ds,
+                problem=GLMOptimizationProblem(
+                    config=fe_cfg, task=TaskType.LOGISTIC_REGRESSION))
+            re_ds = build_random_effect_dataset(
+                data, RandomEffectDataConfiguration(
+                    "userId", "per_user", 1), num_buckets=num_buckets)
+            rand = RandomEffectCoordinate(
+                dataset=re_ds,
+                problem=RandomEffectOptimizationProblem(
+                    config=re_cfg, task=TaskType.LOGISTIC_REGRESSION))
+            return run_coordinate_descent(
+                {"fixed": fixed, "perUser": rand}, 2,
+                TaskType.LOGISTIC_REGRESSION,
+                jnp.asarray(data.responses), jnp.asarray(data.weights),
+                jnp.asarray(data.offsets))
+
+        r1, r2 = run(1), run(4)
+        o1 = [s.objective for s in r1.states]
+        o2 = [s.objective for s in r2.states]
+        np.testing.assert_allclose(o2, o1, rtol=1e-4)
+
+    def test_bucketed_warm_start_roundtrip(self, rng):
+        """initial= warm start slices the compact global block correctly."""
+        data, _, users = self._skewed_data(rng)
+        cfg = RandomEffectDataConfiguration("u", "s", 1)
+        prob = RandomEffectOptimizationProblem(
+            config=l2_config(lam=1e-3, max_iter=40),
+            task=TaskType.LINEAR_REGRESSION)
+        ds = build_random_effect_dataset(data, cfg, num_buckets=3)
+        offs = ds.offsets_with(jnp.zeros(data.num_samples))
+        c1, *_ = prob.run(ds, offs)
+        # restarting AT the optimum must stay there (few extra iterations)
+        c2, iters, _ = prob.run(ds, offs, initial=c1)
+        np.testing.assert_allclose(np.asarray(c2), np.asarray(c1),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_factored_coordinate_rejects_buckets(self, rng):
+        data, *_ = self._skewed_data(rng)
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration(
+                "u", "s", 1,
+                projector=ProjectorConfig(ProjectorType.IDENTITY)),
+            num_buckets=3)
+        with pytest.raises(ValueError, match="single-block"):
+            FactoredRandomEffectCoordinate(
+                dataset=ds,
+                problem=RandomEffectOptimizationProblem(
+                    config=l2_config(), task=TaskType.LINEAR_REGRESSION),
+                latent_problem=GLMOptimizationProblem(
+                    config=l2_config(), task=TaskType.LINEAR_REGRESSION),
+                latent_dim=2)
+
+
 class TestRandomEffectSolver:
     def test_recovers_per_entity_coefficients(self, rng):
         # linear task, no global effect: RE solve should recover W_e
