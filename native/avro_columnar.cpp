@@ -82,7 +82,9 @@ struct Cursor {
     }
     const uint8_t* read_bytes(int64_t* len) {
         *len = read_long();
-        if (*len < 0 || p + *len > end) { ok = false; *len = 0; return p; }
+        // compare against the remaining span, never p + *len: a corrupt
+        // huge length would overflow the pointer (UB) and could pass
+        if (*len < 0 || *len > end - p) { ok = false; *len = 0; return p; }
         const uint8_t* s = p;
         p += *len;
         return s;

@@ -330,7 +330,9 @@ def load_labeled_points_avro(
             seen.add(j)
             rows.append(i)
             cols.append(j)
-            vals.append(float(f[VALUE]))
+            # a nullable numeric value decodes as 0.0, matching the native
+            # columnar path (reference schemas are non-null)
+            vals.append(0.0 if f[VALUE] is None else float(f[VALUE]))
         if intercept_idx is not None:
             rows.append(i)
             cols.append(intercept_idx)
@@ -798,7 +800,8 @@ def load_game_dataset_avro(
                     seen.add(j)
                     rows.append(i)
                     cols.append(j)
-                    vals.append(float(f[VALUE]))
+                    vals.append(
+                        0.0 if f[VALUE] is None else float(f[VALUE]))
             if intercepts[shard] is not None:
                 rows.append(i)
                 cols.append(intercepts[shard])

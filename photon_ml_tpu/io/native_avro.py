@@ -168,37 +168,61 @@ def _bind(lib) -> None:
 
 
 def _read_blocks(path: str) -> Optional[tuple]:
-    """Container header walk → (schema, concatenated block bytes, count)."""
+    """Container header walk → (schema, concatenated block bytes, count).
+
+    Any truncation (header metadata, block varints, payload) declines the
+    fast path with None; the interpreted reader raises the diagnostic."""
     with open(path, "rb") as fh:
         buf = fh.read()
     if buf[:4] != MAGIC:
         return None
     dec = BinaryDecoder(buf, 4)
     meta = {}
-    while True:
-        count = dec.read_long()
-        if count == 0:
-            break
-        if count < 0:
-            dec.read_long()
-            count = -count
-        for _ in range(count):
-            k = dec.read_string()
-            meta[k] = dec.read_bytes()
-    schema = parse_schema(meta["avro.schema"].decode())
+    try:
+        while True:
+            count = dec.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                dec.read_long()
+                count = -count
+            for _ in range(count):
+                k = dec.read_string()
+                meta[k] = dec.read_bytes()
+        schema = parse_schema(meta["avro.schema"].decode())
+    except (IndexError, KeyError):
+        return None
     codec = meta.get("avro.codec", b"null").decode()
     if codec not in ("null", "deflate"):
         return None
+    if dec.pos + SYNC_SIZE > len(buf):
+        return None
+    sync = buf[dec.pos:dec.pos + SYNC_SIZE]
     dec.pos += SYNC_SIZE
     chunks = []
     total = 0
     while dec.pos < len(buf):
-        count = dec.read_long()
-        size = dec.read_long()
+        try:
+            count = dec.read_long()
+            size = dec.read_long()
+        except IndexError:
+            # truncated mid-varint: decline the fast path
+            return None
+        # validate like the interpreted read_container: a truncated or
+        # corrupted file must fall back, not silently mis-decode
+        if count < 0 or size < 0 or dec.pos + size + SYNC_SIZE > len(buf):
+            return None
         data = buf[dec.pos:dec.pos + size]
+        if buf[dec.pos + size:dec.pos + size + SYNC_SIZE] != sync:
+            return None
         dec.pos += size + SYNC_SIZE
         if codec == "deflate":
-            data = zlib.decompress(data, -15)
+            try:
+                data = zlib.decompress(data, -15)
+            except zlib.error:
+                # corrupt payload: decline the fast path; the interpreted
+                # reader raises the real diagnostic
+                return None
         chunks.append(data)
         total += count
     return schema, b"".join(chunks), total
