@@ -470,7 +470,7 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     jax.block_until_ready(offs)
     gather_secs = time.perf_counter() - t0
     t0 = time.perf_counter()
-    coefs, _, _ = re_prob.run(re_ds, offs)
+    coefs, *_ = re_prob.run(re_ds, offs)
     jax.block_until_ready(coefs)
     solve_secs = time.perf_counter() - t0
     t0 = time.perf_counter()

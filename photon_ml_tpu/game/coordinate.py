@@ -76,15 +76,35 @@ class FixedEffectTracker:
 @dataclasses.dataclass
 class RandomEffectTracker:
     """optimization/game/RandomEffectOptimizationTracker analog: iteration
-    counts across entities."""
+    counts + per-entity convergence-reason counts across the vmapped
+    solves (countsByConvergence — the operator's only view into thousands
+    of per-entity fits)."""
 
     iterations: np.ndarray  # [E]
     final_values: np.ndarray  # [E]
+    convergence_codes: Optional[np.ndarray] = None  # [E] int8
+
+    def counts_by_convergence(self) -> dict[str, int]:
+        """reason name -> entity count
+        (RandomEffectOptimizationTracker.countsByConvergence)."""
+        from photon_ml_tpu.game.random_effect import CONVERGENCE_CODE_NAMES
+
+        if self.convergence_codes is None:
+            return {}
+        codes, counts = np.unique(self.convergence_codes,
+                                  return_counts=True)
+        return {CONVERGENCE_CODE_NAMES[int(c)]: int(n)
+                for c, n in zip(codes, counts)}
 
     def summary(self) -> str:
         it = self.iterations
-        return (f"random effect: {len(it)} entities, iterations "
+        base = (f"random effect: {len(it)} entities, iterations "
                 f"min/mean/max = {it.min()}/{it.mean():.1f}/{it.max()}")
+        counts = self.counts_by_convergence()
+        if counts:
+            base += ", convergence " + "/".join(
+                f"{k}={v}" for k, v in sorted(counts.items()))
+        return base
 
 
 @dataclasses.dataclass
@@ -179,9 +199,14 @@ class RandomEffectCoordinate:
     def update(self, coefs: Optional[Array], extra_scores: Array
                ) -> tuple[Array, Tracker]:
         offsets = self.dataset.offsets_with(extra_scores)
-        new_coefs, iters, values = self.problem.run(
+        new_coefs, iters, values, codes = self.problem.run(
             self.dataset, offsets, initial=coefs)
-        tracker = RandomEffectTracker(np.asarray(iters), np.asarray(values))
+        # report only real entities: the single-block path returns
+        # entity-axis PAD lanes too (the bucketed path is already compact)
+        nr = len(self.dataset.entity_codes)
+        tracker = RandomEffectTracker(np.asarray(iters)[:nr],
+                                      np.asarray(values)[:nr],
+                                      np.asarray(codes)[:nr])
         return new_coefs, tracker
 
     def score(self, coefs: Array) -> Array:
@@ -264,10 +289,12 @@ class FactoredRandomEffectCoordinate:
                                preferred_element_type=jnp.float32)
             lat_ds = dataclasses.replace(ds, X=X_lat, projectors=None,
                                          random_projector=None)
-            coefs, iters, values = self.problem.run(lat_ds, offsets,
-                                                    initial=coefs)
-            re_tracker = RandomEffectTracker(np.asarray(iters),
-                                             np.asarray(values))
+            coefs, iters, values, codes = self.problem.run(lat_ds, offsets,
+                                                           initial=coefs)
+            nr = len(ds.entity_codes)
+            re_tracker = RandomEffectTracker(np.asarray(iters)[:nr],
+                                             np.asarray(values)[:nr],
+                                             np.asarray(codes)[:nr])
             # (2) projection-matrix fit on Kronecker features c_e ⊗ x.
             e, n, d = ds.X.shape
             k = self.latent_dim

@@ -583,14 +583,14 @@ def _bucket_plan(counts: np.ndarray, num_buckets: int, multiple: int
     """
     counts = np.asarray(counts, dtype=np.int64)
     q = np.maximum(multiple, -(-counts // multiple) * multiple)
-    uniq = np.unique(q)[::-1]  # descending sizes
+    uniq, w = np.unique(q, return_counts=True)
+    uniq, w = uniq[::-1], w[::-1].astype(np.int64)  # descending sizes
     m = len(uniq)
     k = min(num_buckets, m)
     if k >= m:
         n_max = uniq
         bucket_of = np.searchsorted(-uniq, -q)
         return n_max, bucket_of
-    w = np.array([(q == u).sum() for u in uniq], dtype=np.int64)
     prefix = np.concatenate([[0], np.cumsum(w)])
     inf = np.iinfo(np.int64).max // 4
     # f[j, t] = min padded area covering the j largest sizes with t buckets

@@ -47,6 +47,19 @@ from photon_ml_tpu.optimize.tron import minimize_tron
 
 Array = jnp.ndarray
 
+# Per-entity convergence codes (RandomEffectOptimizationTracker.
+# countsByConvergence analog; names match ConvergenceReason values).
+CONV_MAX_ITERATIONS = 0
+CONV_FUNCTION_VALUES = 1
+CONV_GRADIENT = 2
+CONV_NOT_PROGRESSED = 3
+CONVERGENCE_CODE_NAMES = {
+    CONV_MAX_ITERATIONS: "MaxIterations",
+    CONV_FUNCTION_VALUES: "FunctionValuesConverged",
+    CONV_GRADIENT: "GradientConverged",
+    CONV_NOT_PROGRESSED: "ObjectiveNotImproving",
+}
+
 
 def _vg(w, payload):
     obj, batch = payload
@@ -88,8 +101,21 @@ def _fit_blocks(
             x, hist, _ = minimize_lbfgs(
                 _vg, x0, (obj, batch),
                 max_iter=max_iter, tolerance=tolerance)
-        final_value = hist.values[hist.num_iterations]
-        return x, hist.num_iterations, final_value
+        k = hist.num_iterations
+        final_value = hist.values[k]
+        # Per-lane convergence classification (the device-side half of
+        # Optimizer.getConvergenceReason, Optimizer.scala:156-170):
+        # 0 = max-iterations, 1 = function values, 2 = gradient,
+        # 3 = stopped without tripping a criterion (not progressed).
+        fv = (k >= 1) & (
+            jnp.abs(final_value - hist.values[jnp.maximum(k - 1, 0)])
+            <= tolerance * jnp.abs(hist.values[0]))
+        gv = hist.grad_norms[k] <= tolerance * hist.grad_norms[0]
+        code = jnp.where(k >= max_iter, CONV_MAX_ITERATIONS,
+                         jnp.where(fv, CONV_FUNCTION_VALUES,
+                                   jnp.where(gv, CONV_GRADIENT,
+                                             CONV_NOT_PROGRESSED)))
+        return x, k, final_value, code.astype(jnp.int8)
 
     return jax.vmap(solve_one)(X, labels, offsets, weights, initial)
 
@@ -121,9 +147,9 @@ class RandomEffectOptimizationProblem:
         dataset: RandomEffectDataset,
         offsets: Array,
         initial: Optional[Array] = None,
-    ) -> tuple[Array, Array, Array]:
+    ) -> tuple[Array, Array, Array, Array]:
         """Fit all entities; returns (coefficients [E, D_red], iterations [E],
-        final losses [E]).
+        final losses [E], convergence codes [E] — CONVERGENCE_CODE_NAMES).
 
         ``offsets`` is the entity-major offset block (base offsets + other
         coordinates' scores). All three solvers run batched under ``vmap``:
@@ -153,11 +179,14 @@ class RandomEffectOptimizationProblem:
         e, _, d = dataset.X.shape
         acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
         x0 = solver_x0(acc, (e, d), initial)
-        coefs, iters, values = _fit_blocks(
+        # solver state policy: blocks are f32, solver state >= f32; a
+        # wider offset vector (e.g. f64 scores) must not poison the
+        # jitted solver's carry dtypes
+        offsets = jnp.asarray(offsets, acc)
+        return _fit_blocks(
             dataset.X, dataset.labels, offsets, dataset.weights, x0,
             self.objective(), jnp.full(d, l1, x0.dtype),
             solver, cfg.max_iterations, float(cfg.tolerance))
-        return coefs, iters, values
 
     def _run_bucketed(self, dataset, offsets, initial, solver: str,
                       l1: float):
@@ -171,6 +200,7 @@ class RandomEffectOptimizationProblem:
         coefs = jnp.zeros((e_tot, d_red), acc)
         iters = jnp.zeros(e_tot, jnp.int32)
         values = jnp.zeros(e_tot, acc)
+        codes = jnp.zeros(e_tot, jnp.int8)
         for bucket, off_b in zip(dataset.buckets, offsets):
             e_b, _, d_b = bucket.X.shape
             nr, start = bucket.num_real, bucket.entity_start
@@ -181,14 +211,15 @@ class RandomEffectOptimizationProblem:
             if initial is not None:
                 x0_b = x0_b.at[:nr].set(
                     jnp.asarray(initial, acc)[start:start + nr, :d_b])
-            c_b, it_b, v_b = _fit_blocks(
+            c_b, it_b, v_b, k_b = _fit_blocks(
                 bucket.X, bucket.labels, off_b, bucket.weights, x0_b,
                 obj, jnp.full(d_b, l1, acc),
                 solver, cfg.max_iterations, float(cfg.tolerance))
             coefs = coefs.at[start:start + nr, :d_b].set(c_b[:nr])
             iters = iters.at[start:start + nr].set(it_b[:nr])
             values = values.at[start:start + nr].set(v_b[:nr])
-        return coefs, iters, values
+            codes = codes.at[start:start + nr].set(k_b[:nr])
+        return coefs, iters, values, codes
 
     def regularization_value(self, coefs: Array) -> float:
         """Σ over entities of the per-entity penalty

@@ -311,7 +311,7 @@ class TestEntityBucketing:
         offs = ds.offsets_with(jnp.zeros(data.num_samples))
         c1, *_ = prob.run(ds, offs)
         # restarting AT the optimum must stay there (few extra iterations)
-        c2, iters, _ = prob.run(ds, offs, initial=c1)
+        c2, iters, _, _ = prob.run(ds, offs, initial=c1)
         np.testing.assert_allclose(np.asarray(c2), np.asarray(c1),
                                    rtol=1e-3, atol=1e-4)
 
@@ -348,7 +348,7 @@ class TestRandomEffectSolver:
             data, RandomEffectDataConfiguration("u", "s", 1))
         prob = RandomEffectOptimizationProblem(
             config=l2_config(lam=1e-4), task=TaskType.LINEAR_REGRESSION)
-        coefs, iters, values = prob.run(ds, ds.base_offsets)
+        coefs, iters, values, codes = prob.run(ds, ds.base_offsets)
         # scatter back to raw space and compare per entity
         raw = ds.projectors.scatter_coefficients(np.asarray(coefs)).dense()
         for e_i, code in enumerate(ds.entity_codes):
@@ -373,6 +373,35 @@ class TestRandomEffectSolver:
         np.testing.assert_allclose(np.asarray(s), expected, rtol=1e-4,
                                    atol=1e-5)
 
+    def test_convergence_counts_by_reason(self, rng):
+        """Per-entity convergence-reason counts surface through the tracker
+        (RandomEffectOptimizationTracker.countsByConvergence analog)."""
+        data, *_ = make_game_data(rng, n=300, n_entities=8)
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration("userId", "per_user", 1))
+
+        def fit(max_iter):
+            coord = RandomEffectCoordinate(
+                dataset=ds,
+                problem=RandomEffectOptimizationProblem(
+                    config=l2_config(lam=0.5, max_iter=max_iter),
+                    task=TaskType.LOGISTIC_REGRESSION))
+            _, tracker = coord.update(None, jnp.zeros(data.num_samples))
+            return tracker
+
+        starved = fit(1).counts_by_convergence()
+        assert sum(starved.values()) == ds.num_entities
+        assert starved.get("MaxIterations", 0) >= ds.num_entities - 1
+
+        generous = fit(200)
+        counts = generous.counts_by_convergence()
+        assert sum(counts.values()) == ds.num_entities
+        assert counts.get("MaxIterations", 0) == 0
+        assert set(counts) <= {"FunctionValuesConverged",
+                               "GradientConverged",
+                               "ObjectiveNotImproving"}
+        assert "convergence" in generous.summary()
+
     def test_tron_matches_lbfgs_per_entity(self, rng):
         # Per-entity TRON (TRON.scala:84-341 under vmap) must land on the
         # same per-entity optima as L-BFGS, mirroring the reference's
@@ -390,10 +419,10 @@ class TestRandomEffectSolver:
                     RegularizationType.L2))
 
         task = TaskType.LOGISTIC_REGRESSION
-        c_tron, it_tron, v_tron = RandomEffectOptimizationProblem(
+        c_tron, it_tron, v_tron, _ = RandomEffectOptimizationProblem(
             config=cfg(OptimizerType.TRON), task=task).run(
                 ds, ds.base_offsets)
-        c_lbfgs, _, v_lbfgs = RandomEffectOptimizationProblem(
+        c_lbfgs, _, v_lbfgs, _ = RandomEffectOptimizationProblem(
             config=cfg(OptimizerType.LBFGS), task=task).run(
                 ds, ds.base_offsets)
         assert int(np.min(np.asarray(it_tron))) > 0  # TRON actually iterated
