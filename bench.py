@@ -667,12 +667,45 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     ds = build_random_effect_dataset(data, cfg, entity_axis_size=8)
     re_secs = time.perf_counter() - t0
     del ell
+    import resource
+
+    # peak RSS of THIS process; meaningful when the bench runs isolated in
+    # a subprocess (main() does that), where ingestion dominates the peak
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     return {
         "rows": n,
         "ell_pack_rows_per_sec": round(n / ell_secs, 0),
         "re_build_rows_per_sec": round(n / re_secs, 0),
         "re_block": [int(s) for s in ds.X.shape],
+        "peak_rss_mb": round(peak_kb / 1024.0, 1),
     }
+
+
+def _bench_ingest_isolated() -> dict:
+    """Run bench_ingest in a fresh subprocess so its peak-RSS record
+    reflects ingestion alone (the parent holds earlier benches' arrays);
+    falls back to in-process on any subprocess failure."""
+    import subprocess
+
+    # pin the platform before first backend use: a site import hook may
+    # override JAX_PLATFORMS and hang on a wedged accelerator tunnel
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import json, bench; "
+            "print(json.dumps(bench.bench_ingest()))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        _progress(f"isolated ingest bench rc={proc.returncode}; "
+                  "running in-process")
+    except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
+        _progress(f"isolated ingest bench failed ({e!r}); "
+                  "running in-process")
+    return bench_ingest()
 
 
 def _ensure_live_backend(timeout_secs: int = 240, attempts: int = 2,
@@ -746,7 +779,7 @@ def main():
     _progress("avro ingest bench")
     avro_ingest = bench_avro_ingest()
     _progress("ingest bench")
-    ingest = bench_ingest()
+    ingest = _bench_ingest_isolated()
     _progress("done")
 
     import jax

@@ -85,7 +85,8 @@ def _fit_blocks(
     tolerance: float,
 ):
     """vmapped solve over entity blocks; returns (coefs [E,D], iters [E],
-    final loss values [E]). ``solver`` is one of "lbfgs"/"owlqn"/"tron"."""
+    final loss values [E], convergence codes [E] int8 — see
+    CONVERGENCE_CODE_NAMES). ``solver`` is "lbfgs"/"owlqn"/"tron"."""
 
     def solve_one(Xe, ye, oe, we, x0):
         batch = DenseBatch(X=Xe, labels=ye, offsets=oe, weights=we)

@@ -152,6 +152,11 @@ class BinaryDecoder:
 
     def read_bytes(self) -> bytes:
         n = self.read_long()
+        if n < 0 or self.pos + n > len(self.buf):
+            # corrupt length: a negative n would move pos BACKWARD (an
+            # infinite-loop hazard for callers iterating the buffer)
+            raise ValueError(f"invalid byte-string length {n} at "
+                             f"position {self.pos}")
         v = self.buf[self.pos:self.pos + n]
         self.pos += n
         return v

@@ -122,24 +122,26 @@ def build_index_map_from_records(
     return IndexMap.from_keys(sorted(keys), add_intercept=add_intercept)
 
 
-def _columnar_parts(path: str):
-    """Per-part columnar reads for a file or part directory, or None when
-    any part can't take the native columnar path."""
-    from photon_ml_tpu.io.native_avro import read_columnar
-
+def _columnar_part_paths(path: str) -> list[str]:
+    """Part files of a file-or-directory input (same set as
+    read_directory)."""
     if os.path.isdir(path):
         from photon_ml_tpu.io.avro import list_avro_parts
 
-        paths = list_avro_parts(path)  # same file set as read_directory
-    else:
-        paths = [path]
-    out = []
+        return list_avro_parts(path)
+    return [path]
+
+
+def _iter_columnar_parts(paths):
+    """Yield per-part columnar reads ONE AT A TIME so ingestion memory is
+    bounded by the largest part, not the input (the reference streams
+    partitioned HDFS parts the same way, RandomEffectDataSet.scala:169-206).
+    Yields None when a part can't take the native path — the caller must
+    abandon the stream and fall back."""
+    from photon_ml_tpu.io.native_avro import read_columnar
+
     for p in paths:
-        r = read_columnar(p)
-        if r is None:
-            return None
-        out.append(r)
-    return out or None
+        yield read_columnar(p)
 
 
 def _feature_col_ok(col) -> bool:
@@ -199,12 +201,20 @@ def _columnar_labeled_points(
         index_map: Optional[IndexMap],
         selected: Optional[set],
         add_intercept: bool) -> Optional[LabeledData]:
-    """Vectorized assembly from native columnar reads; None → caller falls
-    back to the per-record interpreted path."""
-    parts = _columnar_parts(path)
-    if parts is None:
-        return None
-    for _, _, cols in parts:
+    """Vectorized assembly from native columnar reads, streamed part by
+    part (each part's columns are released before the next loads); None →
+    caller falls back to the per-record interpreted path."""
+    lab_parts, off_parts, wt_parts = [], [], []
+    all_rows, all_keyid, all_vals = [], [], []
+    key_tables = []
+    keys_before = 0
+    base = 0
+    got_any = False
+    for part in _iter_columnar_parts(_columnar_part_paths(path)):
+        if part is None:
+            return None
+        got_any = True
+        _, count, cols = part
         r = cols.get(field_names.response)
         if r is None or "values" not in r:
             return None
@@ -220,30 +230,30 @@ def _columnar_labeled_points(
                 # silent 0/1 defaults would be wrong; fall back
                 return None
 
-    n = sum(count for _, count, _ in parts)
-    labels = np.zeros(n)
-    offsets = np.zeros(n)
-    weights = np.ones(n)
-    all_rows, all_keyid, all_vals = [], [], []
-    key_tables = []
-    base = 0
-    for _, count, cols in parts:
-        labels[base:base + count] = cols[field_names.response]["values"]
+        lab_parts.append(np.asarray(r["values"], dtype=float))
         off = cols.get(field_names.offset)
-        if off is not None and "values" in off:
-            offsets[base:base + count] = off["values"]  # nulls decode as 0
+        off_parts.append(
+            np.asarray(off["values"], dtype=float)  # nulls decode as 0
+            if off is not None and "values" in off else np.zeros(count))
         wt = cols.get(field_names.weight)
-        if wt is not None and "values" in wt:
-            weights[base:base + count] = np.where(
-                wt["nulls"] == 1, 1.0, wt["values"])
+        wt_parts.append(
+            np.where(wt["nulls"] == 1, 1.0, wt["values"])
+            if wt is not None and "values" in wt else np.ones(count))
         rows, keyid, ukeys, values = _feature_triples(
             cols[field_names.features], base)
         all_rows.append(rows)
-        all_keyid.append(keyid + sum(len(t) for t in key_tables))
+        all_keyid.append(keyid + keys_before)
         all_vals.append(values)
         key_tables.append(ukeys)
+        keys_before += len(ukeys)
         base += count
+    if not got_any:
+        return None
 
+    n = base
+    labels = np.concatenate(lab_parts) if lab_parts else np.zeros(0)
+    offsets = np.concatenate(off_parts) if off_parts else np.zeros(0)
+    weights = np.concatenate(wt_parts) if wt_parts else np.ones(0)
     rows = np.concatenate(all_rows) if all_rows else np.zeros(0, np.int64)
     keyid = np.concatenate(all_keyid) if all_keyid else np.zeros(0, np.int64)
     vals = np.concatenate(all_vals) if all_vals else np.zeros(0)
@@ -554,20 +564,29 @@ def _columnar_game_dataset(
         id_types: Sequence[str],
         response_required: bool) -> Optional[GameDataset]:
     """Vectorized GAME assembly from native columnar reads (the 20M-row
-    ingestion path); None → interpreted fallback."""
+    ingestion path), streamed part by part so peak memory is bounded by
+    the largest part plus the assembled CSR (the reference streams
+    partitioned HDFS parts through executors the same way,
+    avro/data/DataProcessingUtils.scala per-partition map); None →
+    interpreted fallback. Per-part feature keys are mapped through the
+    index maps inside the stream, so string key tables never accumulate."""
+    from photon_ml_tpu.io.native_avro import OP_LONG as _OP_LONG
     from photon_ml_tpu.io.native_avro import arena_strings
 
-    all_parts = []
-    for p in paths:
-        parts = _columnar_parts(p)
-        if parts is None:
-            return None
-        all_parts.extend(parts)
-    if not all_parts:
-        return None
     sections_needed = sorted({s for secs in feature_shard_sections.values()
                               for s in secs})
-    for schema, _, cols in all_parts:
+    resp_parts, off_parts, wt_parts, uids_parts = [], [], [], []
+    have_uid = False
+    ids_parts: dict[str, list] = {t: [] for t in id_types}
+    # per shard: filtered (rows, cols, vals) triples, index-mapped per part
+    shard_acc: dict[str, list] = {s: [] for s in feature_shard_sections}
+    base = 0
+    part_files = [f for p in paths for f in _columnar_part_paths(p)]
+    for part in _iter_columnar_parts(part_files):
+        if part is None:
+            return None
+        schema, count, cols = part
+        # --- structural validation (fall back on any mismatch) ---------
         field_types = {f["name"]: f["type"]
                        for f in (schema.get("fields", [])
                                  if isinstance(schema, dict) else [])}
@@ -589,7 +608,6 @@ def _columnar_game_dataset(
         # top-level id fields: strings, or integer columns (str(int)
         # matches the interpreted path's str(v) exactly); float ids keep
         # the interpreted path
-        from photon_ml_tpu.io.native_avro import OP_LONG as _OP_LONG
         for t in id_types:
             c = cols.get(t)
             if (c is not None and "arena" not in c
@@ -599,17 +617,7 @@ def _columnar_game_dataset(
                                   or "values" not in cols[RESPONSE]):
             return None
 
-    n = sum(c for _, c, _ in all_parts)
-    responses = np.full(n, np.nan)
-    offsets = np.zeros(n)
-    weights = np.ones(n)
-    uids_parts = []
-    have_uid = False
-    ids_obj = {t: np.full(n, None, dtype=object) for t in id_types}
-
-    shard_acc: dict[str, list] = {s: [] for s in feature_shard_sections}
-    base = 0
-    for _, count, cols in all_parts:
+        # --- consume this part -----------------------------------------
         r = cols.get(RESPONSE)
         if r is not None and "values" in r:
             vals = r["values"].copy()
@@ -619,16 +627,19 @@ def _columnar_game_dataset(
                     f"record {base + int(np.argmax(null_mask))} has no "
                     f"response field")
             vals[null_mask] = np.nan
-            responses[base:base + count] = vals
+            resp_parts.append(np.asarray(vals, dtype=float))
         elif response_required:
             raise ValueError(f"record {base} has no response field")
+        else:
+            resp_parts.append(np.full(count, np.nan))
         off = cols.get(OFFSET)
-        if off is not None and "values" in off:
-            offsets[base:base + count] = off["values"]
+        off_parts.append(np.asarray(off["values"], dtype=float)
+                         if off is not None and "values" in off
+                         else np.zeros(count))
         wt = cols.get(WEIGHT)
-        if wt is not None and "values" in wt:
-            weights[base:base + count] = np.where(
-                wt["nulls"] == 1, 1.0, wt["values"])
+        wt_parts.append(np.where(wt["nulls"] == 1, 1.0, wt["values"])
+                        if wt is not None and "values" in wt
+                        else np.ones(count))
         u = cols.get(UID)
         if u is not None and "arena" in u:
             s = arena_strings(u["arena"], u["offsets"], dedup=False)
@@ -639,6 +650,8 @@ def _columnar_game_dataset(
         else:
             uids_parts.append(np.full(count, "", dtype=object))
 
+        ids_local = {t: np.full(count, None, dtype=object)
+                     for t in id_types}
         for t in id_types:
             c = cols.get(t)
             if c is None:
@@ -646,18 +659,18 @@ def _columnar_game_dataset(
             if "arena" in c:
                 s = arena_strings(c["arena"], c["offsets"])
                 ok = (c["nulls"] == 0) & (s != "")
-                ids_obj[t][base:base + count][ok] = s[ok]
+                ids_local[t][ok] = s[ok]
             elif "values" in c:
                 iv = c["values"].astype(np.int64)
                 uniq, inv = np.unique(iv, return_inverse=True)
                 s = np.asarray([str(int(u)) for u in uniq],
                                dtype=object)[inv]
                 ok = c["nulls"] == 0
-                ids_obj[t][base:base + count][ok] = s[ok]
+                ids_local[t][ok] = s[ok]
         m = cols.get(META_DATA_MAP)
         if m is not None and "key_codes" in m:
             pair_rows = np.repeat(
-                np.arange(count, dtype=np.int64) + base, m["lengths"])
+                np.arange(count, dtype=np.int64), m["lengths"])
             key_uniq = m["key_uniq"]
             for t in id_types:
                 matches = np.flatnonzero(key_uniq == t)
@@ -668,17 +681,33 @@ def _columnar_game_dataset(
                     rows_t = pair_rows[hit]
                     vals_t = m["val_uniq"][m["val_codes"][hit]]
                     still = np.asarray(
-                        [ids_obj[t][rr] is None for rr in rows_t])
+                        [ids_local[t][rr] is None for rr in rows_t])
                     # later map entries win like dict construction did
-                    ids_obj[t][rows_t[still]] = vals_t[still]
+                    ids_local[t][rows_t[still]] = vals_t[still]
+        for t in id_types:
+            ids_parts[t].append(ids_local[t])
 
         for shard, sections in feature_shard_sections.items():
+            imap = index_maps[shard]
             for sec in sections:
                 rows, keyid, ukeys, values = _feature_triples(
                     cols[sec], base)
-                shard_acc[shard].append((rows, keyid, ukeys, values))
+                ucol = np.asarray([imap.index_of(k) for k in ukeys],
+                                  np.int64)
+                c = ucol[keyid]
+                ok = c >= 0
+                shard_acc[shard].append((rows[ok], c[ok], values[ok]))
         base += count
+    if base == 0 and not part_files:
+        return None
 
+    n = base
+    responses = (np.concatenate(resp_parts) if resp_parts
+                 else np.full(0, np.nan))
+    offsets = np.concatenate(off_parts) if off_parts else np.zeros(0)
+    weights = np.concatenate(wt_parts) if wt_parts else np.ones(0)
+    ids_obj = {t: (np.concatenate(ids_parts[t]) if ids_parts[t]
+                   else np.zeros(0, dtype=object)) for t in id_types}
     for t in id_types:
         missing = np.asarray([v is None for v in ids_obj[t]])
         if missing.any():
@@ -689,19 +718,11 @@ def _columnar_game_dataset(
     shards = {}
     for shard, acc in shard_acc.items():
         imap = index_maps[shard]
-        rows_l, cols_l, vals_l = [], [], []
-        for rows, keyid, ukeys, values in acc:
-            ucol = np.asarray([imap.index_of(k) for k in ukeys], np.int64)
-            c = ucol[keyid]
-            ok = c >= 0
-            rows_l.append(rows[ok])
-            cols_l.append(c[ok])
-            vals_l.append(values[ok])
-        rows = (np.concatenate(rows_l) if rows_l
+        rows = (np.concatenate([a[0] for a in acc]) if acc
                 else np.zeros(0, np.int64))
-        cvec = (np.concatenate(cols_l) if cols_l
+        cvec = (np.concatenate([a[1] for a in acc]) if acc
                 else np.zeros(0, np.int64))
-        vals = np.concatenate(vals_l) if vals_l else np.zeros(0)
+        vals = np.concatenate([a[2] for a in acc]) if acc else np.zeros(0)
         d = len(imap)
         rc = rows * np.int64(d) + cvec
         if len(np.unique(rc)) != len(rc):

@@ -202,36 +202,67 @@ class OffHeapIndexMap:
     def build(items: Iterable[tuple[str, int]], directory: str,
               num_partitions: int = 1, namespace: str = "global"
               ) -> "OffHeapIndexMap":
+        """Single-pass spill build: every (key, index) is appended straight
+        to its hash partition's spill files, then each partition is sorted
+        and finalized alone — peak memory is O(largest partition), never
+        O(total keys). Construction matches serving's out-of-core bound
+        (the PalDB per-partition writer analog,
+        FeatureIndexingJob.buildIndexMap :145)."""
+        import struct
+
         os.makedirs(directory, exist_ok=True)
-        keys, indices = [], []
-        for k, v in items:
-            keys.append(k)
-            indices.append(v)
-        hashes = np.fromiter((stable_hash64(k) for k in keys),
-                             dtype=np.uint64, count=len(keys))
-        part = (hashes % np.uint64(num_partitions)).astype(np.int64)
-        idx_arr = np.asarray(indices, dtype=np.int64)
+        meta_fhs, key_fhs = [], []
         for p in range(num_partitions):
-            sel = np.flatnonzero(part == p)
-            h = hashes[sel]
-            order = np.argsort(h, kind="stable")
-            sel = sel[order]
-            kb = [keys[i].encode("utf-8") for i in sel]
-            lens = np.fromiter((len(b) for b in kb), dtype=np.uint64,
-                               count=len(kb))
-            offs = np.zeros(len(kb) + 1, dtype=np.uint64)
-            np.cumsum(lens, out=offs[1:])
             pre = os.path.join(directory, f"{namespace}-part-{p}")
-            np.save(f"{pre}.hash.npy", h[order])
-            np.save(f"{pre}.index.npy", idx_arr[sel])
+            meta_fhs.append(open(f"{pre}.spill.meta", "wb"))
+            key_fhs.append(open(f"{pre}.spill.keys", "wb"))
+        total = 0
+        pack = struct.Struct("<QqI").pack  # hash u64, index i64, keylen u32
+        try:
+            for k, v in items:
+                kb = k.encode("utf-8")
+                h = stable_hash64(k)
+                p = h % num_partitions
+                meta_fhs[p].write(pack(h, v, len(kb)))
+                key_fhs[p].write(kb)
+                total += 1
+        finally:
+            for fh in meta_fhs + key_fhs:
+                fh.close()
+
+        meta_dtype = np.dtype(
+            [("h", "<u8"), ("i", "<i8"), ("l", "<u4")])
+        for p in range(num_partitions):
+            pre = os.path.join(directory, f"{namespace}-part-{p}")
+            with open(f"{pre}.spill.meta", "rb") as fh:
+                meta = np.frombuffer(fh.read(), dtype=meta_dtype)
+            with open(f"{pre}.spill.keys", "rb") as fh:
+                key_bytes = np.frombuffer(fh.read(), dtype=np.uint8)
+            in_offs = np.zeros(len(meta) + 1, dtype=np.uint64)
+            np.cumsum(meta["l"], out=in_offs[1:])
+            order = np.argsort(meta["h"], kind="stable")
+            lens = meta["l"][order].astype(np.uint64)
+            offs = np.zeros(len(meta) + 1, dtype=np.uint64)
+            np.cumsum(lens, out=offs[1:])
+            np.save(f"{pre}.hash.npy", meta["h"][order])
+            np.save(f"{pre}.index.npy", meta["i"][order].astype(np.int64))
             np.save(f"{pre}.offsets.npy", offs)
             np.save(f"{pre}.byindex.npy",
-                    np.argsort(idx_arr[sel], kind="stable"))
+                    np.argsort(meta["i"][order], kind="stable"))
+            # reorder the variable-length key bytes into hash order with
+            # one vectorized gather (no per-key Python loop)
+            ln = lens.astype(np.int64)
+            seg_src = in_offs[:-1][order].astype(np.int64)
+            seg = np.repeat(np.arange(len(order)), ln)
+            rank = (np.arange(int(offs[-1]), dtype=np.int64)
+                    - np.repeat(offs[:-1].astype(np.int64), ln))
             with open(f"{pre}.keys.bin", "wb") as fh:
-                fh.write(b"".join(kb))
+                fh.write(key_bytes[seg_src[seg] + rank].tobytes())
+            os.remove(f"{pre}.spill.meta")
+            os.remove(f"{pre}.spill.keys")
         with open(os.path.join(
                 directory, f"{namespace}-offheap-meta.json"), "w") as fh:
-            json.dump({"numPartitions": num_partitions, "size": len(keys),
+            json.dump({"numPartitions": num_partitions, "size": total,
                        "format": "photon-offheap-v1"}, fh)
         return OffHeapIndexMap(directory, namespace)
 

@@ -190,9 +190,11 @@ def _read_blocks(path: str) -> Optional[tuple]:
                 k = dec.read_string()
                 meta[k] = dec.read_bytes()
         schema = parse_schema(meta["avro.schema"].decode())
-    except (IndexError, KeyError):
+        codec = meta.get("avro.codec", b"null").decode()
+    except (IndexError, KeyError, ValueError, UnicodeDecodeError):
+        # truncated or corrupt header (bad varint/length/utf-8/schema
+        # json): decline the fast path
         return None
-    codec = meta.get("avro.codec", b"null").decode()
     if codec not in ("null", "deflate"):
         return None
     if dec.pos + SYNC_SIZE > len(buf):
