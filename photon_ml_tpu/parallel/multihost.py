@@ -1,0 +1,138 @@
+"""Multi-host execution: ``jax.distributed`` workers over one global mesh.
+
+The reference's runtime is inherently multi-node (Spark executors over
+YARN); the TPU-native counterpart is multi-controller JAX: every host
+runs this same program, ``jax.distributed.initialize`` forms the global
+device set, and the SAME mesh/shard_map code that runs single-host runs
+unchanged over hosts — XLA routes the ``psum`` over ICI within a slice
+and DCN across slices (SURVEY §5.8: multi-host only for data-loading and
+inter-slice reductions).
+
+This module is the ``local[4]``-of-hosts witness
+(photon-test/.../SparkTestUtils.scala:55-69 analog, lifted one level):
+``run_worker`` is executed by N CPU processes (each with a virtual
+multi-device platform), feeds per-process LOCAL data shards into a global
+array (the HDFS-partition analog: no process ever holds another's rows),
+runs the explicit shard_map+psum fixed-effect fit
+(parallel/distributed.run_glm_shard_map), and checks parity against a
+process-local single-device solve. tests/test_multihost.py spawns the
+workers; a real pod would launch the same worker per host.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def _synthetic(rows: int, dim: int, seed: int):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, dim)).astype(np.float32)
+    w_true = rng.normal(size=dim).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.uniform(size=rows) < p).astype(np.float32)
+    return X, y
+
+
+def run_worker(process_id: int, num_processes: int, coordinator: str,
+               rows: int = 512, dim: int = 16, seed: int = 11) -> None:
+    """One multi-host worker: global-mesh shard_map fit + local parity.
+
+    Every worker generates the same deterministic dataset but contributes
+    only ITS row range to the global batch (make_array_from_callback reads
+    just the addressable shards), mirroring per-host input partitions.
+    """
+    import jax
+
+    from photon_ml_tpu.utils.backend_probe import default_platform_is_cpu
+
+    if default_platform_is_cpu():
+        # a site import hook may re-pin jax_platforms to an accelerator;
+        # honor the caller's explicit CPU request (test harness) regardless
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_ml_tpu.data.batch import DenseBatch
+    from photon_ml_tpu.optimize.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+        TaskType,
+    )
+    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    devs = jax.devices()  # GLOBAL device list across processes
+    n_local = len(jax.local_devices())
+    assert len(devs) == n_local * num_processes, (len(devs), n_local)
+    assert rows % len(devs) == 0, "rows must divide the global device count"
+    mesh = make_mesh(num_data=len(devs), num_entity=1, devices=devs)
+
+    X, y = _synthetic(rows, dim, seed)
+    host = DenseBatch(
+        X=X, labels=y,
+        offsets=np.zeros(rows, np.float32),
+        weights=np.ones(rows, np.float32),
+    )
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def to_global(leaf):
+        # the callback receives per-shard index tuples and returns only
+        # the addressable (process-local) row ranges
+        return jax.make_array_from_callback(
+            leaf.shape, sharding, lambda idx: leaf[idx])
+
+    gbatch = DenseBatch(
+        X=to_global(host.X), labels=to_global(host.labels),
+        offsets=to_global(host.offsets), weights=to_global(host.weights))
+
+    problem = GLMOptimizationProblem(
+        config=GLMOptimizationConfiguration(
+            max_iterations=25, tolerance=1e-8, regularization_weight=0.5,
+            optimizer_type=OptimizerType.LBFGS,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2)),
+        task=TaskType.LOGISTIC_REGRESSION)
+
+    model, result = run_glm_shard_map(problem, gbatch, mesh)
+    w = np.asarray(model.coefficients.means)
+    assert np.all(np.isfinite(w))
+
+    # Process-local single-device reference fit on the full dataset.
+    local_batch = DenseBatch(
+        X=jnp.asarray(X), labels=jnp.asarray(y),
+        offsets=jnp.zeros(rows, jnp.float32),
+        weights=jnp.ones(rows, jnp.float32))
+    local_model, _ = problem.run(local_batch)
+    np.testing.assert_allclose(
+        w, np.asarray(local_model.coefficients.means),
+        rtol=2e-4, atol=2e-4)
+    print(f"PARITY_OK process={process_id} devices={len(devs)} "
+          f"iters={result.iterations}", flush=True)
+    jax.distributed.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="photon-ml-tpu multi-host shard_map demo worker")
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--coordinator", required=True,
+                    help="host:port of process 0's coordination service")
+    ap.add_argument("--rows", type=int, default=512)
+    ap.add_argument("--dim", type=int, default=16)
+    args = ap.parse_args(argv)
+    run_worker(args.process_id, args.num_processes, args.coordinator,
+               rows=args.rows, dim=args.dim)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Multi-host (jax.distributed) fixed-effect fit: 2 CPU processes, one
+global mesh — the local[4]-of-hosts tier.
+
+Spawns two worker processes (photon_ml_tpu/parallel/multihost.py), each
+with a 4-device virtual CPU platform, that form one 8-device global mesh
+via jax.distributed, feed per-process local row shards into the global
+batch, run the explicit shard_map+psum fit, and assert parity against a
+single-device solve. Reference analog: Spark executors on separate hosts
+running the same treeAggregate program (SURVEY §5.8).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_fit():
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "photon_ml_tpu.parallel.multihost",
+             "--process-id", str(i), "--num-processes", "2",
+             "--coordinator", f"127.0.0.1:{port}"],
+            env=env, cwd=repo, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (f"worker {i} rc={rc}\nstdout:\n{out}\n"
+                         f"stderr:\n{err}")
+        assert f"PARITY_OK process={i} devices=8" in out, out
