@@ -927,3 +927,140 @@ class TestDownSampling:
                 for g in rec["grid"] for s in g["states"]]
         assert all(np.isfinite(a) for a in aucs)
         assert max(aucs) > 0.6  # half the negatives dropped, still learns
+
+
+GAME2_SCHEMA = {
+    "name": "GameRecord2", "type": "record", "namespace": "t2",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+        {"name": "globalFeatures",
+         "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "userFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+        {"name": "itemFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+    ],
+}
+
+
+def _make_game2_avro(path, n=500, n_users=8, n_items=6, d_g=6, d_u=3,
+                     d_i=3, seed=0):
+    """Two-entity GAME fixture: global + per-user + per-item signal (the
+    GameIntegTest per-user/per-song shape)."""
+    rng = np.random.default_rng(seed)
+    w_rng = np.random.default_rng(778)  # same true model across splits
+    w_g = w_rng.normal(size=d_g)
+    W_u = w_rng.normal(size=(n_users, d_u))
+    W_i = w_rng.normal(size=(n_items, d_i))
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        it = int(rng.integers(0, n_items))
+        xg = rng.normal(size=d_g)
+        xu = rng.normal(size=d_u)
+        xi = rng.normal(size=d_i)
+        margin = xg @ w_g + xu @ W_u[u] + xi @ W_i[it]
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append({
+            "uid": f"s{i}", "response": y, "offset": None, "weight": None,
+            "metadataMap": {"userId": f"user{u}", "itemId": f"item{it}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(xg[j])} for j in range(d_g)],
+            "userFeatures": [{"name": f"u{j}", "term": "",
+                              "value": float(xu[j])} for j in range(d_u)],
+            "itemFeatures": [{"name": f"i{j}", "term": "",
+                              "value": float(xi[j])} for j in range(d_i)],
+        })
+    write_container(path, GAME2_SCHEMA, records)
+
+
+class TestGameDriverSweep:
+    """Parametrized GAME-CLI acceptance sweep: coordinate sets x optimizers
+    x a 2-point lambda grid, with metric and coefficient-count gates — the
+    DriverTest.scala:589+ toy/serious-set analog over the CLI surface."""
+
+    N_USERS, N_ITEMS, D_G, D_U, D_I = 8, 6, 6, 3, 3
+
+    @pytest.mark.parametrize("opt", ["LBFGS", "TRON"])
+    @pytest.mark.parametrize(
+        "coords", ["fixed", "fixed+re", "fixed+2re"])
+    def test_sweep(self, tmp_path, coords, opt):
+        from photon_ml_tpu.game.models import (
+            FixedEffectModel,
+            RandomEffectModel,
+        )
+        from photon_ml_tpu.io.model_io import load_game_model
+        from photon_ml_tpu.optimize.config import TaskType
+
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game2_avro(train, n=500, seed=71)
+        _make_game2_avro(validate, n=200, seed=72)
+        out = str(tmp_path / "out")
+
+        shard_map_arg = ("global:globalFeatures|user:userFeatures"
+                        "|item:itemFeatures")
+        seq = ["fixed"]
+        args = [
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map", shard_map_arg,
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            # 2-point lambda grid on the fixed coordinate
+            "--fixed-effect-optimization-configurations",
+            f"fixed:25,1e-7,1,1,{opt},L2;fixed:25,1e-7,0.01,1,{opt},L2",
+            "--evaluator-type", "AUC",
+        ]
+        re_data, re_opt = [], []
+        if coords in ("fixed+re", "fixed+2re"):
+            seq.append("perUser")
+            re_data.append("perUser:userId,user,1")
+            re_opt.append(f"perUser:25,1e-7,1.0,1,{opt},L2")
+        if coords == "fixed+2re":
+            seq.append("perItem")
+            re_data.append("perItem:itemId,item,1")
+            re_opt.append(f"perItem:25,1e-7,1.0,1,{opt},L2")
+        if re_data:
+            args += ["--random-effect-data-configurations",
+                     "|".join(re_data),
+                     "--random-effect-optimization-configurations",
+                     "|".join(re_opt)]
+        args += ["--updating-sequence", ",".join(seq)]
+        game_main(args)
+
+        # -- metric gates (per-grid-point record + best-model selection)
+        rec = json.load(open(os.path.join(out, "metrics.json")))
+        assert len(rec["grid"]) == 2  # the fixed-effect lambda grid
+        best_auc = rec["best"]["metric"]
+        floor = 0.62 if coords == "fixed" else 0.70
+        assert best_auc > floor, (coords, opt, best_auc)
+        for g in rec["grid"]:
+            for s in g["states"]:
+                assert np.isfinite(s["objective"])
+
+        # -- coefficient-count gates (DriverTest's exact-count assertions)
+        model, _ = load_game_model(os.path.join(out, "best"),
+                                   task=TaskType.LOGISTIC_REGRESSION)
+        fixed = model.models["fixed"]
+        assert isinstance(fixed, FixedEffectModel)
+        assert len(np.asarray(fixed.coefficients.means)) == self.D_G + 1
+        if coords in ("fixed+re", "fixed+2re"):
+            ru = model.models["perUser"]
+            assert isinstance(ru, RandomEffectModel)
+            w_u = np.asarray(ru.coefficients)
+            assert w_u.shape[0] == self.N_USERS
+            assert w_u.shape[1] == self.D_U + 1
+        if coords == "fixed+2re":
+            ri = model.models["perItem"]
+            w_i = np.asarray(ri.coefficients)
+            assert w_i.shape[0] == self.N_ITEMS
+            assert w_i.shape[1] == self.D_I + 1
