@@ -1064,3 +1064,56 @@ class TestGameDriverSweep:
             w_i = np.asarray(ri.coefficients)
             assert w_i.shape[0] == self.N_ITEMS
             assert w_i.shape[1] == self.D_I + 1
+
+    @pytest.mark.parametrize("buckets", [1, 3])
+    def test_block_buckets_flag(self, tmp_path, buckets, monkeypatch):
+        """--random-effect-block-buckets engages (N, D) bucketing through
+        the CLI with identical learning quality to the single block."""
+        import photon_ml_tpu.cli.game_training_driver as gtd
+        from photon_ml_tpu.io.model_io import load_game_model
+        from photon_ml_tpu.optimize.config import TaskType
+
+        # spy: prove the flag actually reaches the dataset build
+        built = {}
+        orig_build = gtd.build_random_effect_dataset
+
+        def spy(data, cfg, **kw):
+            ds = orig_build(data, cfg, **kw)
+            built["buckets"] = ds.buckets
+            return ds
+
+        monkeypatch.setattr(gtd, "build_random_effect_dataset", spy)
+
+        train = str(tmp_path / "train.avro")
+        validate = str(tmp_path / "validate.avro")
+        _make_game2_avro(train, n=400, seed=81)
+        _make_game2_avro(validate, n=150, seed=82)
+        out = str(tmp_path / f"out{buckets}")
+        game_main([
+            "--train-input-dirs", train,
+            "--validate-input-dirs", validate,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:25,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations", "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:25,1e-7,1.0,1,LBFGS,L2",
+            "--random-effect-block-buckets", str(buckets),
+            "--evaluator-type", "AUC",
+        ])
+        rec = json.load(open(os.path.join(out, "metrics.json")))
+        assert rec["best"]["metric"] > 0.70
+        model, _ = load_game_model(os.path.join(out, "best"),
+                                   task=TaskType.LOGISTIC_REGRESSION)
+        w_u = np.asarray(model.models["perUser"].coefficients)
+        assert w_u.shape == (self.N_USERS, self.D_U + 1)
+        if buckets > 1:
+            assert built["buckets"] is not None and len(built["buckets"]) > 1
+        else:
+            assert built["buckets"] is None
