@@ -499,6 +499,12 @@ class GameTrainingDriver:
                       "coordinate": s.coordinate_id,
                       "objective": _finite(s.objective),
                       "seconds": round(float(s.seconds), 3),
+                      # per-entity convergence-reason counts for RE sweeps
+                      # (RandomEffectOptimizationTracker.countsByConvergence)
+                      "convergence_counts": (
+                          s.tracker.counts_by_convergence()
+                          if hasattr(s.tracker, "counts_by_convergence")
+                          else None),
                       "validation_metrics": (
                           None if s.validation_metrics is None else
                           {k: _finite(v)

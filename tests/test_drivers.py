@@ -1109,6 +1109,13 @@ class TestGameDriverSweep:
         ])
         rec = json.load(open(os.path.join(out, "metrics.json")))
         assert rec["best"]["metric"] > 0.70
+        # per-entity convergence counts surface in the persisted record
+        re_states = [st for g in rec["grid"] for st in g["states"]
+                     if st["coordinate"] == "perUser"]
+        assert re_states
+        for st in re_states:
+            counts = st["convergence_counts"]
+            assert counts and sum(counts.values()) == self.N_USERS
         model, _ = load_game_model(os.path.join(out, "best"),
                                    task=TaskType.LOGISTIC_REGRESSION)
         w_u = np.asarray(model.models["perUser"].coefficients)

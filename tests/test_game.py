@@ -315,6 +315,25 @@ class TestEntityBucketing:
         np.testing.assert_allclose(np.asarray(c2), np.asarray(c1),
                                    rtol=1e-3, atol=1e-4)
 
+    def test_bucketed_active_passive_coverage(self, rng):
+        """Reservoir cap + bucketing: every sample lands exactly once in
+        an active bucket slot or the (global) passive side."""
+        data, _, users = self._skewed_data(rng)
+        cfg = RandomEffectDataConfiguration(
+            "u", "s", 1, num_active_data_points_upper_bound=20)
+        ds = build_random_effect_dataset(data, cfg, num_buckets=3)
+        ids = np.concatenate(
+            [np.asarray(b.row_ids).ravel() for b in ds.buckets])
+        active = sorted(ids[ids < data.num_samples].tolist())
+        passive = (sorted(np.asarray(ds.passive_row_ids).tolist())
+                   if ds.num_passive else [])
+        assert len(active) + len(passive) == data.num_samples
+        assert sorted(active + passive) == list(range(data.num_samples))
+        # the cap binds inside every bucket
+        for b in ds.buckets:
+            counts = (np.asarray(b.weights) > 0).sum(axis=1)
+            assert counts.max() <= 20
+
     def test_factored_coordinate_rejects_buckets(self, rng):
         data, *_ = self._skewed_data(rng)
         ds = build_random_effect_dataset(
@@ -330,6 +349,118 @@ class TestEntityBucketing:
                 latent_problem=GLMOptimizationProblem(
                     config=l2_config(), task=TaskType.LINEAR_REGRESSION),
                 latent_dim=2)
+
+
+class TestEntityBucketingSolvers:
+    """Bucketed solves across the full optimizer family + precision/resume
+    interplay (the bucketed analog of BaseGLMIntegTest's cross-optimizer
+    discipline)."""
+
+    @staticmethod
+    def _skewed(rng, task="linear"):
+        return TestEntityBucketing._skewed_data(rng)
+
+    def test_bucketed_tron_matches_lbfgs(self, rng):
+        data, W, users = TestEntityBucketing._skewed_data(rng)
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration("u", "s", 1), num_buckets=3)
+
+        def cfg(opt):
+            return GLMOptimizationConfiguration(
+                max_iterations=60, tolerance=1e-10,
+                regularization_weight=0.1, optimizer_type=opt,
+                regularization_context=RegularizationContext(
+                    RegularizationType.L2))
+
+        offs = ds.offsets_with(jnp.zeros(data.num_samples))
+        task = TaskType.LINEAR_REGRESSION
+        c_tron, *_ = RandomEffectOptimizationProblem(
+            config=cfg(OptimizerType.TRON), task=task).run(ds, offs)
+        c_lbfgs, *_ = RandomEffectOptimizationProblem(
+            config=cfg(OptimizerType.LBFGS), task=task).run(ds, offs)
+        np.testing.assert_allclose(np.asarray(c_tron), np.asarray(c_lbfgs),
+                                   atol=2e-3)
+
+    def test_bucketed_owlqn_sparsifies(self, rng):
+        """L1 through the bucketed path engages OWL-QN per bucket and
+        produces sparse per-entity models."""
+        data, W, users = TestEntityBucketing._skewed_data(rng)
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration("u", "s", 1), num_buckets=3)
+        cfg = GLMOptimizationConfiguration(
+            max_iterations=50, tolerance=1e-9, regularization_weight=5.0,
+            optimizer_type=OptimizerType.LBFGS,
+            regularization_context=RegularizationContext(
+                RegularizationType.L1))
+        coefs, *_ = RandomEffectOptimizationProblem(
+            config=cfg, task=TaskType.LINEAR_REGRESSION).run(
+                ds, ds.offsets_with(jnp.zeros(data.num_samples)))
+        w = np.asarray(coefs)
+        assert np.all(np.isfinite(w))
+        # strong L1 must zero a solid fraction of coefficients exactly
+        assert (np.abs(w) < 1e-12).mean() > 0.2
+
+    def test_bucketed_bf16_blocks_close_to_f32(self, rng):
+        """bf16 entity blocks (half the HBM stream on TPU) with f32 solver
+        state stay close to the f32 solve — the RE-side mixed-precision
+        lever (solver_x0 promotes state to >=f32)."""
+        data, W, users = TestEntityBucketing._skewed_data(rng)
+        cfg = RandomEffectDataConfiguration("u", "s", 1)
+        prob = RandomEffectOptimizationProblem(
+            config=l2_config(lam=1e-2), task=TaskType.LINEAR_REGRESSION)
+        f32 = build_random_effect_dataset(data, cfg, num_buckets=3)
+        bf16 = build_random_effect_dataset(data, cfg, num_buckets=3,
+                                           dtype=jnp.bfloat16)
+        assert bf16.buckets[0].X.dtype == jnp.bfloat16
+        c32, *_ = prob.run(f32, f32.offsets_with(
+            jnp.zeros(data.num_samples)))
+        c16, *_ = prob.run(bf16, bf16.offsets_with(
+            jnp.zeros(data.num_samples)))
+        assert np.asarray(c16).dtype == np.float32  # state stayed f32
+        np.testing.assert_allclose(np.asarray(c16), np.asarray(c32),
+                                   rtol=0.1, atol=0.05)
+
+    def test_bucketed_cd_checkpoint_resume(self, rng, tmp_path):
+        """Mid-run resume with a bucketed RE coordinate reproduces the
+        uninterrupted run (compact [E, D] state round-trips)."""
+        from photon_ml_tpu.game.coordinate_descent import (
+            run_coordinate_descent as run_cd,
+        )
+        from photon_ml_tpu.utils.checkpoint import CheckpointManager
+
+        data, *_ = make_game_data(rng, n=400, n_entities=10)
+        task = TaskType.LOGISTIC_REGRESSION
+
+        def build():
+            return {
+                "fixed": FixedEffectCoordinate(
+                    dataset=build_fixed_effect_dataset(data, "global"),
+                    problem=GLMOptimizationProblem(
+                        config=l2_config(lam=0.1), task=task)),
+                "perUser": RandomEffectCoordinate(
+                    dataset=build_random_effect_dataset(
+                        data, RandomEffectDataConfiguration(
+                            "userId", "per_user", 1), num_buckets=3),
+                    problem=RandomEffectOptimizationProblem(
+                        config=l2_config(lam=0.5), task=task)),
+            }
+
+        labels = jnp.asarray(data.responses)
+        weights = jnp.asarray(data.weights)
+        offsets = jnp.asarray(data.offsets)
+        res_full = run_cd(build(), 2, task, labels, weights, offsets)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        run_cd(build(), 1, task, labels, weights, offsets,
+               checkpoint_manager=mgr)
+        snap = mgr.restore()
+        restored = {cid: jnp.asarray(v)
+                    for cid, v in snap["states"].items()}
+        res_resumed = run_cd(build(), 2, task, labels, weights, offsets,
+                             initial_states=restored,
+                             start_iteration=int(snap["iteration"]))
+        np.testing.assert_allclose(
+            res_resumed.states[-1].objective,
+            res_full.states[-1].objective, rtol=1e-6)
 
 
 class TestRandomEffectSolver:
