@@ -238,10 +238,18 @@ def run_game_step(
     }
 
 
-def check_game_step_multichip(n_devices: int, devices=None) -> dict:
+def check_game_step_multichip(n_devices: int, devices=None,
+                              parity_summary: bool = False) -> dict:
     """Build an (n_data x n_entity) mesh over ``n_devices`` devices, run the
     GAME step on it, and sanity-assert finiteness. Returns the results dict
     (the pytest tier additionally asserts parity vs ``run_game_step(mesh=None)``).
+
+    With ``parity_summary=True`` (the dry-run gate's mode) the single-device
+    referent is also computed and ONE auditable summary line is printed —
+    platform, device count, mesh shape, coordinates covered, and the max
+    elementwise deviation from the single-device ground truth — so a green
+    gate record witnesses *what* ran, the way the reference's per-test
+    logging under SparkTestUtils.sparkTest does.
     """
     import jax
 
@@ -259,4 +267,20 @@ def check_game_step_multichip(n_devices: int, devices=None) -> dict:
     out = run_game_step(n_data=n_data, n_entity=n_entity, mesh=mesh)
     for key, val in out.items():
         assert np.all(np.isfinite(val)), f"non-finite {key}"
+    if parity_summary:
+        ref = run_game_step(n_data=n_data, n_entity=n_entity, mesh=None)
+        max_dev = max(
+            float(np.max(np.abs(np.asarray(out[k], dtype=np.float64)
+                                - np.asarray(ref[k], dtype=np.float64))))
+            for k in out)
+        assert max_dev < 1e-3, (
+            f"mesh run deviates from single-device referent by {max_dev}")
+        print(
+            "multichip ok: "
+            f"platform={jax.default_backend()} n_devices={n_devices} "
+            f"mesh=(data={n_data},entity={n_entity}) "
+            "coordinates=fixed,randomEffect,factoredRandomEffect,"
+            "mfScoring,shardMapFixed "
+            f"max_parity_dev={max_dev:.3e}",
+            flush=True)
     return out
