@@ -496,6 +496,121 @@ def _project_nnz(sub: sp.csr_matrix, entity_of_row: np.ndarray,
     return row_of, j, valid
 
 
+class _PairStatsAccumulator:
+    """Streaming per-(entity, feature) moment accumulation for projector
+    construction. Feed any number of active-row chunks through ``add``; the
+    running state is the sorted unique (entity, feature) key set with summed
+    moments (s1=Σx, s2=Σx², sxy=Σxy) plus per-entity label sums — memory is
+    bounded by the number of DISTINCT pairs (the eventual projector table),
+    never the total nnz, which is what lets the entity-block build stream
+    past host RAM (RandomEffectDataSet.scala:169-206's shuffle-side
+    combine)."""
+
+    def __init__(self, raw_dim: int, e_real: int, with_moments: bool):
+        self.raw_dim = raw_dim
+        self.e_real = e_real
+        self.with_moments = with_moments
+        self.keys = np.zeros(0, np.int64)
+        self.s1 = np.zeros(0)
+        self.s2 = np.zeros(0)
+        self.sxy = np.zeros(0)
+        self.sy1 = np.zeros(e_real)
+        self.sy2 = np.zeros(e_real)
+
+    def add(self, sub: sp.csr_matrix, entity_of_row: np.ndarray,
+            labels: np.ndarray) -> None:
+        """Absorb one chunk of ACTIVE rows (CSR + their entity indices +
+        labels)."""
+        lens = np.diff(sub.indptr)
+        row_of = np.repeat(np.arange(sub.shape[0]), lens)
+        ent = np.asarray(entity_of_row, dtype=np.int64)[row_of]
+        keys = ent * self.raw_dim + sub.indices
+        pairs, inv = np.unique(keys, return_inverse=True)
+        if self.with_moments:
+            v = sub.data.astype(np.float64)
+            y = np.asarray(labels, dtype=np.float64)
+            # bincount-with-weights, not np.add.at: the buffered ufunc.at
+            # path is ~10-30x slower on the 80M-element ingest bench.
+            s1 = np.bincount(inv, weights=v, minlength=len(pairs))
+            s2 = np.bincount(inv, weights=v * v, minlength=len(pairs))
+            sxy = np.bincount(inv, weights=v * y[row_of],
+                              minlength=len(pairs))
+            ent_rows = np.asarray(entity_of_row, dtype=np.int64)
+            self.sy1 += np.bincount(ent_rows, weights=y,
+                                    minlength=self.e_real)
+            self.sy2 += np.bincount(ent_rows, weights=y * y,
+                                    minlength=self.e_real)
+        else:
+            s1 = s2 = sxy = np.zeros(len(pairs))
+        # merge-compact into the running sorted key set
+        if len(self.keys):
+            merged, minv = np.unique(np.concatenate([self.keys, pairs]),
+                                     return_inverse=True)
+            ms1 = np.bincount(minv, weights=np.concatenate([self.s1, s1]),
+                              minlength=len(merged))
+            ms2 = np.bincount(minv, weights=np.concatenate([self.s2, s2]),
+                              minlength=len(merged))
+            msxy = np.bincount(minv, weights=np.concatenate([self.sxy, sxy]),
+                               minlength=len(merged))
+            self.keys, self.s1, self.s2, self.sxy = merged, ms1, ms2, msxy
+        else:
+            self.keys, self.s1, self.s2, self.sxy = pairs, s1, s2, sxy
+
+    def finalize(self, act_counts: np.ndarray,
+                 config: RandomEffectDataConfiguration,
+                 pad_to_multiple: int = 8) -> IndexMapProjectors:
+        """Per-entity feature unions + optional |Pearson| top-k selection
+        (LocalDataSet.scala:202-248) over the accumulated pair stats."""
+        e_real = self.e_real
+        raw_dim = self.raw_dim
+        pair_ent = (self.keys // raw_dim).astype(np.int64)
+        pair_col = (self.keys % raw_dim).astype(np.int32)
+
+        # Per-entity keep limits (None -> no cap anywhere).
+        if config.num_features_to_keep_upper_bound is not None:
+            limits = np.full(e_real,
+                             config.num_features_to_keep_upper_bound,
+                             dtype=np.int64)
+        elif config.num_features_to_samples_ratio_upper_bound is not None:
+            limits = np.ceil(
+                config.num_features_to_samples_ratio_upper_bound
+                * act_counts).astype(np.int64)
+        else:
+            limits = None
+
+        if limits is not None:
+            # |Pearson(feature, label)| per pair from the sparse moments:
+            # cov = E[xy] - E[x]E[y], var = E[x^2] - E[x]^2 (zeros
+            # contribute only through the entity's row count).
+            k_e = np.maximum(act_counts, 1).astype(np.float64)
+            ym = self.sy1 / k_e
+            y_sd = np.sqrt(np.maximum(self.sy2 / k_e - ym * ym, 0.0))
+            ke_p = k_e[pair_ent]
+            xm = self.s1 / ke_p
+            cov = self.sxy / ke_p - xm * ym[pair_ent]
+            var_x = np.maximum(self.s2 / ke_p - xm * xm, 0.0)
+            denom = np.sqrt(var_x) * y_sd[pair_ent]
+            corr = np.where(denom > 0,
+                            np.abs(cov) / np.where(denom > 0, denom, 1.0),
+                            0.0)
+            keep = _topk_per_segment(pair_ent, corr, limits)
+            pair_ent, pair_col = pair_ent[keep], pair_col[keep]
+            # restore (entity, column) order after the ranked selection
+            reorder = np.lexsort((pair_col, pair_ent))
+            pair_ent, pair_col = pair_ent[reorder], pair_col[reorder]
+
+        reduced_dims = np.bincount(pair_ent,
+                                   minlength=e_real).astype(np.int32)
+        d_red = int(reduced_dims.max()) if e_real else 1
+        d_red = max(1, -(-max(d_red, 1) // pad_to_multiple)
+                    * pad_to_multiple)
+        raw_indices = np.full((e_real, d_red), raw_dim, dtype=np.int32)
+        starts = np.concatenate([[0], np.cumsum(reduced_dims)[:-1]])
+        slot = np.arange(len(pair_ent)) - starts[pair_ent]
+        raw_indices[pair_ent, slot] = pair_col
+        return IndexMapProjectors(raw_indices, reduced_dims, raw_dim)
+
+
 def _build_projectors_from_active(
     sub: sp.csr_matrix,
     entity_of_row: np.ndarray,
@@ -505,70 +620,14 @@ def _build_projectors_from_active(
     config: RandomEffectDataConfiguration,
     pad_to_multiple: int = 8,
 ) -> IndexMapProjectors:
-    """Per-entity feature unions + optional |Pearson| top-k, in bulk.
-
-    One pass over the active nnz replaces E calls to ``_select_features``:
-    per-(entity, feature) sums accumulate via ``np.bincount`` over the unique
-    (entity, feature) pairs, correlations come from the moment identities
-    cov = E[xy] - E[x]E[y], var = E[x^2] - E[x]^2 (zeros contribute only
-    through the entity's row count), and the per-entity cap is a vectorized
-    rank-within-segment selection. Mirrors LocalDataSet.scala:202-248.
-    """
-    e_real = len(act_counts)
-    lens = np.diff(sub.indptr)
-    row_of = np.repeat(np.arange(sub.shape[0]), lens)
-    ent = np.asarray(entity_of_row, dtype=np.int64)[row_of]
-    keys = ent * raw_dim + sub.indices
-    pairs, inv = np.unique(keys, return_inverse=True)
-    pair_ent = (pairs // raw_dim).astype(np.int64)
-    pair_col = (pairs % raw_dim).astype(np.int32)
-
-    # Per-entity keep limits (None -> no cap anywhere).
-    if config.num_features_to_keep_upper_bound is not None:
-        limits = np.full(e_real, config.num_features_to_keep_upper_bound,
-                         dtype=np.int64)
-    elif config.num_features_to_samples_ratio_upper_bound is not None:
-        limits = np.ceil(config.num_features_to_samples_ratio_upper_bound
-                         * act_counts).astype(np.int64)
-    else:
-        limits = None
-
-    if limits is not None:
-        # |Pearson(feature, label)| per (entity, feature) from sparse moments.
-        v = sub.data.astype(np.float64)
-        y = np.asarray(labels, dtype=np.float64)
-        # bincount-with-weights, not np.add.at: the buffered ufunc.at path
-        # is ~10-30x slower on the 80M-element ingest bench.
-        s1 = np.bincount(inv, weights=v, minlength=len(pairs))
-        s2 = np.bincount(inv, weights=v * v, minlength=len(pairs))
-        sxy = np.bincount(inv, weights=v * y[row_of], minlength=len(pairs))
-        k_e = np.maximum(act_counts, 1).astype(np.float64)
-        ent_rows = np.asarray(entity_of_row, dtype=np.int64)
-        sy1 = np.bincount(ent_rows, weights=y, minlength=e_real)
-        sy2 = np.bincount(ent_rows, weights=y * y, minlength=e_real)
-        ym = sy1 / k_e
-        y_sd = np.sqrt(np.maximum(sy2 / k_e - ym * ym, 0.0))
-        ke_p = k_e[pair_ent]
-        xm = s1 / ke_p
-        cov = sxy / ke_p - xm * ym[pair_ent]
-        var_x = np.maximum(s2 / ke_p - xm * xm, 0.0)
-        denom = np.sqrt(var_x) * y_sd[pair_ent]
-        corr = np.where(denom > 0, np.abs(cov) / np.where(denom > 0, denom,
-                                                          1.0), 0.0)
-        keep = _topk_per_segment(pair_ent, corr, limits)
-        pair_ent, pair_col = pair_ent[keep], pair_col[keep]
-        # restore (entity, column) order after the score-ranked selection
-        reorder = np.lexsort((pair_col, pair_ent))
-        pair_ent, pair_col = pair_ent[reorder], pair_col[reorder]
-
-    reduced_dims = np.bincount(pair_ent, minlength=e_real).astype(np.int32)
-    d_red = int(reduced_dims.max()) if e_real else 1
-    d_red = max(1, -(-max(d_red, 1) // pad_to_multiple) * pad_to_multiple)
-    raw_indices = np.full((e_real, d_red), raw_dim, dtype=np.int32)
-    starts = np.concatenate([[0], np.cumsum(reduced_dims)[:-1]])
-    slot = np.arange(len(pair_ent)) - starts[pair_ent]
-    raw_indices[pair_ent, slot] = pair_col
-    return IndexMapProjectors(raw_indices, reduced_dims, raw_dim)
+    """One-shot (single-chunk) projector build — the in-RAM entry to the
+    same accumulate+finalize path the streamed builder uses chunk-wise."""
+    need_moments = (
+        config.num_features_to_keep_upper_bound is not None
+        or config.num_features_to_samples_ratio_upper_bound is not None)
+    acc = _PairStatsAccumulator(raw_dim, len(act_counts), need_moments)
+    acc.add(sub, entity_of_row, labels)
+    return acc.finalize(act_counts, config, pad_to_multiple)
 
 
 def _bucket_plan(counts: np.ndarray, num_buckets: int, multiple: int
@@ -617,6 +676,40 @@ def _bucket_plan(counts: np.ndarray, num_buckets: int, multiple: int
         seg_of_size[start:] = b
     size_rank = np.searchsorted(-uniq, -q)
     return n_max, seg_of_size[size_rank]
+
+
+def _fill_feature_rows(
+    sub: sp.csr_matrix,
+    out: np.ndarray,
+    flat_pos: np.ndarray,
+    projectors: Optional[IndexMapProjectors],
+    random_projector: Optional[RandomProjector],
+    table_ent: Optional[np.ndarray] = None,
+    global_ent: Optional[np.ndarray] = None,
+    raw_indices: Optional[np.ndarray] = None,
+) -> None:
+    """ONE per-block feature fill shared by the single-block, bucketed, and
+    passive builders: native pack (block_packer.cpp), numpy ``_project_nnz``
+    scatter fallback, random-projector matmul, or chunked densify.
+
+    ``out`` is a zeroed C-contiguous f32 array whose flat row view receives
+    row ``r`` of ``sub`` at ``flat_pos[r]``. For index-map projection,
+    ``table_ent[r]`` indexes ``raw_indices`` (which may be a bucket slice of
+    the global table) and ``global_ent[r]`` is the row's GLOBAL entity index
+    for the numpy fallback's searchsorted over the full projector table.
+    """
+    flat = out.reshape(-1, out.shape[-1])
+    if projectors is not None:
+        if not pack_projected_rows_native(sub, table_ent, flat_pos,
+                                          raw_indices, out):
+            nnz_row, nnz_j, nnz_ok = _project_nnz(sub, global_ent,
+                                                  projectors)
+            flat[flat_pos[nnz_row[nnz_ok]],
+                 nnz_j[nnz_ok]] = sub.data[nnz_ok]
+    elif random_projector is not None:
+        flat[flat_pos] = (sub @ random_projector.matrix).astype(np.float32)
+    else:
+        flat[flat_pos] = _densify_chunked(sub)
 
 
 def _pack_entity_buckets(
@@ -674,23 +767,15 @@ def _pack_entity_buckets(
         weights[loc, slots] = act_weights[mask]
         row_ids[loc, slots] = rows_act[mask]
 
-        sub_b = sub[mask]
-        if projectors is not None:
-            # Per-bucket table slice: every entity's valid columns sit in
-            # the first reduced_dims[e] <= D_b positions, so truncating to
-            # D_b only drops pad sentinels.
-            raw_idx_b = projectors.raw_indices[start:start + nr, :d_b]
-            if not pack_projected_rows_native(
-                    sub_b, loc, loc * n_b + slots, raw_idx_b, X):
-                nnz_row, nnz_j, nnz_ok = _project_nnz(
-                    sub_b, ent_of_act[mask], projectors)
-                X[loc[nnz_row[nnz_ok]], slots[nnz_row[nnz_ok]],
-                  nnz_j[nnz_ok]] = sub_b.data[nnz_ok]
-        elif random_projector is not None:
-            X[loc, slots] = (sub_b @ random_projector.matrix).astype(
-                np.float32)
-        else:
-            X[loc, slots] = _densify_chunked(sub_b)
+        # Per-bucket table slice: every entity's valid columns sit in the
+        # first reduced_dims[e] <= D_b positions, so truncating to D_b only
+        # drops pad sentinels.
+        _fill_feature_rows(
+            sub[mask], X, loc * n_b + slots,
+            projectors, random_projector,
+            table_ent=loc, global_ent=ent_of_act[mask],
+            raw_indices=None if projectors is None
+            else projectors.raw_indices[start:start + nr, :d_b])
 
         buckets.append(EntityBucket(
             entity_start=start, num_real=nr,
@@ -844,21 +929,12 @@ def build_random_effect_dataset(
         weights[ent_of_act, slot_of_act] = act_weights
         row_ids[ent_of_act, slot_of_act] = rows_act
 
-        if projectors is not None:
-            # Native single-pass pack (no nnz-length temporaries); numpy
-            # searchsorted formulation as fallback.
-            if not pack_projected_rows_native(
-                    sub, ent_of_act, ent_of_act * n_max + slot_of_act,
-                    projectors.raw_indices, X):
-                nnz_row, nnz_j, nnz_ok = _project_nnz(sub, ent_of_act,
-                                                      projectors)
-                X[ent_of_act[nnz_row[nnz_ok]], slot_of_act[nnz_row[nnz_ok]],
-                  nnz_j[nnz_ok]] = sub.data[nnz_ok]
-        elif random_projector is not None:
-            X[ent_of_act, slot_of_act] = (
-                sub @ random_projector.matrix).astype(np.float32)
-        else:
-            X[ent_of_act, slot_of_act] = _densify_chunked(sub)
+        _fill_feature_rows(
+            sub, X, ent_of_act * n_max + slot_of_act,
+            projectors, random_projector,
+            table_ent=ent_of_act, global_ent=ent_of_act,
+            raw_indices=None if projectors is None
+            else projectors.raw_indices)
 
     # --- passive side (sample-major, already projected per entity).
     p_X = p_ent = p_rows = p_off = None
@@ -866,21 +942,14 @@ def build_random_effect_dataset(
         pr = order[passive_mask]
         local = inv_perm[grp_of_sorted[passive_mask]].astype(np.int32)
         sub_p = mat[pr]
-        if projectors is not None:
-            dense = np.zeros((len(pr), d_red), dtype=np.float32)
-            if not pack_projected_rows_native(
-                    sub_p, local.astype(np.int64),
-                    np.arange(len(pr), dtype=np.int64),
-                    projectors.raw_indices, dense):
-                nnz_row, nnz_j, nnz_ok = _project_nnz(sub_p, local,
-                                                      projectors)
-                dense[nnz_row[nnz_ok], nnz_j[nnz_ok]] = sub_p.data[nnz_ok]
-            p_X = jnp.asarray(dense)
-        elif random_projector is not None:
-            p_X = jnp.asarray((sub_p @ random_projector.matrix)
-                              .astype(np.float32))
-        else:
-            p_X = jnp.asarray(_densify_chunked(sub_p))
+        dense = np.zeros((len(pr), d_red), dtype=np.float32)
+        _fill_feature_rows(
+            sub_p, dense, np.arange(len(pr), dtype=np.int64),
+            projectors, random_projector,
+            table_ent=local.astype(np.int64), global_ent=local,
+            raw_indices=None if projectors is None
+            else projectors.raw_indices)
+        p_X = jnp.asarray(dense)
         p_ent = jnp.asarray(local)
         p_rows = jnp.asarray(pr.astype(np.int32))
         p_off = jnp.asarray(data.offsets[pr].astype(np.float32))
@@ -903,3 +972,291 @@ def build_random_effect_dataset(
         buckets=buckets,
         _reduced_dim=d_red if buckets is not None else None,
     )
+
+
+def _alloc_rows(shape, blocks_dir: Optional[str], name: str) -> np.ndarray:
+    """Zeroed f32 destination: RAM array, or a disk-backed ``np.memmap``
+    under ``blocks_dir`` (never resident all at once — the OS pages it)."""
+    if blocks_dir is None:
+        return np.zeros(shape, dtype=np.float32)
+    import os
+
+    os.makedirs(blocks_dir, exist_ok=True)
+    return np.memmap(os.path.join(blocks_dir, name + ".f32"),
+                     dtype=np.float32, mode="w+", shape=shape)
+
+
+def build_random_effect_dataset_streamed(
+    stream_factory,
+    config: RandomEffectDataConfiguration,
+    raw_dim: int,
+    seed: int = 0,
+    pad_rows_multiple: int = 8,
+    entity_axis_size: int = 1,
+    num_buckets: int = 1,
+    blocks_dir: Optional[str] = None,
+    pad_dim_multiple: int = 8,
+) -> RandomEffectDataset:
+    """Random-effect blocks from STREAMED parts, optionally memmap-backed.
+
+    The in-RAM builder (``build_random_effect_dataset``) holds the full
+    feature CSR plus every padded block simultaneously; the reference
+    instead streams partitioned parts through a distributed shuffle into
+    entity-major layout (data/RandomEffectDataSet.scala:169-206) and never
+    materializes the whole dataset on one host. This builder is that
+    shuffle's single-host analog:
+
+    - ``stream_factory()`` returns a FRESH iterator over parts, each part
+      ``(csr_chunk [M, raw_dim], entity_codes [M], labels [M], offsets [M],
+      weights [M])`` in a deterministic order (the iterator is consumed 2-3
+      times; identical content each time).
+    - Pass 1 holds only O(N) scalar columns (codes/labels/offsets/weights)
+      — never features — and computes the reservoir split, the
+      load-balanced entity order, and the (N, D) bucket plan.
+    - For INDEX_MAP projection a stats pass accumulates per-(entity,
+      feature) moments bounded by the projector-table size
+      (``_PairStatsAccumulator``).
+    - Pass 2 scatters each part's active/passive rows straight into their
+      destination blocks; with ``blocks_dir`` those are ``np.memmap`` files
+      (bucket blocks + passive rows), so peak RSS is one part + the scalar
+      columns, not CSR + all blocks.
+
+    Always returns the bucketed representation (``num_buckets=1`` → one
+    bucket). Blocks stay float32; with ``blocks_dir`` they are numpy
+    memmaps that JAX copies to device per-bucket at solve time — the
+    caller owns the directory's lifetime.
+    """
+    # ---- pass 1: scalar columns only ------------------------------------
+    codes_parts, y_parts, off_parts, wt_parts = [], [], [], []
+    for chunk in stream_factory():
+        _, c, y, o, w = chunk
+        codes_parts.append(np.asarray(c, np.int64))
+        y_parts.append(np.asarray(y, np.float64))
+        off_parts.append(np.asarray(o, np.float32))
+        # f64 so the reservoir rescale product below is bit-identical to
+        # the in-RAM builder's (f64 weights x f64 scale, then one f32 cast)
+        wt_parts.append(np.asarray(w, np.float64))
+    if not codes_parts:
+        raise ValueError("empty random-effect stream")
+    codes = np.concatenate(codes_parts)
+    resp = np.concatenate(y_parts)
+    offs = np.concatenate(off_parts)
+    wts = np.concatenate(wt_parts)
+    del codes_parts, y_parts, off_parts, wt_parts
+    n = len(codes)
+    rng = np.random.default_rng(seed)
+
+    # identical reservoir/grouping math to the in-RAM builder (same seed →
+    # identical active sets, so the two paths are parity-testable)
+    order = np.lexsort((rng.random(n), codes))
+    sorted_codes = codes[order]
+    uniq, starts, group_sizes = np.unique(
+        sorted_codes, return_index=True, return_counts=True)
+    e_real = len(uniq)
+    grp_of_sorted = np.repeat(np.arange(e_real), group_sizes)
+    pos_in_group = np.arange(n) - starts[grp_of_sorted]
+
+    cap = config.num_active_data_points_upper_bound
+    if cap is None:
+        active_mask = np.ones(n, dtype=bool)
+        act_counts = group_sizes
+    else:
+        active_mask = pos_in_group < cap
+        act_counts = np.minimum(group_sizes, cap)
+    group_scale = group_sizes / np.maximum(act_counts, 1)
+
+    lo_b = config.num_passive_data_points_lower_bound
+    pas_counts = group_sizes - act_counts
+    keep_passive_group = (pas_counts > 0 if lo_b is None
+                          else pas_counts >= lo_b)
+    passive_mask = ~active_mask & keep_passive_group[grp_of_sorted]
+
+    # bucket plan + bucket-major balanced entity order
+    bucket_n_max, bucket_of = _bucket_plan(
+        act_counts, max(1, num_buckets), pad_rows_multiple)
+    parts = []
+    for b in range(len(bucket_n_max)):
+        idx = np.flatnonzero(bucket_of == b)
+        parts.append(idx[balanced_entity_order(
+            act_counts[idx], num_bins=max(1, entity_axis_size))])
+    kept = [(nm, p) for nm, p in zip(bucket_n_max, parts) if len(p)]
+    bucket_n_max = np.array([nm for nm, _ in kept], dtype=np.int64)
+    parts = [p for _, p in kept]
+    perm = np.concatenate(parts)
+    bucket_sizes = np.array([len(p) for p in parts], dtype=np.int64)
+    ent_codes = uniq[perm].astype(np.int64)
+    inv_perm = np.empty(e_real, dtype=np.int64)
+    inv_perm[perm] = np.arange(e_real)
+    counts = act_counts[perm]
+
+    # per-dataset-row assignments (row-indexed views of the sorted layout)
+    row_ent = np.empty(n, np.int64)
+    row_ent[order] = inv_perm[grp_of_sorted]
+    row_slot = np.empty(n, np.int32)
+    row_slot[order] = pos_in_group.astype(np.int32)
+    row_active = np.empty(n, bool)
+    row_active[order] = active_mask
+    row_passive = np.empty(n, bool)
+    row_passive[order] = passive_mask
+    n_passive = int(passive_mask.sum())
+    ppos = np.full(n, -1, np.int64)
+    ppos[order[passive_mask]] = np.arange(n_passive)
+    group_scale_perm = group_scale[perm]
+    del (order, sorted_codes, grp_of_sorted, pos_in_group, active_mask,
+         passive_mask, codes)
+
+    # ---- projector (streamed stats pass for INDEX_MAP) -------------------
+    proj_cfg = config.projector
+    projectors = None
+    random_projector = None
+    if proj_cfg.kind == ProjectorType.INDEX_MAP:
+        need_moments = (
+            config.num_features_to_keep_upper_bound is not None
+            or config.num_features_to_samples_ratio_upper_bound is not None)
+        acc = _PairStatsAccumulator(raw_dim, e_real, need_moments)
+        lo = 0
+        for chunk in stream_factory():
+            mat_c = chunk[0].tocsr()
+            m = mat_c.shape[0]
+            a = row_active[lo:lo + m]
+            acc.add(mat_c[a], row_ent[lo:lo + m][a], resp[lo:lo + m][a])
+            lo += m
+        projectors = acc.finalize(counts, config, pad_dim_multiple)
+        d_red = projectors.max_reduced_dim
+    elif proj_cfg.kind == ProjectorType.RANDOM:
+        random_projector = build_random_projector(
+            raw_dim, proj_cfg.projected_dim, seed=proj_cfg.seed)
+        d_red = proj_cfg.projected_dim
+    else:  # IDENTITY
+        d_red = raw_dim
+
+    # ---- allocate destination blocks ------------------------------------
+    b_starts = np.concatenate([[0], np.cumsum(bucket_sizes)])
+    Xs, labs, offsb, wtsb, rids, dims = [], [], [], [], [], []
+    for b in range(len(bucket_sizes)):
+        nr, n_b = int(bucket_sizes[b]), int(bucket_n_max[b])
+        start = int(b_starts[b])
+        if projectors is not None:
+            d_b = int(projectors.reduced_dims[start:start + nr].max())
+            d_b = max(1, -(-max(d_b, 1) // pad_dim_multiple)
+                      * pad_dim_multiple)
+            d_b = min(d_b, d_red)
+        else:
+            d_b = d_red
+        e_b = max(1, -(-nr // entity_axis_size) * entity_axis_size)
+        Xs.append(_alloc_rows((e_b, n_b, d_b), blocks_dir, f"bucket{b}_X"))
+        labs.append(np.zeros((e_b, n_b), np.float32))
+        offsb.append(np.zeros((e_b, n_b), np.float32))
+        wtsb.append(np.zeros((e_b, n_b), np.float32))
+        rids.append(np.full((e_b, n_b), n, np.int32))
+        dims.append(d_b)
+    p_X = (_alloc_rows((n_passive, d_red), blocks_dir, "passive_X")
+           if n_passive else None)
+    p_ent = np.zeros(n_passive, np.int32)
+    p_rows = np.zeros(n_passive, np.int32)
+    p_off = np.zeros(n_passive, np.float32)
+
+    # ---- pass 2: scatter each part into its blocks -----------------------
+    lo = 0
+    for chunk in stream_factory():
+        mat_c = chunk[0].tocsr()
+        m = mat_c.shape[0]
+        hi = lo + m
+        a = np.flatnonzero(row_active[lo:hi])
+        if len(a):
+            rows_g = (lo + a).astype(np.int64)
+            ent = row_ent[lo:hi][a]
+            slot = row_slot[lo:hi][a]
+            b_of = np.searchsorted(b_starts, ent, side="right") - 1
+            sub_a = mat_c[a]
+            for b in np.unique(b_of):
+                mask = b_of == b
+                start = int(b_starts[b])
+                nr = int(bucket_sizes[b])
+                loc = ent[mask] - start
+                sl = slot[mask]
+                n_b = int(bucket_n_max[b])
+                _fill_feature_rows(
+                    sub_a[mask], Xs[b], loc * n_b + sl,
+                    projectors, random_projector,
+                    table_ent=loc, global_ent=ent[mask],
+                    raw_indices=None if projectors is None
+                    else projectors.raw_indices[start:start + nr,
+                                                :dims[b]])
+                labs[b][loc, sl] = resp[rows_g[mask]].astype(np.float32)
+                offsb[b][loc, sl] = offs[rows_g[mask]]
+                wtsb[b][loc, sl] = (wts[rows_g[mask]]
+                                    * group_scale_perm[ent[mask]]
+                                    ).astype(np.float32)
+                rids[b][loc, sl] = rows_g[mask].astype(np.int32)
+        p = np.flatnonzero(row_passive[lo:hi])
+        if len(p):
+            rows_g = (lo + p).astype(np.int64)
+            pp = ppos[rows_g]
+            ent_p = row_ent[lo:hi][p]
+            _fill_feature_rows(
+                mat_c[p], p_X, pp,
+                projectors, random_projector,
+                table_ent=ent_p, global_ent=ent_p,
+                raw_indices=None if projectors is None
+                else projectors.raw_indices)
+            p_ent[pp] = ent_p.astype(np.int32)
+            p_rows[pp] = rows_g.astype(np.int32)
+            p_off[pp] = offs[rows_g]
+        lo = hi
+
+    on_disk = blocks_dir is not None
+    buckets = []
+    for b in range(len(bucket_sizes)):
+        if on_disk and hasattr(Xs[b], "flush"):
+            Xs[b].flush()
+        buckets.append(EntityBucket(
+            entity_start=int(b_starts[b]), num_real=int(bucket_sizes[b]),
+            X=Xs[b] if on_disk else jnp.asarray(Xs[b]),
+            labels=labs[b] if on_disk else jnp.asarray(labs[b]),
+            base_offsets=offsb[b] if on_disk else jnp.asarray(offsb[b]),
+            weights=wtsb[b] if on_disk else jnp.asarray(wtsb[b]),
+            row_ids=rids[b] if on_disk else jnp.asarray(rids[b]),
+        ))
+    if p_X is not None and on_disk and hasattr(p_X, "flush"):
+        p_X.flush()
+    return RandomEffectDataset(
+        config=config,
+        entity_codes=ent_codes,
+        X=None, labels=None, base_offsets=None, weights=None, row_ids=None,
+        num_samples=n,
+        projectors=projectors,
+        random_projector=random_projector,
+        passive_X=(None if p_X is None
+                   else (p_X if on_disk else jnp.asarray(p_X))),
+        passive_entity=(None if p_X is None
+                        else (p_ent if on_disk else jnp.asarray(p_ent))),
+        passive_row_ids=(None if p_X is None
+                         else (p_rows if on_disk else jnp.asarray(p_rows))),
+        passive_offsets=(None if p_X is None
+                         else (p_off if on_disk else jnp.asarray(p_off))),
+        buckets=buckets,
+        _reduced_dim=d_red,
+    )
+
+
+def dataset_row_stream(data: GameDataset, config:
+                       RandomEffectDataConfiguration,
+                       chunk_rows: int = 500_000):
+    """Stream factory over an in-RAM GameDataset (row chunks) — lets the
+    streamed/memmap builder run on datasets that already fit in RAM, and
+    defines the part contract for loaders that stream from disk."""
+    id_type = config.random_effect_type
+    if id_type not in data.id_columns:
+        raise KeyError(f"id type {id_type!r} not in dataset (have "
+                       f"{list(data.id_columns)})")
+
+    def factory():
+        mat = data.feature_shards[config.feature_shard_id].tocsr()
+        codes = np.asarray(data.id_columns[id_type])
+        for lo in range(0, data.num_samples, chunk_rows):
+            hi = min(lo + chunk_rows, data.num_samples)
+            yield (mat[lo:hi], codes[lo:hi], data.responses[lo:hi],
+                   data.offsets[lo:hi], data.weights[lo:hi])
+
+    return factory

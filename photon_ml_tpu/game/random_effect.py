@@ -91,31 +91,37 @@ def _fit_blocks(
     def solve_one(Xe, ye, oe, we, x0):
         batch = DenseBatch(X=Xe, labels=ye, offsets=oe, weights=we)
         if solver == "owlqn":
-            x, hist, _ = minimize_owlqn(
+            x, hist, progressed = minimize_owlqn(
                 _vg, x0, (obj, batch), l1=l1,
                 max_iter=max_iter, tolerance=tolerance)
         elif solver == "tron":
-            x, hist, _ = minimize_tron(
+            x, hist, progressed = minimize_tron(
                 _vg, _hvp, x0, (obj, batch),
                 max_iter=max_iter, tolerance=tolerance)
         else:
-            x, hist, _ = minimize_lbfgs(
+            x, hist, progressed = minimize_lbfgs(
                 _vg, x0, (obj, batch),
                 max_iter=max_iter, tolerance=tolerance)
         k = hist.num_iterations
         final_value = hist.values[k]
-        # Per-lane convergence classification (the device-side half of
-        # Optimizer.getConvergenceReason, Optimizer.scala:156-170):
-        # 0 = max-iterations, 1 = function values, 2 = gradient,
-        # 3 = stopped without tripping a criterion (not progressed).
+        # Per-lane convergence classification mirroring the HOST ordering
+        # of Optimizer.getConvergenceReason (Optimizer.scala:156-170 port,
+        # optimize/common._convergence_reason): max-iterations, then
+        # not-progressed, then function values, then gradient; the
+        # total-function fallback is FunctionValuesConverged like the host.
+        # A lane that stalls with an unchanged objective therefore reports
+        # ObjectiveNotImproving, keeping tracker counts aligned with the
+        # reference's countsByConvergence.
         fv = (k >= 1) & (
             jnp.abs(final_value - hist.values[jnp.maximum(k - 1, 0)])
             <= tolerance * jnp.abs(hist.values[0]))
         gv = hist.grad_norms[k] <= tolerance * hist.grad_norms[0]
-        code = jnp.where(k >= max_iter, CONV_MAX_ITERATIONS,
-                         jnp.where(fv, CONV_FUNCTION_VALUES,
-                                   jnp.where(gv, CONV_GRADIENT,
-                                             CONV_NOT_PROGRESSED)))
+        code = jnp.where(
+            k >= max_iter, CONV_MAX_ITERATIONS,
+            jnp.where(~progressed, CONV_NOT_PROGRESSED,
+                      jnp.where(fv, CONV_FUNCTION_VALUES,
+                                jnp.where(gv, CONV_GRADIENT,
+                                          CONV_FUNCTION_VALUES))))
         return x, k, final_value, code.astype(jnp.int8)
 
     return jax.vmap(solve_one)(X, labels, offsets, weights, initial)
@@ -193,7 +199,15 @@ class RandomEffectOptimizationProblem:
                       l1: float):
         """Per-bucket vmapped solves assembled into one compact global
         block ``[num_entities, reduced_dim]`` (entity order is bucket-major;
-        pad lanes never leave the bucket)."""
+        pad lanes never leave the bucket).
+
+        Compile-cost note: each distinct bucket shape (E_b, N_b, D_b)
+        compiles its own ``_fit_blocks`` trace, so the first sweep pays one
+        compile per bucket. The DP bucket plan is deterministic for a given
+        dataset, so shapes are stable across sweeps/processes and the
+        in-process jit cache plus the persistent XLA compile cache
+        (utils/compile_cache.py) absorb every later sweep; keep bucket
+        counts small (3-4) so the one-time cost stays bounded."""
         cfg = self.config
         e_tot, d_red = dataset.num_entities, dataset.reduced_dim
         acc = jnp.promote_types(dataset.buckets[0].X.dtype, jnp.float32)

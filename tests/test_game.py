@@ -351,6 +351,141 @@ class TestEntityBucketing:
                 latent_dim=2)
 
 
+class TestStreamedBlockBuild:
+    """Streamed / memmap-backed entity-block build
+    (build_random_effect_dataset_streamed): the single-host analog of the
+    reference's streamed shuffle into entity-major layout
+    (data/RandomEffectDataSet.scala:169-206), parity-tested against the
+    in-RAM builder."""
+
+    @staticmethod
+    def _data(rng, n=900, d=10, n_entities=21):
+        sizes = np.maximum(1, (300 / np.arange(1, n_entities + 1) ** 1.2)
+                           .astype(int))
+        users = rng.permutation(np.repeat(np.arange(n_entities), sizes))
+        n = len(users)
+        X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.4)
+        y = rng.normal(size=n)
+        data = GameDataset(responses=y,
+                           feature_shards={"s": sp.csr_matrix(X)},
+                           offsets=rng.normal(size=n) * 0.1,
+                           weights=rng.uniform(0.5, 1.5, size=n))
+        data.encode_ids("u", users)
+        return data
+
+    @staticmethod
+    def _cfg(**kw):
+        base = dict(num_active_data_points_upper_bound=16,
+                    num_passive_data_points_lower_bound=1,
+                    num_features_to_keep_upper_bound=6)
+        base.update(kw)
+        return RandomEffectDataConfiguration("u", "s", 1, **base)
+
+    def _assert_parity(self, ds_ram, ds_st):
+        assert list(ds_st.entity_codes) == list(ds_ram.entity_codes)
+        assert len(ds_st.buckets) == len(ds_ram.buckets)
+        for br, bs in zip(ds_ram.buckets, ds_st.buckets):
+            assert br.entity_start == bs.entity_start
+            assert br.num_real == bs.num_real
+            assert tuple(br.X.shape) == tuple(bs.X.shape)
+            np.testing.assert_allclose(np.asarray(bs.X), np.asarray(br.X),
+                                       rtol=1e-6, atol=1e-7)
+            np.testing.assert_array_equal(np.asarray(bs.row_ids),
+                                          np.asarray(br.row_ids))
+            np.testing.assert_allclose(np.asarray(bs.weights),
+                                       np.asarray(br.weights), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(bs.labels),
+                                       np.asarray(br.labels), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(bs.base_offsets),
+                                       np.asarray(br.base_offsets),
+                                       rtol=1e-6, atol=1e-7)
+        assert ds_st.num_passive == ds_ram.num_passive
+        if ds_ram.num_passive:
+            np.testing.assert_array_equal(
+                np.asarray(ds_st.passive_row_ids),
+                np.asarray(ds_ram.passive_row_ids))
+            np.testing.assert_array_equal(
+                np.asarray(ds_st.passive_entity),
+                np.asarray(ds_ram.passive_entity))
+            np.testing.assert_allclose(np.asarray(ds_st.passive_X),
+                                       np.asarray(ds_ram.passive_X),
+                                       rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.parametrize("projector", ["indexmap", "random", "identity"])
+    def test_streamed_matches_in_ram(self, rng, projector):
+        from photon_ml_tpu.game.dataset import (
+            build_random_effect_dataset,
+            build_random_effect_dataset_streamed,
+            dataset_row_stream,
+        )
+
+        data = self._data(rng)
+        kw = {}
+        if projector == "random":
+            kw = dict(projector=ProjectorConfig(ProjectorType.RANDOM,
+                                                projected_dim=8),
+                      num_features_to_keep_upper_bound=None)
+        elif projector == "identity":
+            kw = dict(projector=ProjectorConfig(ProjectorType.IDENTITY),
+                      num_features_to_keep_upper_bound=None)
+        cfg = self._cfg(**kw)
+        ds_ram = build_random_effect_dataset(data, cfg, num_buckets=3)
+        # chunk size deliberately misaligned with entity boundaries
+        ds_st = build_random_effect_dataset_streamed(
+            dataset_row_stream(data, cfg, chunk_rows=113), cfg,
+            raw_dim=data.shard_dim("s"), num_buckets=3)
+        self._assert_parity(ds_ram, ds_st)
+
+    def test_streamed_memmap_blocks_on_disk(self, rng, tmp_path):
+        from photon_ml_tpu.game.dataset import (
+            build_random_effect_dataset,
+            build_random_effect_dataset_streamed,
+            dataset_row_stream,
+        )
+
+        data = self._data(rng)
+        cfg = self._cfg()
+        ds_ram = build_random_effect_dataset(data, cfg, num_buckets=3)
+        ds_mm = build_random_effect_dataset_streamed(
+            dataset_row_stream(data, cfg, chunk_rows=97), cfg,
+            raw_dim=data.shard_dim("s"), num_buckets=3,
+            blocks_dir=str(tmp_path))
+        # blocks really live on disk
+        assert isinstance(ds_mm.buckets[0].X, np.memmap)
+        assert any(f.endswith(".f32") for f in
+                   __import__("os").listdir(tmp_path))
+        self._assert_parity(ds_ram, ds_mm)
+
+        # the memmap-backed dataset solves and scores like the in-RAM one
+        prob = RandomEffectOptimizationProblem(
+            config=l2_config(lam=1e-2), task=TaskType.LINEAR_REGRESSION)
+        zeros = jnp.zeros(data.num_samples, jnp.float32)
+        c_ram, *_ = prob.run(ds_ram, ds_ram.offsets_with(zeros))
+        c_mm, *_ = prob.run(ds_mm, ds_mm.offsets_with(zeros))
+        np.testing.assert_allclose(np.asarray(c_mm), np.asarray(c_ram),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(score_random_effect(ds_mm, c_mm)),
+            np.asarray(score_random_effect(ds_ram, c_ram)),
+            rtol=2e-4, atol=2e-4)
+
+    def test_streamed_single_bucket_covers_all_rows(self, rng):
+        from photon_ml_tpu.game.dataset import (
+            build_random_effect_dataset_streamed,
+            dataset_row_stream,
+        )
+
+        data = self._data(rng)
+        cfg = RandomEffectDataConfiguration("u", "s", 1)  # no caps
+        ds = build_random_effect_dataset_streamed(
+            dataset_row_stream(data, cfg, chunk_rows=101), cfg,
+            raw_dim=data.shard_dim("s"))
+        assert len(ds.buckets) == 1 and ds.num_passive == 0
+        ids = np.asarray(ds.buckets[0].row_ids).ravel()
+        real = ids[ids < data.num_samples]
+        assert sorted(real.tolist()) == list(range(data.num_samples))
+
+
 class TestEntityBucketingSolvers:
     """Bucketed solves across the full optimizer family + precision/resume
     interplay (the bucketed analog of BaseGLMIntegTest's cross-optimizer
