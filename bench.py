@@ -45,7 +45,33 @@ import os
 import sys
 import time
 
+# Quiet the XLA:CPU AOT loader's E-level tuning-flag lines: the bench opts
+# into persistent compilation caching on CPU fallbacks
+# (enable_persistent_compile_cache(allow_cpu=True)), and every cached-entry
+# load otherwise prints a multi-KB machine-feature dump that buries the
+# record's tail. Setting the env var here is too late — a site import hook
+# (PYTHONPATH sitecustomize) loads jaxlib before this line, latching the
+# C++ log threshold — so the main script re-execs itself once with the var
+# in place; imported-module uses inherit it from the parent process.
+if (__name__ == "__main__"
+        and "TF_CPP_MIN_LOG_LEVEL" not in os.environ):
+    # an operator's explicit TF_CPP_MIN_LOG_LEVEL always wins; orig_argv
+    # keeps interpreter flags (-u, -W, -X ...) across the re-exec
+    os.environ["TF_CPP_MIN_LOG_LEVEL"] = "3"
+    os.execv(sys.executable, [sys.executable, *sys.orig_argv[1:]])
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 import numpy as np
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+#: Last-known-good ON-CHIP bench record (written whenever this bench runs
+#: on a non-CPU backend; embedded, dated, in every later record so a wedged
+#: tunnel at recording time no longer erases all on-chip evidence).
+LASTGOOD_PATH = os.path.join(_REPO_DIR, "BENCH_TPU_lastgood.json")
+#: Pinned numpy-proxy baseline: measured once, then reused for
+#: ``vs_baseline`` so the headline ratio stops moving with proxy noise on
+#: degraded (CPU-fallback) runs; the live measurement is still recorded.
+PROXY_PIN_PATH = os.path.join(_REPO_DIR, "BENCH_PROXY_PINNED.json")
 
 
 def _progress(msg: str) -> None:
@@ -449,14 +475,32 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
                 task=TaskType.LOGISTIC_REGRESSION)),
     }
 
+    labels_j = jnp.asarray(data.responses, jnp.float32)
+    weights_j = jnp.asarray(data.weights, jnp.float32)
+    offsets_j = jnp.asarray(data.offsets, jnp.float32)
     t0 = time.perf_counter()
     result = run_coordinate_descent(
         coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
-        labels=jnp.asarray(data.responses, jnp.float32),
-        weights=jnp.asarray(data.weights, jnp.float32),
-        offsets=jnp.asarray(data.offsets, jnp.float32))
+        labels=labels_j, weights=weights_j, offsets=offsets_j)
     train_secs = time.perf_counter() - t0
     sweep_secs = [round(h.seconds, 2) for h in result.states]
+
+    # Compile vs steady-state attribution: re-run the identical training
+    # with every kernel already jitted at these shapes. The warm time is
+    # the steady-state cost; cold minus warm is (per-bucket-shape) compile
+    # overhead, which the persistent compile cache (enabled with
+    # allow_cpu=True in main) absorbs on later *processes* too — the
+    # warm-start economics of the reference's λ-grid
+    # (ModelTraining.scala:182-208).
+    t0 = time.perf_counter()
+    result_warm = run_coordinate_descent(
+        coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
+        labels=labels_j, weights=weights_j, offsets=offsets_j)
+    train_secs_warm = time.perf_counter() - t0
+    sweep_secs_warm = [round(h.seconds, 2) for h in result_warm.states]
+    _progress(f"glmix train cold {train_secs:.1f}s / warm "
+              f"{train_secs_warm:.1f}s (compile overhead "
+              f"{train_secs - train_secs_warm:.1f}s)")
 
     # Steady-state per-stage attribution of one RE update (everything is
     # already compiled at these shapes): offset gather (sample->entity
@@ -485,7 +529,10 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
         "re_padded_cells_vs_single_block": round(area / single_area, 3),
         "dataset_build_secs": round(build_secs, 2),
         "train_secs": round(train_secs, 2),
+        "train_secs_warm": round(train_secs_warm, 2),
+        "compile_overhead_secs": round(train_secs - train_secs_warm, 2),
         "per_update_secs": sweep_secs,
+        "per_update_secs_warm": sweep_secs_warm,
         "re_update_stage_secs": {
             "gather_offsets": round(gather_secs, 3),
             "solve": round(solve_secs, 3),
@@ -685,9 +732,66 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     }
 
 
-def _bench_ingest_isolated() -> dict:
-    """Run bench_ingest in a fresh subprocess so its peak-RSS record
-    reflects ingestion alone (the parent holds earlier benches' arrays);
+def bench_ingest_streamed(n=10_000_000, d=100_000, nnz_per_row=8,
+                          n_entities=50_000, chunk=1_000_000) -> dict:
+    """10M-row STREAMED ingestion: the same random-effect block build as
+    ``bench_ingest`` but through ``build_random_effect_dataset_streamed``
+    with memmap-backed blocks — parts are generated chunk-by-chunk and
+    scattered straight into disk-backed blocks, so peak RSS is one chunk
+    plus O(N) scalar columns instead of CSR + all padded blocks
+    (RandomEffectDataSet.scala:169-206's streamed shuffle, single-host)."""
+    import tempfile
+
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.game.dataset import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset_streamed,
+    )
+
+    def stream():
+        rng = np.random.default_rng(3)
+        for lo in range(0, n, chunk):
+            m = min(chunk, n - lo)
+            cols = np.sort(rng.integers(0, d, size=(m, nnz_per_row),
+                                        dtype=np.int32), axis=1).reshape(-1)
+            vals = rng.random(m * nnz_per_row).astype(np.float32)
+            indptr = np.arange(0, m * nnz_per_row + 1, nnz_per_row,
+                               dtype=np.int64)
+            mat = sp.csr_matrix((vals, cols, indptr), shape=(m, d))
+            mat.sum_duplicates()
+            y = rng.integers(0, 2, m).astype(np.float64)
+            codes = rng.integers(0, n_entities, m).astype(np.int64)
+            yield mat, codes, y, np.zeros(m), np.ones(m)
+
+    cfg = RandomEffectDataConfiguration(
+        random_effect_type="u", feature_shard_id="s", num_partitions=1,
+        num_active_data_points_upper_bound=32,
+        num_features_to_keep_upper_bound=64)
+    with tempfile.TemporaryDirectory() as tmp:
+        t0 = time.perf_counter()
+        ds = build_random_effect_dataset_streamed(
+            stream, cfg, raw_dim=d, entity_axis_size=8, blocks_dir=tmp)
+        re_secs = time.perf_counter() - t0
+        import resource
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        disk_bytes = sum(
+            os.path.getsize(os.path.join(tmp, f)) for f in os.listdir(tmp))
+        return {
+            "rows": n,
+            "re_build_rows_per_sec": round(n / re_secs, 0),
+            "re_blocks": [[int(s) for s in b.X.shape] for b in ds.buckets],
+            "num_passive": ds.num_passive,
+            "blocks_on_disk": True,
+            "blocks_disk_mb": round(disk_bytes / 2**20, 1),
+            "peak_rss_mb": round(peak_kb / 1024.0, 1),
+        }
+
+
+def _bench_isolated(fn_name: str, fallback, timeout: int = 900) -> dict:
+    """Run a bench function in a fresh subprocess so its peak-RSS record
+    reflects that bench alone (the parent holds earlier benches' arrays);
     falls back to in-process on any subprocess failure."""
     import subprocess
 
@@ -695,29 +799,37 @@ def _bench_ingest_isolated() -> dict:
     # override JAX_PLATFORMS and hang on a wedged accelerator tunnel
     code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
             "import json, bench; "
-            "print(json.dumps(bench.bench_ingest()))")
+            f"print(json.dumps(bench.{fn_name}()))")
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         if proc.returncode == 0:
             return json.loads(proc.stdout.strip().splitlines()[-1])
-        _progress(f"isolated ingest bench rc={proc.returncode}; "
+        _progress(f"isolated {fn_name} rc={proc.returncode}; "
                   "running in-process")
     except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
-        _progress(f"isolated ingest bench failed ({e!r}); "
-                  "running in-process")
-    return bench_ingest()
+        _progress(f"isolated {fn_name} failed ({e!r}); running in-process")
+    return fallback()
+
+
+def _bench_ingest_isolated() -> dict:
+    return _bench_isolated("bench_ingest", bench_ingest)
+
+
+def _bench_ingest_streamed_isolated() -> dict:
+    return _bench_isolated("bench_ingest_streamed", bench_ingest_streamed)
 
 
 def _ensure_live_backend(timeout_secs: int = 240, attempts: int = 2,
-                         backoff_secs: int = 30) -> None:
+                         backoff_secs: int = 30) -> bool:
     """Probe the accelerator backend (shared timed-subprocess helper in
     photon_ml_tpu.utils.backend_probe) and fall back to CPU when it hangs
     or fails — a CPU-measured record with a visible fallback marker beats
-    a bench that never prints.
+    a bench that never prints. Returns True when the run is DEGRADED (an
+    accelerator was intended but the probe failed and CPU is substituting).
 
     The probe is retried with a pause between attempts: a wedged tunnel
     grant can be reclaimed by the remote side between attempts, and an
@@ -728,30 +840,103 @@ def _ensure_live_backend(timeout_secs: int = 240, attempts: int = 2,
     )
 
     if default_platform_is_cpu():
-        return
+        return False
     for attempt in range(attempts):
         if attempt:
             _progress(f"retrying backend probe in {backoff_secs}s "
                       f"(attempt {attempt + 1}/{attempts})")
             time.sleep(backoff_secs)
         if probe_default_backend(timeout_secs, log=_progress) is not None:
-            return
+            return False
     _progress("falling back to CPU for this run")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    return True
+
+
+def _pinned_proxy(measured_evals_per_sec: float) -> dict:
+    """Load (or pin on first measurement) the numpy-proxy baseline.
+
+    Returns {"baseline_evals_per_sec": pinned, "pinned_at": iso,
+    "baseline_evals_per_sec_measured": live} — the pinned value feeds
+    ``vs_baseline`` so round-over-round comparisons of degraded runs don't
+    read proxy noise as regressions; the live value keeps the proxy
+    auditable."""
+    import datetime
+
+    # key the pin on machine identity too: a pin file traveling with the
+    # checkout to a different host must force a re-pin, never feed a
+    # machine-crossed vs_baseline ratio
+    from photon_ml_tpu.utils.compile_cache import _machine_fingerprint
+    import jax as _jax
+
+    config = (f"numpy logistic value+grad, N={N_ROWS}, D={DIM}, "
+              f"machine={_machine_fingerprint(_jax)}")
+    pinned = None
+    try:
+        with open(PROXY_PIN_PATH) as f:
+            pinned = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if (not pinned or "baseline_evals_per_sec" not in pinned
+            # a pin from a different problem shape must not feed this
+            # shape's vs_baseline — re-pin on config mismatch
+            or pinned.get("config") != config):
+        pinned = {
+            "baseline_evals_per_sec": round(measured_evals_per_sec, 2),
+            "pinned_at": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "config": config,
+        }
+        try:
+            with open(PROXY_PIN_PATH, "w") as f:
+                json.dump(pinned, f, indent=1)
+        except OSError:
+            pass
+    return {
+        "baseline_evals_per_sec": pinned["baseline_evals_per_sec"],
+        "baseline_pinned_at": pinned.get("pinned_at"),
+        "baseline_evals_per_sec_measured": round(measured_evals_per_sec, 2),
+    }
+
+
+def _load_lastgood() -> dict | None:
+    try:
+        with open(LASTGOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_lastgood(record: dict) -> None:
+    import datetime
+
+    try:
+        with open(LASTGOOD_PATH, "w") as f:
+            json.dump({
+                "recorded_at": datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(timespec="seconds"),
+                "record": record,
+            }, f, indent=1)
+        _progress(f"on-chip record saved to {LASTGOOD_PATH}")
+    except OSError as e:  # pragma: no cover
+        _progress(f"could not save last-good record: {e!r}")
 
 
 def main():
-    _ensure_live_backend()
+    degraded = _ensure_live_backend()
     # Persistent XLA compile cache (machine-fingerprinted): the tunnel's
     # remote compiles cost tens of seconds each, and the cache makes every
-    # rerun (including the driver's recording run) warm-start.
+    # rerun (including the driver's recording run) warm-start. allow_cpu:
+    # degraded CPU-fallback runs cache too, so the glmix bucket-shape
+    # compiles are paid once per machine, not once per process.
     from photon_ml_tpu.utils.compile_cache import (
         enable_persistent_compile_cache,
     )
 
-    enable_persistent_compile_cache()
+    cache_on = enable_persistent_compile_cache(allow_cpu=True)
+    _progress(f"persistent compile cache {'on' if cache_on else 'off'}")
     _progress("generating data")
     X, y, w = _data()
     _progress("numpy baseline")
@@ -784,21 +969,29 @@ def main():
     avro_ingest = bench_avro_ingest()
     _progress("ingest bench")
     ingest = _bench_ingest_isolated()
+    _progress("streamed ingest bench")
+    ingest_streamed = _bench_ingest_streamed_isolated()
     _progress("done")
 
     import jax
 
-    print(json.dumps({
+    proxy = _pinned_proxy(cpu_evals)
+    record = {
         "metric": "logistic_grad_evals_per_sec",
         "value": vg["evals_per_sec"],
         "unit": f"evals/s (N={N_ROWS}, D={DIM}, f32)",
-        "vs_baseline": round(vg["evals_per_sec"] / cpu_evals, 2),
-        "baseline_evals_per_sec": round(cpu_evals, 2),
+        "vs_baseline": round(
+            vg["evals_per_sec"] / proxy["baseline_evals_per_sec"], 2),
+        **proxy,
         # no JVM exists in this environment, so the Spark-local reference
         # cannot be measured here; the comparison point is a same-host
         # NumPy proxy of the Breeze per-core inner loop (BASELINE.md)
         "baseline_kind": "same-host numpy proxy (no JVM available)",
         "backend": jax.default_backend(),
+        # degraded: an accelerator was intended but its tunnel was wedged,
+        # so every number below is a CPU substitute — compare against the
+        # embedded tpu_lastgood block, not across degraded rounds
+        "degraded": degraded,
         "hbm_peak_gbps": peak,
         **parity,
         "value_gradient": vg,
@@ -809,7 +1002,20 @@ def main():
         "game_full": game_full,
         "avro_ingest": avro_ingest,
         "ingest": ingest,
-    }))
+        "ingest_streamed": ingest_streamed,
+    }
+    if jax.default_backend() != "cpu":
+        # This run IS on-chip evidence; save it (and don't embed a copy of
+        # itself).
+        _save_lastgood(record)
+    else:
+        lastgood = _load_lastgood()
+        if lastgood is not None:
+            # Dated last-known-good ON-CHIP record: carried in every CPU
+            # fallback output so a wedged tunnel at recording time doesn't
+            # erase on-chip history.
+            record["tpu_lastgood"] = lastgood
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

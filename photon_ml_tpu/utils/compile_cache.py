@@ -77,19 +77,25 @@ def _machine_fingerprint(jax) -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
-def enable_persistent_compile_cache() -> bool:
+def enable_persistent_compile_cache(allow_cpu: bool = False) -> bool:
     """Idempotently turn on JAX's persistent compilation cache. Returns
     whether the cache is active (False when disabled via env or the
-    backend rejects it)."""
+    backend rejects it).
+
+    ``allow_cpu=True`` keeps persistence on for CPU-pinned processes too.
+    Self-compiled XLA:CPU AOT entries reload and execute correctly on the
+    same machine (the fingerprinted directory guarantees that), but the
+    loader logs E-level lines about its own tuning-flag set
+    (prefer-no-gather/scatter) on every load — callers that opt in (the
+    bench, whose CPU-fallback glmix sweep otherwise pays ~17s of repeat
+    compiles per process) should suppress those with
+    ``TF_CPP_MIN_LOG_LEVEL=3`` before the first jax import."""
     global _enabled
     if _enabled:
         return True
     if os.environ.get("PHOTON_DISABLE_COMPILE_CACHE"):
         return False
-    # CPU-only processes skip persistence: XLA:CPU AOT reloads warn on the
-    # loader's own tuning-flag set (prefer-no-gather/scatter) even for
-    # self-compiled entries, and CPU compiles are seconds — the cache
-    # exists for the remote accelerator's tens-of-seconds compiles.
+    # CPU-only processes skip persistence by default (see allow_cpu above).
     # Known gap: a host with NO platform pin that resolves to CPU by
     # default still persists — resolving the real backend here would force
     # the init this function must avoid (see the fingerprint note below).
@@ -102,7 +108,7 @@ def enable_persistent_compile_cache() -> bool:
                      or os.environ.get("JAX_PLATFORMS", "")).strip().lower()
     except Exception:  # pragma: no cover
         platforms = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
-    if platforms.startswith("cpu"):
+    if platforms.startswith("cpu") and not allow_cpu:
         return False
     base_dir = os.environ.get("PHOTON_COMPILE_CACHE_DIR", _DEFAULT_DIR)
     try:

@@ -1,0 +1,130 @@
+"""Opportunistic on-chip bench capture.
+
+The accelerator tunnel in this environment wedges intermittently: a round
+whose single end-of-round bench lands on a wedged moment records zero
+on-chip evidence (BENCH_r03/r04 are CPU fallbacks), even though the
+tunnel may have been healthy hours earlier. This watcher inverts that:
+probe the backend cheaply on a loop, and the moment it is healthy run the
+FULL bench — ``bench.py`` itself then writes ``BENCH_TPU_lastgood.json``
+(a dated on-chip record that every later bench output embeds), so one
+healthy window anywhere in a session preserves on-chip evidence for the
+round's record regardless of the tunnel's state at recording time.
+
+Usage:
+    python tools/tpu_opportunist.py --once          # one probe+bench try
+    python tools/tpu_opportunist.py --loop 900      # probe every 15 min
+
+The probe runs in a timed subprocess (photon_ml_tpu.utils.backend_probe)
+so a wedged tunnel costs one bounded wait, never a hang. The bench run is
+skipped when the probe fails or when a fresh-enough last-good record
+already exists (--max-age, default 6h) — re-benching a healthy chip every
+loop would burn the session's device budget for no new information.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+LASTGOOD = os.path.join(_REPO, "BENCH_TPU_lastgood.json")
+
+
+def _log(msg: str) -> None:
+    print(f"[tpu-opportunist +{time.time() - _T0:7.0f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.time()
+
+
+def _lastgood_age_secs() -> float | None:
+    try:
+        return time.time() - os.path.getmtime(LASTGOOD)
+    except OSError:
+        return None
+
+
+def try_capture(probe_timeout: int, bench_timeout: int,
+                max_age_secs: float) -> bool:
+    """One probe; on health, one full bench run. True when a fresh on-chip
+    record exists afterwards."""
+    age = _lastgood_age_secs()
+    if age is not None and age < max_age_secs:
+        _log(f"last-good record is {age / 60:.0f} min old; nothing to do")
+        return True
+
+    from photon_ml_tpu.utils.backend_probe import probe_default_backend
+
+    # A CPU pin inherited from a degraded shell (JAX_PLATFORMS=cpu) must
+    # not blind the watcher: its whole job is finding the accelerator, so
+    # drop the pin for this process AND the probe/bench subprocesses that
+    # inherit our environment.
+    if os.environ.pop("JAX_PLATFORMS", None) is not None:
+        _log("dropped inherited JAX_PLATFORMS pin for probing")
+    count = probe_default_backend(probe_timeout, log=_log)
+    if count is None:
+        _log("backend unhealthy; will retry")
+        return False
+    _log(f"backend healthy ({count} device(s)) — running full bench now")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the accelerator resolve
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")],
+            env=env, cwd=_REPO, capture_output=True, text=True,
+            timeout=bench_timeout)
+    except subprocess.TimeoutExpired:
+        _log(f"bench run exceeded {bench_timeout}s; killed")
+        return False
+    if proc.returncode != 0:
+        _log(f"bench run failed rc={proc.returncode}; stderr tail:\n"
+             + "\n".join(proc.stderr.splitlines()[-8:]))
+        return False
+    try:
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        _log("bench produced no parsable record")
+        return False
+    if record.get("backend") == "cpu":
+        _log("bench fell back to CPU mid-run; no on-chip record")
+        return False
+    _log(f"on-chip bench captured: {record.get('value')} "
+         f"{record.get('unit')} (saved to {LASTGOOD})")
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--once", action="store_true",
+                    help="one probe+bench attempt, then exit")
+    ap.add_argument("--loop", type=int, metavar="SECS", default=None,
+                    help="probe every SECS seconds until an on-chip "
+                         "record is captured (then keep refreshing)")
+    ap.add_argument("--probe-timeout", type=int, default=150)
+    ap.add_argument("--bench-timeout", type=int, default=3600)
+    ap.add_argument("--max-age", type=float, default=6 * 3600.0,
+                    help="skip benching when the last-good record is "
+                         "younger than this many seconds")
+    args = ap.parse_args()
+    if args.once or args.loop is None:
+        ok = try_capture(args.probe_timeout, args.bench_timeout,
+                         args.max_age)
+        return 0 if ok else 1
+    while True:
+        try:
+            try_capture(args.probe_timeout, args.bench_timeout,
+                        args.max_age)
+        except Exception as e:  # a transient error must not kill the loop
+            _log(f"capture attempt failed ({e!r}); continuing")
+        time.sleep(args.loop)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
