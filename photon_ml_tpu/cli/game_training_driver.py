@@ -192,6 +192,25 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    help="snapshot coordinate states after each CD sweep "
                         "and auto-resume from the latest snapshot "
                         "(single-grid-point runs only)")
+    # Multi-host (multi-controller jax.distributed) execution: launch this
+    # same driver once per host; each process ingests only its own share
+    # of the avro part files (cli/game/training/Driver.scala:642-726 — the
+    # driver IS the cluster program).
+    p.add_argument("--num-processes", type=int, default=1,
+                   help="total multi-host processes (1 = single-process)")
+    p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0's jax.distributed "
+                        "coordination service (required when "
+                        "--num-processes > 1)")
+    p.add_argument("--coordinator-timeout", type=int, default=60,
+                   help="seconds to wait for the cluster to form before "
+                        "failing fast (jax.distributed initialization "
+                        "timeout)")
+    p.add_argument("--heartbeat-timeout", type=int, default=100,
+                   help="seconds without a peer heartbeat before the "
+                        "cluster declares that process dead and errors "
+                        "pending collectives")
     return p.parse_args(argv)
 
 
@@ -536,9 +555,109 @@ class GameTrainingDriver:
         return best_result
 
 
+def _run_multihost(ns: argparse.Namespace) -> None:
+    """Multi-host GAME training: route to the jax.distributed worker.
+
+    Every process runs this same CLI with its own ``--process-id``; part
+    files are round-robin split across processes so no process ever reads
+    another's rows. Feature maps must be PRE-BUILT
+    (--feature-name-and-term-set-path or --offheap-indexmap-dir) so all
+    processes hold identical maps — the reference does the same with its
+    standalone FeatureIndexingJob for large feature spaces.
+    """
+    from photon_ml_tpu.parallel.multihost import run_game_worker
+    from photon_ml_tpu.utils.date_range import resolve_input_paths
+
+    if not ns.coordinator:
+        raise ValueError(
+            "--coordinator host:port is required with --num-processes > 1")
+    if not (ns.feature_name_and_term_set_path
+            or getattr(ns, "offheap_indexmap_dir", None)):
+        raise ValueError(
+            "multi-host mode needs pre-built feature maps: pass "
+            "--feature-name-and-term-set-path or --offheap-indexmap-dir "
+            "(every process must hold identical maps)")
+    os.makedirs(ns.output_dir, exist_ok=True)
+    driver = GameTrainingDriver(ns, logger=PhotonLogger(
+        os.path.join(ns.output_dir,
+                     f"game-training.p{ns.process_id}.log"), echo=False))
+    try:
+        driver.prepare_feature_maps()
+        fixed_ids = [c for c in driver.updating_sequence
+                     if c in driver.fixed_data_configs]
+        re_ids = [c for c in driver.updating_sequence
+                  if c in driver.random_data_configs]
+        if (len(fixed_ids) != 1 or len(re_ids) != 1
+                or driver.factored_grid != [{}]):
+            raise ValueError(
+                "multi-host mode currently supports exactly one fixed + "
+                "one random-effect coordinate (no factored coordinates)")
+        if len(driver.fixed_opt_grid) > 1 or len(driver.random_opt_grid) > 1:
+            raise ValueError("multi-host mode supports a single grid point")
+        f_cid, r_cid = fixed_ids[0], re_ids[0]
+        f_opt = driver.fixed_opt_grid[0].get(
+            f_cid, GLMOptimizationConfiguration())
+        r_opt = driver.random_opt_grid[0].get(
+            r_cid, GLMOptimizationConfiguration())
+
+        # expand dirs to part files, then round-robin by process id
+        paths = resolve_input_paths(
+            ns.train_input_dirs, ns.train_date_range,
+            ns.train_date_range_days_ago)
+        files = []
+        for p in sorted(paths):
+            if os.path.isdir(p):
+                from photon_ml_tpu.io.avro import list_avro_parts
+
+                files.extend(list_avro_parts(p))
+            else:
+                files.append(p)
+        local_files = sorted(files)[ns.process_id::ns.num_processes]
+        if not local_files:
+            raise ValueError(
+                f"process {ns.process_id} received no part files "
+                f"({len(files)} file(s) across {ns.num_processes} "
+                "processes)")
+        driver.logger.info(
+            f"process {ns.process_id}/{ns.num_processes}: "
+            f"{len(local_files)} of {len(files)} part file(s)")
+
+        result = run_game_worker(
+            ns.process_id, ns.num_processes, ns.coordinator, local_files,
+            driver.section_keys, driver.index_maps,
+            (f_cid, driver.fixed_data_configs[f_cid], f_opt),
+            (r_cid, driver.random_data_configs[r_cid], r_opt),
+            driver.task, num_iterations=ns.num_iterations,
+            num_buckets=max(1, int(ns.random_effect_block_buckets)),
+            initialization_timeout=ns.coordinator_timeout,
+            heartbeat_timeout=ns.heartbeat_timeout)
+
+        re_table = result["random_effect"][r_cid]
+        ids = sorted(re_table)
+        np.savez(
+            os.path.join(ns.output_dir,
+                         f"multihost_result.p{ns.process_id}.npz"),
+            fixed=result["fixed"][f_cid],
+            objective=np.asarray(result["objective"]),
+            re_ids=np.asarray(ids),
+            re_coefs=(np.stack([re_table[i] for i in ids])
+                      if ids else np.zeros((0, 0))))
+        print(f"MULTIHOST_GAME_OK process={ns.process_id} "
+              f"of={ns.num_processes} devices={result['global_devices']} "
+              f"rows={result['rows_global']} "
+              f"objective={result['objective']:.6f}", flush=True)
+    except Exception as e:
+        driver.logger.error(f"multi-host GAME training failed: {e}")
+        raise
+    finally:
+        driver.logger.close()
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     enable_persistent_compile_cache()
     ns = parse_args(argv if argv is not None else sys.argv[1:])
+    if ns.num_processes > 1:
+        return _run_multihost(ns)
     driver = GameTrainingDriver(ns)
     try:
         driver.run()

@@ -120,6 +120,335 @@ def run_worker(process_id: int, num_processes: int, coordinator: str,
     jax.distributed.shutdown()
 
 
+# ---------------------------------------------------------------------------
+# Host-data exchange helpers (the broadcast/shuffle analog for host metadata)
+# ---------------------------------------------------------------------------
+
+
+def allgather_ragged(arr: np.ndarray) -> list[np.ndarray]:
+    """All processes exchange a 1-D (or row-major) numeric array of
+    process-dependent length; returns the per-process arrays in process
+    order. Pads to the global max length and rides two device allgathers
+    (jax.experimental.multihost_utils.process_allgather) — the host-side
+    analog of the reference's driver↔executor metadata collects."""
+    from jax.experimental import multihost_utils as mhu
+
+    arr = np.ascontiguousarray(arr)
+    n = np.asarray([arr.shape[0]], dtype=np.int64)
+    ns = np.asarray(mhu.process_allgather(n)).reshape(-1)
+    cap = int(ns.max()) if len(ns) else 0
+    pad = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+    pad[: arr.shape[0]] = arr
+    g = np.asarray(mhu.process_allgather(pad))
+    return [g[p, : int(ns[p])] for p in range(len(ns))]
+
+
+def allgather_strings(strings: np.ndarray) -> list[np.ndarray]:
+    """Exchange per-process string arrays (object/str dtype) across all
+    processes via a null-separated uint8 buffer."""
+    joined = "\x00".join(str(s) for s in strings)
+    buf = np.frombuffer(joined.encode("utf-8"), dtype=np.uint8)
+    counts = allgather_ragged(
+        np.asarray([len(strings)], dtype=np.int64))
+    bufs = allgather_ragged(buf)
+    out = []
+    for c, b in zip(counts, bufs):
+        k = int(c[0])
+        if k == 0:
+            out.append(np.zeros(0, dtype=object))
+            continue
+        decoded = bytes(b).decode("utf-8").split("\x00")
+        assert len(decoded) == k, (len(decoded), k)
+        out.append(np.asarray(decoded, dtype=object))
+    return out
+
+
+def allgather_csr(mat) -> list:
+    """Exchange per-process CSR row blocks; returns per-process matrices
+    (same column dimension) in process order."""
+    import scipy.sparse as sp
+
+    lens = np.diff(mat.indptr).astype(np.int64)
+    lens_g = allgather_ragged(lens)
+    idx_g = allgather_ragged(np.asarray(mat.indices, np.int64))
+    dat_g = allgather_ragged(np.asarray(mat.data, np.float64))
+    out = []
+    for ln, ix, dv in zip(lens_g, idx_g, dat_g):
+        indptr = np.concatenate([[0], np.cumsum(ln)])
+        out.append(sp.csr_matrix(
+            (dv, ix.astype(np.int32), indptr),
+            shape=(len(ln), mat.shape[1])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-host GAME training (fixed + random effect)
+# ---------------------------------------------------------------------------
+
+#: Pad-row entity id: never collides with data ids (and must not contain
+#: the "\x00" separator allgather_strings joins on); its coefficient row
+#: is dropped from results.
+_PAD_ENTITY = "\x01__pad__\x01"
+
+
+def run_game_worker(
+    process_id: int,
+    num_processes: int,
+    coordinator: str,
+    train_paths,
+    feature_shard_sections: dict,
+    index_maps: dict,
+    fixed_coordinate: tuple,
+    random_coordinate: tuple,
+    task,
+    num_iterations: int = 1,
+    num_buckets: int = 1,
+    initialization_timeout: int = 60,
+    heartbeat_timeout: int = 100,
+) -> dict:
+    """One multi-host GAME training process: fixed + random effect CD.
+
+    The cluster-program analog of the reference's GAME training driver
+    (cli/game/training/Driver.scala:642-726 — the driver IS the cluster
+    program): every host runs this same function with ITS OWN avro part
+    files (``train_paths``), and the global batch exists only as a mesh-
+    sharded array.
+
+    Data movement per axis:
+    - **Fixed-effect rows never leave their process.** Each process feeds
+      its local (padded) row range into the global mesh via
+      ``jax.make_array_from_callback``; the L-BFGS fit runs through the
+      shard_map+psum backend over all hosts' devices.
+    - **Scalar columns and the (narrow) random-effect shard are
+      host-allgathered**, then every process builds the identical padded
+      entity blocks and runs the identical deterministic vmapped RE solve —
+      replicated compute in place of the reference's entity-partitioned
+      executors. Scaling the RE solve's entity axis across processes (the
+      sharded-blocks path proven by tests/test_multichip.py) is wired for
+      single-controller meshes; multi-controller entity sharding rides the
+      same layout and is the natural next step.
+
+    ``fixed_coordinate`` = (coord_id, FixedEffectDataConfiguration,
+    GLMOptimizationConfiguration); ``random_coordinate`` likewise with a
+    RandomEffectDataConfiguration. Returns a dict with the fixed
+    coefficients, per-entity RE coefficients keyed by raw entity id, and
+    the final objective — identical on every process.
+    """
+    import jax
+
+    from photon_ml_tpu.utils.backend_probe import default_platform_is_cpu
+
+    if default_platform_is_cpu():
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_ml_tpu.data.batch import DenseBatch
+    from photon_ml_tpu.game.dataset import (
+        GameDataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+        score_random_effect,
+    )
+    from photon_ml_tpu.io.data_format import load_game_dataset_avro
+    from photon_ml_tpu.ops.losses import get_loss
+    from photon_ml_tpu.optimize.config import TASK_LOSS_NAME
+    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=initialization_timeout,
+        heartbeat_timeout_seconds=heartbeat_timeout)
+    # Fault-injection hook for the committed failure-path tests: a worker
+    # that dies mid-run (after joining the cluster, before any collective)
+    # must surface as a bounded coordination error on the survivors, not a
+    # hang — Spark's task-failure semantics analog (SURVEY §5.3).
+    import os as _os
+
+    if _os.environ.get("PHOTON_MH_TEST_EXIT_AFTER_INIT") == str(process_id):
+        _os._exit(17)
+    try:
+        return _game_worker_body(
+            jax, jnp, NamedSharding, P, DenseBatch, GameDataset,
+            build_random_effect_dataset, RandomEffectOptimizationProblem,
+            score_random_effect, load_game_dataset_avro, get_loss,
+            TASK_LOSS_NAME, GLMOptimizationProblem, run_glm_shard_map,
+            DATA_AXIS, make_mesh,
+            process_id, num_processes, train_paths,
+            feature_shard_sections, index_maps, fixed_coordinate,
+            random_coordinate, task, num_iterations, num_buckets)
+    finally:
+        jax.distributed.shutdown()
+
+
+def _game_worker_body(
+        jax, jnp, NamedSharding, P, DenseBatch, GameDataset,
+        build_random_effect_dataset, RandomEffectOptimizationProblem,
+        score_random_effect, load_game_dataset_avro, get_loss,
+        TASK_LOSS_NAME, GLMOptimizationProblem, run_glm_shard_map,
+        DATA_AXIS, make_mesh,
+        process_id, num_processes, train_paths, feature_shard_sections,
+        index_maps, fixed_coordinate, random_coordinate, task,
+        num_iterations, num_buckets):
+    devs = jax.devices()
+    n_local = len(jax.local_devices())
+    mesh = make_mesh(num_data=len(devs), num_entity=1, devices=devs)
+
+    f_cid, f_data_cfg, f_opt_cfg = fixed_coordinate
+    r_cid, r_data_cfg, r_opt_cfg = random_coordinate
+    id_type = r_data_cfg.random_effect_type
+
+    # ---- local ingestion: ONLY this process's part files -----------------
+    local = load_game_dataset_avro(
+        list(train_paths), feature_shard_sections, index_maps,
+        id_types=[id_type], response_required=True)
+    n_loc = local.num_samples
+    raw_ids_loc = local.id_vocabs[id_type][local.id_columns[id_type]]
+
+    # ---- padded canonical sample layout ----------------------------------
+    # Every process pads its row range to the same L (multiple of the
+    # per-process device count) so contiguous data-axis shards of [P*L]
+    # rows fall entirely inside one process; pad rows carry weight 0. The
+    # layout requires UNIFORM local device counts — verify instead of
+    # silently computing mismatched L's and wedging the collectives.
+    n_all = allgather_ragged(np.asarray([n_loc, n_local], np.int64))
+    n_per = np.asarray([int(x[0]) for x in n_all])
+    dev_per = np.asarray([int(x[1]) for x in n_all])
+    if not (dev_per == n_local).all():
+        raise RuntimeError(
+            f"multi-host GAME needs identical per-process device counts, "
+            f"got {dev_per.tolist()}")
+    L = int(-(-int(n_per.max()) // n_local) * n_local)
+    n_pad_total = L * num_processes
+
+    def pad_local(a, fill=0.0, dtype=np.float32):
+        out = np.full(L, fill, dtype)
+        out[:n_loc] = a
+        return out
+
+    resp_loc = pad_local(local.responses)
+    off_loc = pad_local(local.offsets)
+    wt_loc = pad_local(local.weights)
+    ids_loc = np.full(L, _PAD_ENTITY, dtype=object)
+    ids_loc[:n_loc] = raw_ids_loc
+
+    # ---- allgather scalar columns + the RE shard -------------------------
+    resp_g = np.concatenate(allgather_ragged(resp_loc))
+    off_g = np.concatenate(allgather_ragged(off_loc))
+    wt_g = np.concatenate(allgather_ragged(wt_loc))
+    ids_g = np.concatenate(allgather_strings(ids_loc))
+    re_mat_loc = local.feature_shards[r_data_cfg.feature_shard_id]
+    import scipy.sparse as sp
+
+    re_pad = sp.vstack([
+        re_mat_loc.tocsr(),
+        sp.csr_matrix((L - n_loc, re_mat_loc.shape[1]))]).tocsr()
+    re_mat_g = sp.vstack(allgather_csr(re_pad)).tocsr()
+
+    # identical global GameDataset view for the RE coordinate on every
+    # process (deterministic build → identical blocks/solves everywhere)
+    gdata = GameDataset(
+        responses=resp_g, feature_shards={"re": re_mat_g},
+        offsets=off_g.astype(np.float64), weights=wt_g.astype(np.float64))
+    gdata.encode_ids(id_type, ids_g)
+    import dataclasses as _dc
+
+    re_cfg_local = _dc.replace(r_data_cfg, feature_shard_id="re")
+    re_ds = build_random_effect_dataset(gdata, re_cfg_local,
+                                        num_buckets=num_buckets)
+    re_prob = RandomEffectOptimizationProblem(config=r_opt_cfg, task=task)
+
+    # ---- fixed-effect global batch: local rows only ----------------------
+    f_mat = local.feature_shards[f_data_cfg.feature_shard_id].tocsr()
+    X_loc = np.zeros((L, f_mat.shape[1]), np.float32)
+    X_loc[:n_loc] = f_mat.toarray()
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def to_global(loc, extra_dims=()):
+        shape = (n_pad_total,) + extra_dims
+
+        def cb(idx):
+            sl = idx[0]
+            lo = sl.start - process_id * L
+            return loc[lo:lo + (sl.stop - sl.start)]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    X_g = to_global(X_loc, (X_loc.shape[1],))
+    y_g = to_global(resp_loc)
+    w_g = to_global(wt_loc)
+    f_problem = GLMOptimizationProblem(config=f_opt_cfg, task=task)
+
+    def gather_global(x_global):
+        """Sharded global [N_pad] vector → replicated numpy on every host."""
+        from jax.experimental import multihost_utils as mhu
+
+        shards = sorted(x_global.addressable_shards,
+                        key=lambda s: s.index[0].start)
+        loc_rows = np.concatenate([np.asarray(s.data) for s in shards])
+        return np.asarray(mhu.process_allgather(loc_rows)).reshape(-1)
+
+    @jax.jit
+    def fixed_margins(X, w):
+        return X @ w
+
+    # ---- coordinate descent: fixed ⇄ random ------------------------------
+    loss = get_loss(TASK_LOSS_NAME[task])
+    scores_fixed = np.zeros(n_pad_total, np.float32)
+    scores_re = np.zeros(n_pad_total, np.float32)
+    w_fixed = None
+    re_coefs = None
+    objective = None
+    for _ in range(num_iterations):
+        # fixed update: offsets = base + RE scores (local slice only)
+        off_inj = off_loc + scores_re[process_id * L:(process_id + 1) * L]
+        batch_g = DenseBatch(X=X_g, labels=y_g,
+                             offsets=to_global(off_inj), weights=w_g)
+        model, _ = run_glm_shard_map(
+            f_problem, batch_g, mesh,
+            initial=None if w_fixed is None else jnp.asarray(w_fixed))
+        w_fixed = np.asarray(model.coefficients.means)
+        scores_fixed = gather_global(fixed_margins(X_g,
+                                                   jnp.asarray(w_fixed)))
+
+        # random-effect update: replicated deterministic solve
+        offs = re_ds.offsets_with(jnp.asarray(scores_fixed))
+        re_coefs, *_ = re_prob.run(
+            re_ds, offs,
+            initial=None if re_coefs is None else re_coefs)
+        scores_re = np.asarray(
+            score_random_effect(re_ds, re_coefs)).astype(np.float32)
+
+        total = scores_fixed + scores_re + off_g
+        li = loss.loss(jnp.asarray(total), jnp.asarray(resp_g))
+        objective = float(jnp.sum(jnp.asarray(wt_g) * li))
+        objective += float(f_problem.regularization_value(
+            jnp.asarray(w_fixed)))
+        objective += re_prob.regularization_value(re_coefs)
+
+    # drop the pad entity from the returned RE table
+    vocab = gdata.id_vocabs[id_type]
+    keep = np.asarray([vocab[int(c)] != _PAD_ENTITY
+                       for c in re_ds.entity_codes])
+    re_table = {
+        str(vocab[int(code)]): np.asarray(re_coefs[i])
+        for i, code in enumerate(re_ds.entity_codes) if keep[i]}
+    return {
+        "fixed": {f_cid: w_fixed},
+        "random_effect": {r_cid: re_table},
+        "objective": objective,
+        "num_processes": num_processes,
+        "global_devices": len(devs),
+        "rows_global": int(n_per.sum()),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="photon-ml-tpu multi-host shard_map demo worker")

@@ -14,6 +14,7 @@ import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 
@@ -23,22 +24,29 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_fit():
-    port = _free_port()
+def _worker_env(n_devices: int = 4) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     flags = [f for f in env.get("XLA_FLAGS", "").split()
              if not f.startswith("--xla_force_host_platform_device_count")]
-    flags.append("--xla_force_host_platform_device_count=4")
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
     env["XLA_FLAGS"] = " ".join(flags)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return env
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_distributed_fit():
+    port = _free_port()
+    env = _worker_env(4)
 
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "photon_ml_tpu.parallel.multihost",
              "--process-id", str(i), "--num-processes", "2",
              "--coordinator", f"127.0.0.1:{port}"],
-            env=env, cwd=repo, text=True,
+            env=env, cwd=_REPO, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
         for i in range(2)
     ]
@@ -56,3 +64,257 @@ def test_two_process_distributed_fit():
         assert rc == 0, (f"worker {i} rc={rc}\nstdout:\n{out}\n"
                          f"stderr:\n{err}")
         assert f"PARITY_OK process={i} devices=8" in out, out
+
+
+# ---------------------------------------------------------------------------
+# Multi-host GAME training through the real CLI driver
+# ---------------------------------------------------------------------------
+
+_GAME_SCHEMA = {
+    "name": "GameRecord", "type": "record", "namespace": "t",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+
+def _write_game_part(path, n, n_users, d_g, d_u, seed):
+    """One avro part file of GAME records (same true model across parts)."""
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro import write_container
+
+    schema = dict(_GAME_SCHEMA)
+    schema["fields"] = schema["fields"] + [
+        {"name": "globalFeatures",
+         "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "userFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+    ]
+    rng = np.random.default_rng(seed)
+    w_rng = np.random.default_rng(777)
+    w_g = w_rng.normal(size=d_g)
+    W_u = w_rng.normal(size=(n_users, d_u))
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        xg = rng.normal(size=d_g)
+        xu = rng.normal(size=d_u)
+        margin = xg @ w_g + xu @ W_u[u]
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append({
+            "uid": f"s{seed}_{i}", "response": y, "offset": None,
+            "weight": None, "metadataMap": {"userId": f"user{u}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(xg[j])}
+                               for j in range(d_g)],
+            "userFeatures": [{"name": f"u{j}", "term": "",
+                              "value": float(xu[j])}
+                             for j in range(d_u)],
+        })
+    write_container(path, schema, records)
+
+
+def _game_cli_args(data_dir, out_dir, feature_set_dir, num_iterations=2):
+    return [
+        "--train-input-dirs", data_dir,
+        "--output-dir", out_dir,
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--feature-name-and-term-set-path", feature_set_dir,
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:globalFeatures|user:userFeatures",
+        "--updating-sequence", "g,u",
+        "--num-iterations", str(num_iterations),
+        "--fixed-effect-data-configurations", "g:global,1",
+        "--fixed-effect-optimization-configurations",
+        "g:60,1e-9,0.1,1.0,LBFGS,L2",
+        "--random-effect-data-configurations",
+        "u:userId,user,1,-,-,-,identity",
+        "--random-effect-optimization-configurations",
+        "u:60,1e-9,0.5,1.0,LBFGS,L2",
+        "--model-output-mode", "NONE",
+    ]
+
+
+class TestMultihostGameDriver:
+    """2-process GAME training via the real CLI (fixed + random effect) on
+    SPLIT part files, parity vs the single-process driver — the
+    Driver.scala:642-726 cluster-program analog."""
+
+    @pytest.fixture(scope="class")
+    def fixture_dirs(self, tmp_path_factory):
+        root = tmp_path_factory.mktemp("mh_game")
+        data_dir = root / "data"
+        data_dir.mkdir()
+        # two part files, different rows, same true model
+        _write_game_part(str(data_dir / "part-00000.avro"),
+                         n=180, n_users=6, d_g=5, d_u=3, seed=10)
+        _write_game_part(str(data_dir / "part-00001.avro"),
+                         n=140, n_users=6, d_g=5, d_u=3, seed=11)
+        # pre-built feature sets over ALL parts (identical on every
+        # process — the FeatureIndexingJob analog)
+        from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+        sets = NameAndTermFeatureSets.from_paths(
+            [str(data_dir)], ["globalFeatures", "userFeatures"])
+        fs_dir = root / "feature_sets"
+        sets.save(str(fs_dir))
+        return str(data_dir), str(fs_dir), root
+
+    def test_cli_two_process_parity_vs_single(self, fixture_dirs):
+        data_dir, fs_dir, root = fixture_dirs
+
+        # -- single-process reference (in-process driver run) -------------
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            parse_args,
+        )
+
+        single_out = str(root / "single_out")
+        driver = GameTrainingDriver(parse_args(
+            _game_cli_args(data_dir, single_out, fs_dir)))
+        result = driver.run()
+        fixed_ref = np.asarray(
+            result.model.models["g"].coefficients.means)
+        re_model = result.model.models["u"]
+        if hasattr(re_model, "to_raw"):  # projected-space wrapper
+            re_model = re_model.to_raw()
+        vocab = driver.train_data.id_vocabs["userId"]
+        re_ref = {str(vocab[int(c)]): np.asarray(re_model.coefficients[i])
+                  for i, c in enumerate(re_model.entity_codes)}
+
+        # -- 2-process CLI run on split part files -------------------------
+        port = _free_port()
+        mh_out = str(root / "mh_out")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "photon_ml_tpu.cli.game_training_driver",
+                 *_game_cli_args(data_dir, mh_out, fs_dir),
+                 "--num-processes", "2", "--process-id", str(i),
+                 "--coordinator", f"127.0.0.1:{port}"],
+                env=_worker_env(4), cwd=_REPO, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            for i in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=420)
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        for i, (rc, out, err) in enumerate(outs):
+            assert rc == 0, (f"worker {i} rc={rc}\nstdout:\n{out}\n"
+                             f"stderr:\n{err}")
+            assert f"MULTIHOST_GAME_OK process={i}" in out, out
+            assert "devices=8" in out, out
+
+        # every process wrote an identical result record
+        recs = [np.load(os.path.join(mh_out, f"multihost_result.p{i}.npz"),
+                        allow_pickle=False) for i in range(2)]
+        np.testing.assert_allclose(recs[0]["fixed"], recs[1]["fixed"],
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(recs[0]["re_coefs"],
+                                   recs[1]["re_coefs"],
+                                   rtol=1e-6, atol=1e-7)
+
+        # parity vs the single-process driver
+        np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
+                                   rtol=5e-3, atol=5e-3)
+        ids = [str(s) for s in recs[0]["re_ids"]]
+        assert sorted(ids) == sorted(re_ref)
+        for i, rid in enumerate(ids):
+            np.testing.assert_allclose(recs[0]["re_coefs"][i], re_ref[rid],
+                                       rtol=5e-3, atol=5e-3)
+
+
+class TestMultihostFailurePaths:
+    """Failure semantics of the multi-host driver: a missing peer or a
+    mid-run worker death must surface as a bounded, clean error — never a
+    hang (the Spark task-failure analog, SURVEY §5.3)."""
+
+    def test_coordinator_unreachable_times_out_cleanly(self):
+        # nobody ever serves this port; worker 1 of 2 must fail fast
+        port = _free_port()
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.distributed.initialize("
+            f"'127.0.0.1:{port}', 2, 1, initialization_timeout=10)\n"
+            "print('UNEXPECTED: init returned')\n")
+        import time
+
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=_worker_env(2), cwd=_REPO,
+            text=True, capture_output=True, timeout=120)
+        assert proc.returncode != 0, proc.stdout
+        assert "UNEXPECTED" not in proc.stdout
+        # bounded: the 10s init timeout plus overhead, not a hang
+        assert time.time() - t0 < 100
+
+    def test_worker_death_errors_survivor_within_bound(self, tmp_path):
+        """Process 1 joins the cluster then dies (fault injection); the
+        surviving process's pending work must ERROR within the heartbeat
+        bound, not hang."""
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        _write_game_part(str(data_dir / "part-00000.avro"),
+                         n=60, n_users=4, d_g=3, d_u=2, seed=20)
+        _write_game_part(str(data_dir / "part-00001.avro"),
+                         n=60, n_users=4, d_g=3, d_u=2, seed=21)
+        from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+        sets = NameAndTermFeatureSets.from_paths(
+            [str(data_dir)], ["globalFeatures", "userFeatures"])
+        fs_dir = tmp_path / "fs"
+        sets.save(str(fs_dir))
+
+        port = _free_port()
+        import time
+
+        t0 = time.time()
+        procs = []
+        for i in range(2):
+            env = _worker_env(2)
+            # worker 1 exits (rc 17) right after joining the cluster
+            env["PHOTON_MH_TEST_EXIT_AFTER_INIT"] = "1"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "photon_ml_tpu.cli.game_training_driver",
+                 *_game_cli_args(str(data_dir), str(tmp_path / "out"),
+                                 str(fs_dir), num_iterations=1),
+                 "--num-processes", "2", "--process-id", str(i),
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--heartbeat-timeout", "10"],
+                env=env, cwd=_REPO, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=240)
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        elapsed = time.time() - t0
+        # the injected death exits 17; the survivor must FAIL (nonzero),
+        # not succeed on partial data and not hang past the bound
+        assert outs[1][0] == 17, outs[1]
+        assert outs[0][0] not in (0, None), (
+            f"survivor unexpectedly succeeded:\n{outs[0][1]}")
+        assert "MULTIHOST_GAME_OK" not in outs[0][1]
+        assert elapsed < 200, f"survivor took {elapsed:.0f}s (hang?)"
