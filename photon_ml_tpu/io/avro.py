@@ -688,6 +688,24 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
     while dec.pos < len(buf):
         count = dec.read_long()
         size = dec.read_long()
+        # Corrupt varints must raise, never mis-frame: a negative size
+        # would walk dec.pos BACKWARDS (non-terminating loop), a size past
+        # EOF would silently clamp the payload slice, and a negative count
+        # would silently skip the block (the decode contract of
+        # avro/AvroUtils.scala:54 — clean raise, never wrong data).
+        if count < 0 or size < 0 or dec.pos + size > len(buf):
+            raise ValueError(
+                f"{path}: corrupt block header (count={count}, "
+                f"size={size}, {len(buf) - dec.pos} bytes left)")
+        if count > size and count > 1_000_000:
+            # every record decodes >= 0 bytes, so for non-degenerate
+            # schemas count can't exceed the payload size; the extra
+            # million-record allowance keeps legal zero-byte-record
+            # containers readable while a hostile 2^61 count can no
+            # longer spin the decode loop into an OOM
+            raise ValueError(
+                f"{path}: implausible block count {count} for "
+                f"{size}-byte payload")
         data = buf[dec.pos:dec.pos + size]
         dec.pos += size
         if codec == "deflate":
@@ -697,8 +715,14 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
         bdec = BinaryDecoder(data)
         for _ in range(count):
             append(reader(bdec))
-        assert buf[dec.pos:dec.pos + SYNC_SIZE] == sync, \
-            f"{path}: sync marker mismatch (corrupt block)"
+        if bdec.pos != len(data):
+            raise ValueError(
+                f"{path}: block decoded {bdec.pos} of {len(data)} bytes "
+                f"for {count} records (corrupt count or payload)")
+        if buf[dec.pos:dec.pos + SYNC_SIZE] != sync:
+            # a plain raise, not an assert: -O must not disable framing
+            # validation
+            raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
         dec.pos += SYNC_SIZE
     return schema, records
 

@@ -146,14 +146,17 @@ def allgather_ragged(arr: np.ndarray) -> list[np.ndarray]:
 def allgather_strings(strings: np.ndarray) -> list[np.ndarray]:
     """Exchange per-process string arrays (object/str dtype) across all
     processes via a null-separated uint8 buffer."""
+    from jax.experimental import multihost_utils as mhu
+
     joined = "\x00".join(str(s) for s in strings)
     buf = np.frombuffer(joined.encode("utf-8"), dtype=np.uint8)
-    counts = allgather_ragged(
-        np.asarray([len(strings)], dtype=np.int64))
+    # fixed-size count: one collective, not allgather_ragged's two
+    counts = np.asarray(mhu.process_allgather(
+        np.asarray([len(strings)], dtype=np.int64))).reshape(-1)
     bufs = allgather_ragged(buf)
     out = []
     for c, b in zip(counts, bufs):
-        k = int(c[0])
+        k = int(c)
         if k == 0:
             out.append(np.zeros(0, dtype=object))
             continue
@@ -234,6 +237,8 @@ def run_game_worker(
     coefficients, per-entity RE coefficients keyed by raw entity id, and
     the final objective — identical on every process.
     """
+    import os
+
     import jax
 
     from photon_ml_tpu.utils.backend_probe import default_platform_is_cpu
@@ -241,6 +246,33 @@ def run_game_worker(
     if default_platform_is_cpu():
         jax.config.update("jax_platforms", "cpu")
 
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=initialization_timeout,
+        heartbeat_timeout_seconds=heartbeat_timeout)
+    # Fault-injection hook for the committed failure-path tests: a worker
+    # that dies mid-run (after joining the cluster, before any collective)
+    # must surface as a bounded coordination error on the survivors, not a
+    # hang — Spark's task-failure semantics analog (SURVEY §5.3).
+    if os.environ.get("PHOTON_MH_TEST_EXIT_AFTER_INIT") == str(process_id):
+        os._exit(17)
+    try:
+        return _game_worker_body(
+            process_id, num_processes, train_paths,
+            feature_shard_sections, index_maps, fixed_coordinate,
+            random_coordinate, task, num_iterations, num_buckets)
+    finally:
+        jax.distributed.shutdown()
+
+
+def _game_worker_body(
+        process_id, num_processes, train_paths, feature_shard_sections,
+        index_maps, fixed_coordinate, random_coordinate, task,
+        num_iterations, num_buckets):
+    """Post-initialize body of :func:`run_game_worker` (imports deferred
+    until the distributed backend is live)."""
+    import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -260,42 +292,6 @@ def run_game_worker(
     from photon_ml_tpu.parallel.distributed import run_glm_shard_map
     from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator, num_processes=num_processes,
-        process_id=process_id,
-        initialization_timeout=initialization_timeout,
-        heartbeat_timeout_seconds=heartbeat_timeout)
-    # Fault-injection hook for the committed failure-path tests: a worker
-    # that dies mid-run (after joining the cluster, before any collective)
-    # must surface as a bounded coordination error on the survivors, not a
-    # hang — Spark's task-failure semantics analog (SURVEY §5.3).
-    import os as _os
-
-    if _os.environ.get("PHOTON_MH_TEST_EXIT_AFTER_INIT") == str(process_id):
-        _os._exit(17)
-    try:
-        return _game_worker_body(
-            jax, jnp, NamedSharding, P, DenseBatch, GameDataset,
-            build_random_effect_dataset, RandomEffectOptimizationProblem,
-            score_random_effect, load_game_dataset_avro, get_loss,
-            TASK_LOSS_NAME, GLMOptimizationProblem, run_glm_shard_map,
-            DATA_AXIS, make_mesh,
-            process_id, num_processes, train_paths,
-            feature_shard_sections, index_maps, fixed_coordinate,
-            random_coordinate, task, num_iterations, num_buckets)
-    finally:
-        jax.distributed.shutdown()
-
-
-def _game_worker_body(
-        jax, jnp, NamedSharding, P, DenseBatch, GameDataset,
-        build_random_effect_dataset, RandomEffectOptimizationProblem,
-        score_random_effect, load_game_dataset_avro, get_loss,
-        TASK_LOSS_NAME, GLMOptimizationProblem, run_glm_shard_map,
-        DATA_AXIS, make_mesh,
-        process_id, num_processes, train_paths, feature_shard_sections,
-        index_maps, fixed_coordinate, random_coordinate, task,
-        num_iterations, num_buckets):
     devs = jax.devices()
     n_local = len(jax.local_devices())
     mesh = make_mesh(num_data=len(devs), num_entity=1, devices=devs)

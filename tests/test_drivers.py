@@ -320,6 +320,149 @@ class TestGameDrivers:
         assert os.path.isdir(os.path.join(out, "best"))
 
 
+def _numpy_recompute_scores(model_dir: str, records: list[dict]) -> np.ndarray:
+    """Independent score recomputation straight from the saved model's avro
+    files and the raw input records — shares NO model/score code with the
+    driver (only the low-level avro container reader). The offline referent
+    of the reference's scoring integ test
+    (integTest/.../cli/game/scoring/DriverTest.scala).
+    """
+    from photon_ml_tpu.io.avro import read_directory
+
+    section_of_shard = {"global": ["globalFeatures"],
+                        "user": ["userFeatures"]}
+
+    def coef_map(rec):
+        return {(f["name"], f["term"]): float(f["value"])
+                for f in rec["means"]}
+
+    def margin(rec_features, coefs):
+        m = coefs.get(("(INTERCEPT)", ""), 0.0)
+        for f in rec_features:
+            m += float(f["value"]) * coefs.get((f["name"], f["term"]), 0.0)
+        return m
+
+    scores = np.zeros(len(records))
+    fixed_root = os.path.join(model_dir, "fixed-effect")
+    for name in (sorted(os.listdir(fixed_root))
+                 if os.path.isdir(fixed_root) else []):
+        shard = open(os.path.join(fixed_root, name, "id-info")
+                     ).read().split()[0]
+        _, recs = read_directory(
+            os.path.join(fixed_root, name, "coefficients"))
+        assert len(recs) == 1
+        coefs = coef_map(recs[0])
+        for i, rec in enumerate(records):
+            feats = [f for sec in section_of_shard[shard]
+                     for f in rec[sec]]
+            scores[i] += margin(feats, coefs)
+    re_root = os.path.join(model_dir, "random-effect")
+    for name in (sorted(os.listdir(re_root))
+                 if os.path.isdir(re_root) else []):
+        re_type, shard = open(
+            os.path.join(re_root, name, "id-info")).read().split()[:2]
+        _, recs = read_directory(
+            os.path.join(re_root, name, "coefficients"))
+        per_entity = {r["modelId"]: coef_map(r) for r in recs}
+        for i, rec in enumerate(records):
+            ent = (rec.get("metadataMap") or {}).get(re_type,
+                                                     rec.get(re_type))
+            coefs = per_entity.get(str(ent))
+            if coefs is None:
+                continue  # cold entity → no contribution
+            feats = [f for sec in section_of_shard[shard]
+                     for f in rec[sec]]
+            scores[i] += margin(feats, coefs)
+    return scores
+
+
+class TestScoringParitySweep:
+    """Score-vs-offline-recomputation parity at sweep breadth: the CLI
+    pipeline (train → save avro model → score via scoring driver) must
+    reproduce, element-wise, scores recomputed by plain numpy from the raw
+    avro records and the saved coefficient files. Reference analog:
+    integTest/.../cli/game/scoring/DriverTest.scala."""
+
+    VARIANTS = {
+        "fixed_only": dict(
+            updating="fixed",
+            score_sections="global:globalFeatures",
+            score_ids="",
+            extra=[]),
+        "fixed_re": dict(
+            updating="fixed,perUser",
+            extra=[
+                "--random-effect-data-configurations",
+                "perUser:userId,user,1,-,-,-,identity",
+                "--random-effect-optimization-configurations",
+                "perUser:30,1e-7,1.0,1,LBFGS,L2"]),
+        "fixed_re_projected_capped": dict(
+            updating="fixed,perUser",
+            extra=[
+                # index-map projection + active/feature caps: the saved
+                # model scatters reduced coefficients back to raw names
+                "--random-effect-data-configurations",
+                "perUser:userId,user,1,40,-,-,index_map",
+                "--random-effect-optimization-configurations",
+                "perUser:30,1e-7,1.0,1,LBFGS,L2"]),
+        "fixed_factored": dict(
+            updating="fixed,perUserFactored",
+            extra=[
+                "--random-effect-data-configurations",
+                "perUserFactored:userId,user,1,-,-,-,identity",
+                "--factored-random-effect-optimization-configurations",
+                "perUserFactored:20,1e-7,1.0,1,LBFGS,L2"
+                ":20,1e-7,0.1,1,LBFGS,L2:2,2"]),
+    }
+
+    @pytest.mark.parametrize("variant", sorted(VARIANTS))
+    def test_cli_scores_match_offline_recompute(self, tmp_path, variant):
+        from photon_ml_tpu.io.avro import read_container
+
+        cfg = self.VARIANTS[variant]
+        train = str(tmp_path / "train.avro")
+        score_in = str(tmp_path / "score.avro")
+        _make_game_avro(train, n=300, seed=30)
+        _make_game_avro(score_in, n=120, seed=31)
+        out = str(tmp_path / "out")
+        game_main([
+            "--train-input-dirs", train,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", cfg["updating"],
+            "--num-iterations", "2",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:30,1e-7,0.1,1,LBFGS,L2",
+            *cfg["extra"],
+        ])
+        best_dir = os.path.join(out, "best")
+
+        score_out = str(tmp_path / "score-out")
+        score_main([
+            "--input-data-dirs", score_in,
+            "--game-model-input-dir", best_dir,
+            "--output-dir", score_out,
+            "--feature-shard-id-to-feature-section-keys-map",
+            cfg.get("score_sections",
+                    "global:globalFeatures|user:userFeatures"),
+            "--random-effect-id-set", cfg.get("score_ids", "userId"),
+        ])
+        scored = load_scored_items(
+            os.path.join(score_out, "scores", "part-00000.avro"))
+        _, records = read_container(score_in)
+        assert len(scored) == len(records)
+        by_uid = {r["uid"]: r["predictionScore"] for r in scored}
+
+        offline = _numpy_recompute_scores(best_dir, records)
+        for i, rec in enumerate(records):
+            np.testing.assert_allclose(
+                by_uid[rec["uid"]], offline[i], rtol=2e-4, atol=2e-4,
+                err_msg=f"{variant}: row {i} uid={rec['uid']}")
+
+
 class TestOffHeapIndexMapFlow:
     """FeatureIndexingJob → --offheap-indexmap-dir consumption, both driver
     families (InputFormatFactory.scala:49-60, GAMEDriver.scala:90-97)."""
