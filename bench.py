@@ -123,6 +123,33 @@ def _roofline(bytes_per_eval: float, secs_per_eval: float,
     return out
 
 
+def _reset_peak_rss() -> None:
+    """Reset the kernel's peak-RSS watermark for THIS process. A child
+    forked from a large parent inherits the fork-moment RSS in its
+    ru_maxrss/VmHWM, so the isolated ingest subprocesses would otherwise
+    report the parent bench's ~6 GB peak instead of their own."""
+    try:
+        with open("/proc/self/clear_refs", "w") as fh:
+            fh.write("5")
+    except OSError:  # pragma: no cover - non-Linux
+        pass
+
+
+def _peak_rss_mb() -> float:
+    """Peak RSS of this process since the last _reset_peak_rss()."""
+    try:
+        with open("/proc/self/status") as fh:
+            for ln in fh:
+                if ln.startswith("VmHWM:"):
+                    return round(int(ln.split()[1]) / 1024.0, 1)
+    except OSError:  # pragma: no cover - non-Linux
+        pass
+    import resource
+
+    return round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
 def _data():
     rng = np.random.default_rng(0)
     X = rng.normal(size=(N_ROWS, DIM)).astype(np.float32)
@@ -683,6 +710,8 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     scale target)."""
     import scipy.sparse as sp
 
+    _reset_peak_rss()
+
     from photon_ml_tpu.data.batch import ell_from_csr
     from photon_ml_tpu.game.dataset import (
         GameDataset,
@@ -718,17 +747,14 @@ def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
     ds = build_random_effect_dataset(data, cfg, entity_axis_size=8)
     re_secs = time.perf_counter() - t0
     del ell
-    import resource
-
-    # peak RSS of THIS process; meaningful when the bench runs isolated in
-    # a subprocess (main() does that), where ingestion dominates the peak
-    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # peak RSS since the reset above: meaningful both isolated (main()
+    # runs this in a subprocess) and as an in-process fallback
     return {
         "rows": n,
         "ell_pack_rows_per_sec": round(n / ell_secs, 0),
         "re_build_rows_per_sec": round(n / re_secs, 0),
         "re_block": [int(s) for s in ds.X.shape],
-        "peak_rss_mb": round(peak_kb / 1024.0, 1),
+        "peak_rss_mb": _peak_rss_mb(),
     }
 
 
@@ -768,14 +794,12 @@ def bench_ingest_streamed(n=10_000_000, d=100_000, nnz_per_row=8,
         random_effect_type="u", feature_shard_id="s", num_partitions=1,
         num_active_data_points_upper_bound=32,
         num_features_to_keep_upper_bound=64)
+    _reset_peak_rss()
     with tempfile.TemporaryDirectory() as tmp:
         t0 = time.perf_counter()
         ds = build_random_effect_dataset_streamed(
             stream, cfg, raw_dim=d, entity_axis_size=8, blocks_dir=tmp)
         re_secs = time.perf_counter() - t0
-        import resource
-
-        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         disk_bytes = sum(
             os.path.getsize(os.path.join(tmp, f)) for f in os.listdir(tmp))
         return {
@@ -785,7 +809,7 @@ def bench_ingest_streamed(n=10_000_000, d=100_000, nnz_per_row=8,
             "num_passive": ds.num_passive,
             "blocks_on_disk": True,
             "blocks_disk_mb": round(disk_bytes / 2**20, 1),
-            "peak_rss_mb": round(peak_kb / 1024.0, 1),
+            "peak_rss_mb": _peak_rss_mb(),
         }
 
 

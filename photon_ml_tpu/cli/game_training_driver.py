@@ -644,6 +644,7 @@ def _run_multihost(ns: argparse.Namespace) -> None:
                       if ids else np.zeros((0, 0))))
         print(f"MULTIHOST_GAME_OK process={ns.process_id} "
               f"of={ns.num_processes} devices={result['global_devices']} "
+              f"re_entity_axis={result['re_entity_axis_devices']} "
               f"rows={result['rows_global']} "
               f"objective={result['objective']:.6f}", flush=True)
     except Exception as e:

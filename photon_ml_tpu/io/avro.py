@@ -697,21 +697,21 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
             raise ValueError(
                 f"{path}: corrupt block header (count={count}, "
                 f"size={size}, {len(buf) - dec.pos} bytes left)")
-        if count > size and count > 1_000_000:
-            # every record decodes >= 0 bytes, so for non-degenerate
-            # schemas count can't exceed the payload size; the extra
-            # million-record allowance keeps legal zero-byte-record
-            # containers readable while a hostile 2^61 count can no
-            # longer spin the decode loop into an OOM
-            raise ValueError(
-                f"{path}: implausible block count {count} for "
-                f"{size}-byte payload")
         data = buf[dec.pos:dec.pos + size]
         dec.pos += size
         if codec == "deflate":
             data = zlib.decompress(data, -15)
         elif codec != "null":
             raise ValueError(f"unsupported codec {codec!r}")
+        if count > len(data) and count > 1_000_000:
+            # every record decodes >= 0 bytes, so for non-degenerate
+            # schemas count can't exceed the DECOMPRESSED payload size;
+            # the extra million-record allowance keeps legal
+            # zero-byte-record containers readable while a hostile 2^61
+            # count can no longer spin the decode loop into an OOM
+            raise ValueError(
+                f"{path}: implausible block count {count} for "
+                f"{len(data)}-byte payload")
         bdec = BinaryDecoder(data)
         for _ in range(count):
             append(reader(bdec))

@@ -217,6 +217,8 @@ class TestMultihostGameDriver:
                              f"stderr:\n{err}")
             assert f"MULTIHOST_GAME_OK process={i}" in out, out
             assert "devices=8" in out, out
+            # the RE solve's entity axis is sharded over all 8 devices
+            assert "re_entity_axis=8" in out, out
 
         # every process wrote an identical result record
         recs = [np.load(os.path.join(mh_out, f"multihost_result.p{i}.npz"),
