@@ -171,6 +171,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "(rows, dims), cutting FLOPs/HBM on skewed entity "
                         "sizes (SURVEY hard part 1; not applied to "
                         "factored coordinates, which need one block)")
+    p.add_argument("--random-effect-blocks-dir", default=None,
+                   help="build random-effect entity blocks through the "
+                        "STREAMED builder with np.memmap destinations "
+                        "under this directory (one subdir per "
+                        "coordinate): peak host RAM stays one part plus "
+                        "O(N) scalar columns instead of CSR + all padded "
+                        "blocks; blocks page to device per solve")
     p.add_argument("--evaluator-type", default="")
     p.add_argument("--model-output-mode", default=ModelOutputMode.ALL,
                    choices=[ModelOutputMode.ALL, ModelOutputMode.BEST,
@@ -356,10 +363,26 @@ class GameTrainingDriver:
                 data_cfg = self.random_data_configs[cid]
                 opt_cfg = random_cfgs.get(
                     cid, GLMOptimizationConfiguration())
-                ds = build_random_effect_dataset(
-                    self.train_data, data_cfg,
-                    num_buckets=max(
-                        1, int(self.ns.random_effect_block_buckets)))
+                num_buckets = max(
+                    1, int(self.ns.random_effect_block_buckets))
+                if getattr(self.ns, "random_effect_blocks_dir", None):
+                    from photon_ml_tpu.game.dataset import (
+                        build_random_effect_dataset_streamed,
+                        dataset_row_stream,
+                    )
+
+                    ds = build_random_effect_dataset_streamed(
+                        dataset_row_stream(self.train_data, data_cfg),
+                        data_cfg,
+                        raw_dim=self.train_data.shard_dim(
+                            data_cfg.feature_shard_id),
+                        num_buckets=num_buckets,
+                        blocks_dir=os.path.join(
+                            self.ns.random_effect_blocks_dir, cid))
+                else:
+                    ds = build_random_effect_dataset(
+                        self.train_data, data_cfg,
+                        num_buckets=num_buckets)
                 coords[cid] = RandomEffectCoordinate(
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
@@ -630,7 +653,12 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             driver.task, num_iterations=ns.num_iterations,
             num_buckets=max(1, int(ns.random_effect_block_buckets)),
             initialization_timeout=ns.coordinator_timeout,
-            heartbeat_timeout=ns.heartbeat_timeout)
+            heartbeat_timeout=ns.heartbeat_timeout,
+            # per-process subdir: two processes must not write the same
+            # memmap files
+            blocks_dir=(os.path.join(ns.random_effect_blocks_dir,
+                                     f"{r_cid}.p{ns.process_id}")
+                        if ns.random_effect_blocks_dir else None))
 
         re_table = result["random_effect"][r_cid]
         ids = sorted(re_table)

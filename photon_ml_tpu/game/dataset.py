@@ -996,6 +996,7 @@ def build_random_effect_dataset_streamed(
     num_buckets: int = 1,
     blocks_dir: Optional[str] = None,
     pad_dim_multiple: int = 8,
+    keep_host_blocks: bool = False,
 ) -> RandomEffectDataset:
     """Random-effect blocks from STREAMED parts, optionally memmap-backed.
 
@@ -1024,7 +1025,10 @@ def build_random_effect_dataset_streamed(
     Always returns the bucketed representation (``num_buckets=1`` → one
     bucket). Blocks stay float32; with ``blocks_dir`` they are numpy
     memmaps that JAX copies to device per-bucket at solve time — the
-    caller owns the directory's lifetime.
+    caller owns the directory's lifetime. ``keep_host_blocks=True`` keeps
+    RAM-built blocks as plain numpy too (no device commit) — for callers
+    that re-shard them onto a global mesh themselves (the multi-host
+    worker must not materialize the full block set on one device first).
     """
     # ---- pass 1: scalar columns only ------------------------------------
     codes_parts, y_parts, off_parts, wt_parts = [], [], [], []
@@ -1205,20 +1209,20 @@ def build_random_effect_dataset_streamed(
             p_off[pp] = offs[rows_g]
         lo = hi
 
-    on_disk = blocks_dir is not None
+    host_blocks = blocks_dir is not None or keep_host_blocks
     buckets = []
     for b in range(len(bucket_sizes)):
-        if on_disk and hasattr(Xs[b], "flush"):
+        if host_blocks and hasattr(Xs[b], "flush"):
             Xs[b].flush()
         buckets.append(EntityBucket(
             entity_start=int(b_starts[b]), num_real=int(bucket_sizes[b]),
-            X=Xs[b] if on_disk else jnp.asarray(Xs[b]),
-            labels=labs[b] if on_disk else jnp.asarray(labs[b]),
-            base_offsets=offsb[b] if on_disk else jnp.asarray(offsb[b]),
-            weights=wtsb[b] if on_disk else jnp.asarray(wtsb[b]),
-            row_ids=rids[b] if on_disk else jnp.asarray(rids[b]),
+            X=Xs[b] if host_blocks else jnp.asarray(Xs[b]),
+            labels=labs[b] if host_blocks else jnp.asarray(labs[b]),
+            base_offsets=offsb[b] if host_blocks else jnp.asarray(offsb[b]),
+            weights=wtsb[b] if host_blocks else jnp.asarray(wtsb[b]),
+            row_ids=rids[b] if host_blocks else jnp.asarray(rids[b]),
         ))
-    if p_X is not None and on_disk and hasattr(p_X, "flush"):
+    if p_X is not None and host_blocks and hasattr(p_X, "flush"):
         p_X.flush()
     return RandomEffectDataset(
         config=config,
@@ -1228,13 +1232,13 @@ def build_random_effect_dataset_streamed(
         projectors=projectors,
         random_projector=random_projector,
         passive_X=(None if p_X is None
-                   else (p_X if on_disk else jnp.asarray(p_X))),
+                   else (p_X if host_blocks else jnp.asarray(p_X))),
         passive_entity=(None if p_X is None
-                        else (p_ent if on_disk else jnp.asarray(p_ent))),
+                        else (p_ent if host_blocks else jnp.asarray(p_ent))),
         passive_row_ids=(None if p_X is None
-                         else (p_rows if on_disk else jnp.asarray(p_rows))),
+                         else (p_rows if host_blocks else jnp.asarray(p_rows))),
         passive_offsets=(None if p_X is None
-                         else (p_off if on_disk else jnp.asarray(p_off))),
+                         else (p_off if host_blocks else jnp.asarray(p_off))),
         buckets=buckets,
         _reduced_dim=d_red,
     )

@@ -208,6 +208,7 @@ def run_game_worker(
     num_buckets: int = 1,
     initialization_timeout: int = 60,
     heartbeat_timeout: int = 100,
+    blocks_dir=None,
 ) -> dict:
     """One multi-host GAME training process: fixed + random effect CD.
 
@@ -260,7 +261,8 @@ def run_game_worker(
         return _game_worker_body(
             process_id, num_processes, train_paths,
             feature_shard_sections, index_maps, fixed_coordinate,
-            random_coordinate, task, num_iterations, num_buckets)
+            random_coordinate, task, num_iterations, num_buckets,
+            blocks_dir)
     finally:
         jax.distributed.shutdown()
 
@@ -268,7 +270,7 @@ def run_game_worker(
 def _game_worker_body(
         process_id, num_processes, train_paths, feature_shard_sections,
         index_maps, fixed_coordinate, random_coordinate, task,
-        num_iterations, num_buckets):
+        num_iterations, num_buckets, blocks_dir=None):
     """Post-initialize body of :func:`run_game_worker` (imports deferred
     until the distributed backend is live)."""
     import jax
@@ -278,7 +280,8 @@ def _game_worker_body(
     from photon_ml_tpu.data.batch import DenseBatch
     from photon_ml_tpu.game.dataset import (
         GameDataset,
-        build_random_effect_dataset,
+        build_random_effect_dataset_streamed,
+        dataset_row_stream,
     )
     from photon_ml_tpu.game.random_effect import (
         RandomEffectOptimizationProblem,
@@ -355,9 +358,15 @@ def _game_worker_body(
     import dataclasses as _dc
 
     re_cfg_local = _dc.replace(r_data_cfg, feature_shard_id="re")
-    re_ds = build_random_effect_dataset(gdata, re_cfg_local,
-                                        num_buckets=num_buckets,
-                                        entity_axis_size=len(devs))
+    # Streamed HOST-side block build (keep_host_blocks): blocks stay numpy
+    # (or memmap under blocks_dir) so sharding below goes host→devices
+    # directly — materializing the full block set on one device first
+    # would cap the dataset at single-device HBM, defeating the sharding.
+    re_ds = build_random_effect_dataset_streamed(
+        dataset_row_stream(gdata, re_cfg_local), re_cfg_local,
+        raw_dim=gdata.shard_dim("re"),
+        num_buckets=num_buckets, entity_axis_size=len(devs),
+        blocks_dir=blocks_dir, keep_host_blocks=True)
     re_prob = RandomEffectOptimizationProblem(config=r_opt_cfg, task=task)
 
     # ---- entity-axis sharding over ALL hosts' devices --------------------

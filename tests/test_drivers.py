@@ -293,6 +293,43 @@ class TestGameDrivers:
         assert len(scores) == 150 + 400  # both inputs scored
         assert all(np.isfinite(r["predictionScore"]) for r in scores)
 
+    def test_game_blocks_on_disk_matches_in_ram(self, tmp_path):
+        """--random-effect-blocks-dir routes RE block builds through the
+        streamed memmap builder; training metrics must match the in-RAM
+        path and the block files must really land on disk."""
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=300, seed=9)
+        args = [
+            "--train-input-dirs", train,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:30,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations",
+            "perUser:userId,user,1,-,-,-,identity",
+            "--random-effect-optimization-configurations",
+            "perUser:30,1e-7,1.0,1,LBFGS,L2",
+            "--model-output-mode", "NONE",
+        ]
+        out_a = str(tmp_path / "in-ram")
+        game_main(args + ["--output-dir", out_a])
+        blocks = str(tmp_path / "blocks")
+        out_b = str(tmp_path / "on-disk")
+        game_main(args + ["--output-dir", out_b,
+                          "--random-effect-blocks-dir", blocks,
+                          "--random-effect-block-buckets", "2"])
+        assert any(f.endswith(".f32")
+                   for f in os.listdir(os.path.join(blocks, "perUser")))
+        rec_a = json.loads(open(os.path.join(out_a, "metrics.json")).read())
+        rec_b = json.loads(open(os.path.join(out_b, "metrics.json")).read())
+        objs_a = [s["objective"] for s in rec_a["grid"][0]["states"]]
+        objs_b = [s["objective"] for s in rec_b["grid"][0]["states"]]
+        np.testing.assert_allclose(objs_b, objs_a, rtol=1e-4)
+
     def test_game_grid_selects_best(self, tmp_path):
         train = str(tmp_path / "train.avro")
         validate = str(tmp_path / "validate.avro")

@@ -120,7 +120,8 @@ def _write_game_part(path, n, n_users, d_g, d_u, seed):
     write_container(path, schema, records)
 
 
-def _game_cli_args(data_dir, out_dir, feature_set_dir, num_iterations=2):
+def _game_cli_args(data_dir, out_dir, feature_set_dir, num_iterations=2,
+                   optimizer="LBFGS"):
     return [
         "--train-input-dirs", data_dir,
         "--output-dir", out_dir,
@@ -132,11 +133,11 @@ def _game_cli_args(data_dir, out_dir, feature_set_dir, num_iterations=2):
         "--num-iterations", str(num_iterations),
         "--fixed-effect-data-configurations", "g:global,1",
         "--fixed-effect-optimization-configurations",
-        "g:60,1e-9,0.1,1.0,LBFGS,L2",
+        f"g:60,1e-9,0.1,1.0,{optimizer},L2",
         "--random-effect-data-configurations",
         "u:userId,user,1,-,-,-,identity",
         "--random-effect-optimization-configurations",
-        "u:60,1e-9,0.5,1.0,LBFGS,L2",
+        f"u:60,1e-9,0.5,1.0,{optimizer},L2",
         "--model-output-mode", "NONE",
     ]
 
@@ -166,7 +167,11 @@ class TestMultihostGameDriver:
         sets.save(str(fs_dir))
         return str(data_dir), str(fs_dir), root
 
-    def test_cli_two_process_parity_vs_single(self, fixture_dirs):
+    # TRON exercises the Hessian-vector psum path over the multi-host
+    # mesh (OptimizerIntegTest analog, lifted to the cluster program)
+    @pytest.mark.parametrize("optimizer", ["LBFGS", "TRON"])
+    def test_cli_two_process_parity_vs_single(self, fixture_dirs,
+                                              optimizer):
         data_dir, fs_dir, root = fixture_dirs
 
         # -- single-process reference (in-process driver run) -------------
@@ -175,9 +180,10 @@ class TestMultihostGameDriver:
             parse_args,
         )
 
-        single_out = str(root / "single_out")
+        single_out = str(root / f"single_out_{optimizer}")
         driver = GameTrainingDriver(parse_args(
-            _game_cli_args(data_dir, single_out, fs_dir)))
+            _game_cli_args(data_dir, single_out, fs_dir,
+                           optimizer=optimizer)))
         result = driver.run()
         fixed_ref = np.asarray(
             result.model.models["g"].coefficients.means)
@@ -190,12 +196,19 @@ class TestMultihostGameDriver:
 
         # -- 2-process CLI run on split part files -------------------------
         port = _free_port()
-        mh_out = str(root / "mh_out")
+        mh_out = str(root / f"mh_out_{optimizer}")
+        extra = []
+        if optimizer == "LBFGS":
+            # also exercise memmap-backed RE blocks through the multihost
+            # plumb (per-process subdirs)
+            extra = ["--random-effect-blocks-dir",
+                     str(root / "mh_blocks")]
         procs = [
             subprocess.Popen(
                 [sys.executable, "-m",
                  "photon_ml_tpu.cli.game_training_driver",
-                 *_game_cli_args(data_dir, mh_out, fs_dir),
+                 *_game_cli_args(data_dir, mh_out, fs_dir,
+                                 optimizer=optimizer), *extra,
                  "--num-processes", "2", "--process-id", str(i),
                  "--coordinator", f"127.0.0.1:{port}"],
                 env=_worker_env(4), cwd=_REPO, text=True,
@@ -219,6 +232,11 @@ class TestMultihostGameDriver:
             assert "devices=8" in out, out
             # the RE solve's entity axis is sharded over all 8 devices
             assert "re_entity_axis=8" in out, out
+        if optimizer == "LBFGS":
+            for i in range(2):
+                bdir = root / "mh_blocks" / f"u.p{i}"
+                assert any(f.endswith(".f32") for f in os.listdir(bdir)), \
+                    f"no memmap blocks for process {i}"
 
         # every process wrote an identical result record
         recs = [np.load(os.path.join(mh_out, f"multihost_result.p{i}.npz"),
