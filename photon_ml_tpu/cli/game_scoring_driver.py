@@ -65,6 +65,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    default=None,
                    help="must match the partition count the store was built "
                         "with (validated against the store's meta)")
+    # Multi-process scoring: scoring is embarrassingly parallel over part
+    # files (the reference scores per Spark partition, cli/game/scoring/
+    # Driver.scala:122-146), so N processes each score their round-robin
+    # share and write their own scores/part-<id>.avro — no coordination
+    # service needed.
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
     return p.parse_args(argv)
 
 
@@ -132,6 +139,29 @@ class GameScoringDriver:
 
         input_paths = resolve_input_paths(
             ns.input_data_dirs, ns.date_range, ns.date_range_days_ago)
+        if ns.num_processes > 1:
+            # expand dirs to part files and take this process's share;
+            # scoring is per-row, so processes need no coordination
+            if self.evaluators:
+                raise ValueError(
+                    "evaluators need the full score set; run them on the "
+                    "combined output, not under --num-processes > 1")
+            files = []
+            for p in sorted(input_paths):
+                if os.path.isdir(p):
+                    from photon_ml_tpu.io.avro import list_avro_parts
+
+                    files.extend(list_avro_parts(p))
+                else:
+                    files.append(p)
+            input_paths = sorted(files)[ns.process_id::ns.num_processes]
+            if not input_paths:
+                raise ValueError(
+                    f"process {ns.process_id} received no part files "
+                    f"({len(files)} file(s) across {ns.num_processes})")
+            self.logger.info(
+                f"process {ns.process_id}/{ns.num_processes}: scoring "
+                f"{len(input_paths)} of {len(files)} part file(s)")
         with timed_phase("prepareGameDataSet", self.logger):
             data = load_game_dataset_avro(
                 input_paths, self.section_keys, index_maps,
@@ -142,7 +172,8 @@ class GameScoringDriver:
             scores = np.asarray(model.score(data))
 
         save_scored_items(
-            os.path.join(ns.output_dir, "scores", "part-00000.avro"),
+            os.path.join(ns.output_dir, "scores",
+                         f"part-{ns.process_id:05d}.avro"),
             scores, ns.model_id or "game-model",
             uids=(data.uids if data.uids is not None else None),
             labels=(data.responses

@@ -76,7 +76,9 @@ def _make_game_avro(path, n=400, n_users=8, d_g=6, d_u=3, seed=0):
         margin = xg @ w_g + xu @ W_u[u]
         y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
         records.append({
-            "uid": f"s{i}", "response": y, "offset": None, "weight": None,
+            # seed-unique uids: multi-part fixtures must not collide
+            "uid": f"s{seed}_{i}", "response": y, "offset": None,
+            "weight": None,
             "metadataMap": {"userId": f"user{u}"},
             "globalFeatures": [{"name": f"g{j}", "term": "",
                                 "value": float(xg[j])} for j in range(d_g)],
@@ -292,6 +294,71 @@ class TestGameDrivers:
             os.path.join(score_out, "scores", "part-00000.avro"))
         assert len(scores) == 150 + 400  # both inputs scored
         assert all(np.isfinite(r["predictionScore"]) for r in scores)
+
+    def test_multiprocess_scoring_matches_single(self, tmp_path):
+        """--num-processes/--process-id on the scoring driver: each process
+        scores its round-robin share of the part files and writes its own
+        scores part; combined output equals a single-process run (scoring
+        is per-Spark-partition in the reference, Driver.scala:122-146)."""
+        data_dir = tmp_path / "parts"
+        data_dir.mkdir()
+        _make_game_avro(str(data_dir / "part-00000.avro"), n=120, seed=40)
+        _make_game_avro(str(data_dir / "part-00001.avro"), n=90, seed=41)
+        _make_game_avro(str(data_dir / "part-00002.avro"), n=70, seed=42)
+        out = str(tmp_path / "train-out")
+        game_main([
+            "--train-input-dirs", str(data_dir),
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--updating-sequence", "fixed,perUser",
+            "--num-iterations", "1",
+            "--fixed-effect-data-configurations", "fixed:global,1",
+            "--fixed-effect-optimization-configurations",
+            "fixed:20,1e-7,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations",
+            "perUser:userId,user,1",
+            "--random-effect-optimization-configurations",
+            "perUser:20,1e-7,1.0,1,LBFGS,L2",
+            "--model-output-mode", "BEST",
+        ])
+        best = os.path.join(out, "best")
+        common = [
+            "--input-data-dirs", str(data_dir),
+            "--game-model-input-dir", best,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "global:globalFeatures|user:userFeatures",
+            "--random-effect-id-set", "userId",
+        ]
+        single_out = str(tmp_path / "score-single")
+        score_main(common + ["--output-dir", single_out])
+        multi_out = str(tmp_path / "score-multi")
+        for pid in range(2):
+            score_main(common + [
+                "--output-dir", multi_out,
+                "--num-processes", "2", "--process-id", str(pid)])
+
+        def by_uid(d):
+            out = {}
+            for f in sorted(os.listdir(os.path.join(d, "scores"))):
+                for r in load_scored_items(
+                        os.path.join(d, "scores", f)):
+                    out[r["uid"]] = r["predictionScore"]
+            return out
+
+        s1, s2 = by_uid(single_out), by_uid(multi_out)
+        assert len(os.listdir(os.path.join(multi_out, "scores"))) == 2
+        assert set(s1) == set(s2) and len(s1) == 120 + 90 + 70
+        for uid, v in s1.items():
+            np.testing.assert_allclose(s2[uid], v, rtol=1e-6, atol=1e-7,
+                                       err_msg=uid)
+        # evaluators are refused under multi-process scoring
+        with pytest.raises(ValueError, match="combined output"):
+            score_main(common + [
+                "--output-dir", str(tmp_path / "score-ev"),
+                "--evaluator-type", "AUC",
+                "--num-processes", "2", "--process-id", "0"])
 
     def test_game_blocks_on_disk_matches_in_ram(self, tmp_path):
         """--random-effect-blocks-dir routes RE block builds through the
