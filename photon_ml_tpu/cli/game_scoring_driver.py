@@ -146,15 +146,19 @@ class GameScoringDriver:
                 raise ValueError(
                     "evaluators need the full score set; run them on the "
                     "combined output, not under --num-processes > 1")
-            files = []
-            for p in sorted(input_paths):
-                if os.path.isdir(p):
-                    from photon_ml_tpu.io.avro import list_avro_parts
+            if not 0 <= ns.process_id < ns.num_processes:
+                raise ValueError(
+                    f"--process-id {ns.process_id} out of range for "
+                    f"--num-processes {ns.num_processes}")
+            if parse_flag(ns.delete_output_dir_if_exists):
+                raise ValueError(
+                    "--delete-output-dir-if-exists would delete other "
+                    "processes' score parts; clear the output dir once "
+                    "before launching the processes")
+            from photon_ml_tpu.io.avro import expand_part_paths
 
-                    files.extend(list_avro_parts(p))
-                else:
-                    files.append(p)
-            input_paths = sorted(files)[ns.process_id::ns.num_processes]
+            files = expand_part_paths(input_paths)
+            input_paths = files[ns.process_id::ns.num_processes]
             if not input_paths:
                 raise ValueError(
                     f"process {ns.process_id} received no part files "

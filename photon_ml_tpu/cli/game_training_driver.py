@@ -624,18 +624,17 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             r_cid, GLMOptimizationConfiguration())
 
         # expand dirs to part files, then round-robin by process id
+        from photon_ml_tpu.io.avro import expand_part_paths
+
+        if not 0 <= ns.process_id < ns.num_processes:
+            raise ValueError(
+                f"--process-id {ns.process_id} out of range for "
+                f"--num-processes {ns.num_processes}")
         paths = resolve_input_paths(
             ns.train_input_dirs, ns.train_date_range,
             ns.train_date_range_days_ago)
-        files = []
-        for p in sorted(paths):
-            if os.path.isdir(p):
-                from photon_ml_tpu.io.avro import list_avro_parts
-
-                files.extend(list_avro_parts(p))
-            else:
-                files.append(p)
-        local_files = sorted(files)[ns.process_id::ns.num_processes]
+        files = expand_part_paths(paths)
+        local_files = files[ns.process_id::ns.num_processes]
         if not local_files:
             raise ValueError(
                 f"process {ns.process_id} received no part files "

@@ -352,6 +352,10 @@ class EntityBucket:
     base_offsets: Array  # [E_b, N_b]
     weights: Array  # [E_b, N_b] (0 = padding)
     row_ids: Array  # [E_b, N_b] int32 (num_samples = discard)
+    # When built with entity_shard=(k, K): arrays hold only rows
+    # [local_entity_offset, local_entity_offset + E_b/K) of the bucket's
+    # padded entity axis; 0 for full builds.
+    local_entity_offset: int = 0
 
 
 @dataclasses.dataclass
@@ -997,6 +1001,7 @@ def build_random_effect_dataset_streamed(
     blocks_dir: Optional[str] = None,
     pad_dim_multiple: int = 8,
     keep_host_blocks: bool = False,
+    entity_shard: Optional[tuple[int, int]] = None,
 ) -> RandomEffectDataset:
     """Random-effect blocks from STREAMED parts, optionally memmap-backed.
 
@@ -1029,6 +1034,17 @@ def build_random_effect_dataset_streamed(
     RAM-built blocks as plain numpy too (no device commit) — for callers
     that re-shard them onto a global mesh themselves (the multi-host
     worker must not materialize the full block set on one device first).
+
+    ``entity_shard=(k, K)`` builds ONLY the k-th of K contiguous
+    entity-axis slices of every bucket (the grouping/plan stays global,
+    computed from the O(N) scalar columns): bucket arrays come back with
+    leading dim ``E_b/K`` and ``EntityBucket.local_entity_offset`` set to
+    the slice start, so a multi-host worker allocates and fills just its
+    own entity range — no host ever holds another host's blocks, the
+    per-host-sharded analog of RandomEffectDataSet.scala:169-206's
+    partitioned shuffle output. Requires ``entity_axis_size`` divisible
+    by K (every bucket's padded E_b then splits evenly). Passive arrays
+    remain global.
     """
     # ---- pass 1: scalar columns only ------------------------------------
     codes_parts, y_parts, off_parts, wt_parts = [], [], [], []
@@ -1135,8 +1151,22 @@ def build_random_effect_dataset_streamed(
         d_red = raw_dim
 
     # ---- allocate destination blocks ------------------------------------
+    if entity_shard is not None:
+        shard_k, shard_count = entity_shard
+        if not 0 <= shard_k < shard_count:
+            raise ValueError(
+                f"entity_shard index {shard_k} out of range for "
+                f"{shard_count} shards")
+        if entity_axis_size % shard_count != 0:
+            raise ValueError(
+                f"entity_shard needs entity_axis_size divisible by "
+                f"{shard_count}, got {entity_axis_size}")
+    else:
+        shard_k, shard_count = 0, 1
     b_starts = np.concatenate([[0], np.cumsum(bucket_sizes)])
     Xs, labs, offsb, wtsb, rids, dims = [], [], [], [], [], []
+    # local (this shard's) entity range of each bucket: [sl_lo, sl_hi)
+    slice_lo, slice_hi = [], []
     for b in range(len(bucket_sizes)):
         nr, n_b = int(bucket_sizes[b]), int(bucket_n_max[b])
         start = int(b_starts[b])
@@ -1148,11 +1178,15 @@ def build_random_effect_dataset_streamed(
         else:
             d_b = d_red
         e_b = max(1, -(-nr // entity_axis_size) * entity_axis_size)
-        Xs.append(_alloc_rows((e_b, n_b, d_b), blocks_dir, f"bucket{b}_X"))
-        labs.append(np.zeros((e_b, n_b), np.float32))
-        offsb.append(np.zeros((e_b, n_b), np.float32))
-        wtsb.append(np.zeros((e_b, n_b), np.float32))
-        rids.append(np.full((e_b, n_b), n, np.int32))
+        e_loc = e_b // shard_count
+        slice_lo.append(shard_k * e_loc)
+        slice_hi.append((shard_k + 1) * e_loc)
+        Xs.append(_alloc_rows((e_loc, n_b, d_b), blocks_dir,
+                              f"bucket{b}_X"))
+        labs.append(np.zeros((e_loc, n_b), np.float32))
+        offsb.append(np.zeros((e_loc, n_b), np.float32))
+        wtsb.append(np.zeros((e_loc, n_b), np.float32))
+        rids.append(np.full((e_loc, n_b), n, np.int32))
         dims.append(d_b)
     p_X = (_alloc_rows((n_passive, d_red), blocks_dir, "passive_X")
            if n_passive else None)
@@ -1177,16 +1211,26 @@ def build_random_effect_dataset_streamed(
                 mask = b_of == b
                 start = int(b_starts[b])
                 nr = int(bucket_sizes[b])
-                loc = ent[mask] - start
+                if shard_count > 1:
+                    # only this shard's entity range of the bucket
+                    loc_all = ent - start
+                    mask &= ((loc_all >= slice_lo[b])
+                             & (loc_all < slice_hi[b]))
+                    if not mask.any():
+                        continue
+                loc = ent[mask] - start - slice_lo[b]
                 sl = slot[mask]
                 n_b = int(bucket_n_max[b])
+                # projector-table slice aligned with the slice-local loc
+                # (real entities only: rows past nr are pure padding)
+                tbl_lo = start + slice_lo[b]
+                tbl_hi = start + min(nr, slice_hi[b])
                 _fill_feature_rows(
                     sub_a[mask], Xs[b], loc * n_b + sl,
                     projectors, random_projector,
                     table_ent=loc, global_ent=ent[mask],
                     raw_indices=None if projectors is None
-                    else projectors.raw_indices[start:start + nr,
-                                                :dims[b]])
+                    else projectors.raw_indices[tbl_lo:tbl_hi, :dims[b]])
                 labs[b][loc, sl] = resp[rows_g[mask]].astype(np.float32)
                 offsb[b][loc, sl] = offs[rows_g[mask]]
                 wtsb[b][loc, sl] = (wts[rows_g[mask]]
@@ -1221,6 +1265,7 @@ def build_random_effect_dataset_streamed(
             base_offsets=offsb[b] if host_blocks else jnp.asarray(offsb[b]),
             weights=wtsb[b] if host_blocks else jnp.asarray(wtsb[b]),
             row_ids=rids[b] if host_blocks else jnp.asarray(rids[b]),
+            local_entity_offset=int(slice_lo[b]),
         ))
     if p_X is not None and host_blocks and hasattr(p_X, "flush"):
         p_X.flush()

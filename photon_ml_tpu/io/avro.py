@@ -745,6 +745,19 @@ def list_avro_parts(path: str) -> list[str]:
             if name.endswith(".avro")]
 
 
+def expand_part_paths(paths) -> list[str]:
+    """File-or-directory inputs → sorted list of avro part files — THE
+    shared expansion for every caller that splits work by part file (the
+    multi-process drivers must all agree on the file set)."""
+    out: list[str] = []
+    for p in sorted(paths):
+        if os.path.isdir(p):
+            out.extend(list_avro_parts(p))
+        else:
+            out.append(p)
+    return sorted(out)
+
+
 def read_directory(path: str) -> tuple[Any, list[Any]]:
     """Read all ``*.avro`` files under a directory (the reference's
     partitioned-output layout: part-*.avro shards)."""

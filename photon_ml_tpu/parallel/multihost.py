@@ -358,15 +358,19 @@ def _game_worker_body(
     import dataclasses as _dc
 
     re_cfg_local = _dc.replace(r_data_cfg, feature_shard_id="re")
-    # Streamed HOST-side block build (keep_host_blocks): blocks stay numpy
-    # (or memmap under blocks_dir) so sharding below goes host→devices
-    # directly — materializing the full block set on one device first
-    # would cap the dataset at single-device HBM, defeating the sharding.
+    # Streamed HOST-side block build, PER-HOST SHARDED: every process
+    # computes the identical global grouping/plan from the O(N) scalar
+    # columns, then allocates and fills ONLY its own contiguous entity
+    # slice of every bucket (entity_shard) — no host ever holds another
+    # host's blocks, and keep_host_blocks means nothing is committed to a
+    # single device before the global-mesh sharding below
+    # (RandomEffectDataSet.scala:169-206's partitioned shuffle output).
     re_ds = build_random_effect_dataset_streamed(
         dataset_row_stream(gdata, re_cfg_local), re_cfg_local,
         raw_dim=gdata.shard_dim("re"),
         num_buckets=num_buckets, entity_axis_size=len(devs),
-        blocks_dir=blocks_dir, keep_host_blocks=True)
+        blocks_dir=blocks_dir, keep_host_blocks=True,
+        entity_shard=(process_id, num_processes))
     re_prob = RandomEffectOptimizationProblem(config=r_opt_cfg, task=task)
 
     # ---- entity-axis sharding over ALL hosts' devices --------------------
@@ -381,14 +385,31 @@ def _game_worker_body(
 
     ent_mesh = make_mesh(num_data=1, num_entity=len(devs), devices=devs)
 
-    def to_global_ent(leaf):
-        arr = np.asarray(leaf)
+    def to_global_ent(local_arr):
+        """Global entity-sharded array from this host's LOCAL slice.
+
+        jax.devices() is process-major, so the entity-axis shard of this
+        host's devices is exactly rows [pid*E_loc, (pid+1)*E_loc) of the
+        full bucket — the range the sharded build filled; the callback is
+        only ever asked for addressable (local) shards.
+        """
+        arr = np.asarray(local_arr)
+        e_loc = arr.shape[0]
+        full = (e_loc * num_processes,) + arr.shape[1:]
+        lo = process_id * e_loc
         sh = NamedSharding(
             ent_mesh, P(*([ENTITY_AXIS] + [None] * (arr.ndim - 1))))
-        return jax.make_array_from_callback(arr.shape, sh,
-                                            lambda idx: arr[idx])
 
-    for block in (re_ds.buckets if re_ds.buckets is not None else [re_ds]):
+        def cb(idx):
+            # a replicated/size-1 entity axis yields slice(None) — use
+            # indices() so the arithmetic survives it
+            start, stop, _ = idx[0].indices(full[0])
+            return arr[(slice(start - lo, stop - lo),) + tuple(idx[1:])]
+
+        return jax.make_array_from_callback(full, sh, cb)
+
+    for block in re_ds.buckets:
+        assert block.local_entity_offset == process_id * block.X.shape[0]
         for field in ("X", "labels", "base_offsets", "weights", "row_ids"):
             setattr(block, field, to_global_ent(getattr(block, field)))
     if re_ds.passive_X is not None:

@@ -469,6 +469,47 @@ class TestStreamedBlockBuild:
             np.asarray(score_random_effect(ds_ram, c_ram)),
             rtol=2e-4, atol=2e-4)
 
+    def test_entity_sharded_slices_concatenate_to_full(self, rng):
+        """entity_shard=(k, K): the K per-shard builds hold exactly the
+        K contiguous entity slices of the full build's buckets — the
+        per-host-sharded block build no host-holds-all contract."""
+        from photon_ml_tpu.game.dataset import (
+            build_random_effect_dataset_streamed,
+            dataset_row_stream,
+        )
+
+        data = self._data(rng)
+        cfg = self._cfg()
+        K = 2
+        full = build_random_effect_dataset_streamed(
+            dataset_row_stream(data, cfg, chunk_rows=113), cfg,
+            raw_dim=data.shard_dim("s"), num_buckets=3,
+            entity_axis_size=2 * K, keep_host_blocks=True)
+        shards = [build_random_effect_dataset_streamed(
+            dataset_row_stream(data, cfg, chunk_rows=113), cfg,
+            raw_dim=data.shard_dim("s"), num_buckets=3,
+            entity_axis_size=2 * K, keep_host_blocks=True,
+            entity_shard=(k, K)) for k in range(K)]
+        for b, fb in enumerate(full.buckets):
+            for field in ("X", "labels", "base_offsets", "weights",
+                          "row_ids"):
+                whole = np.asarray(getattr(fb, field))
+                parts = [np.asarray(getattr(s.buckets[b], field))
+                         for s in shards]
+                assert all(p.shape[0] == whole.shape[0] // K
+                           for p in parts)
+                np.testing.assert_array_equal(
+                    np.concatenate(parts, axis=0), whole,
+                    err_msg=f"bucket {b} field {field}")
+            for k, s in enumerate(shards):
+                assert (s.buckets[b].local_entity_offset
+                        == k * whole.shape[0] // K)
+        # passive side stays global and identical
+        if full.num_passive:
+            for s in shards:
+                np.testing.assert_array_equal(
+                    np.asarray(s.passive_X), np.asarray(full.passive_X))
+
     def test_streamed_single_bucket_covers_all_rows(self, rng):
         from photon_ml_tpu.game.dataset import (
             build_random_effect_dataset_streamed,
