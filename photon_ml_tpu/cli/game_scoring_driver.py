@@ -95,6 +95,22 @@ class GameScoringDriver:
 
     def run(self) -> np.ndarray:
         ns = self.ns
+        if ns.num_processes > 1:
+            # validate BEFORE any destructive output-dir handling: the
+            # rmtree below would delete other processes' score parts
+            if self.evaluators:
+                raise ValueError(
+                    "evaluators need the full score set; run them on the "
+                    "combined output, not under --num-processes > 1")
+            if not 0 <= ns.process_id < ns.num_processes:
+                raise ValueError(
+                    f"--process-id {ns.process_id} out of range for "
+                    f"--num-processes {ns.num_processes}")
+            if parse_flag(ns.delete_output_dir_if_exists):
+                raise ValueError(
+                    "--delete-output-dir-if-exists would delete other "
+                    "processes' score parts; clear the output dir once "
+                    "before launching the processes")
         if os.path.isdir(ns.output_dir) and os.listdir(ns.output_dir):
             if parse_flag(ns.delete_output_dir_if_exists):
                 import shutil
@@ -142,19 +158,7 @@ class GameScoringDriver:
         if ns.num_processes > 1:
             # expand dirs to part files and take this process's share;
             # scoring is per-row, so processes need no coordination
-            if self.evaluators:
-                raise ValueError(
-                    "evaluators need the full score set; run them on the "
-                    "combined output, not under --num-processes > 1")
-            if not 0 <= ns.process_id < ns.num_processes:
-                raise ValueError(
-                    f"--process-id {ns.process_id} out of range for "
-                    f"--num-processes {ns.num_processes}")
-            if parse_flag(ns.delete_output_dir_if_exists):
-                raise ValueError(
-                    "--delete-output-dir-if-exists would delete other "
-                    "processes' score parts; clear the output dir once "
-                    "before launching the processes")
+            # (validation ran at the top of run(), before the rmtree)
             from photon_ml_tpu.io.avro import expand_part_paths
 
             files = expand_part_paths(input_paths)

@@ -610,14 +610,27 @@ def _run_multihost(ns: argparse.Namespace) -> None:
                      if c in driver.fixed_data_configs]
         re_ids = [c for c in driver.updating_sequence
                   if c in driver.random_data_configs]
-        if (len(fixed_ids) != 1 or len(re_ids) != 1
-                or driver.factored_grid != [{}]):
+        if len(fixed_ids) != 1 or len(re_ids) != 1:
             raise ValueError(
                 "multi-host mode currently supports exactly one fixed + "
-                "one random-effect coordinate (no factored coordinates)")
-        if len(driver.fixed_opt_grid) > 1 or len(driver.random_opt_grid) > 1:
+                "one random-effect coordinate (plain or factored)")
+        if (len(driver.fixed_opt_grid) > 1 or len(driver.random_opt_grid) > 1
+                or len(driver.factored_grid) > 1):
             raise ValueError("multi-host mode supports a single grid point")
         f_cid, r_cid = fixed_ids[0], re_ids[0]
+        factored_cfg = driver.factored_grid[0].get(r_cid)
+        extra_factored = set(driver.factored_grid[0]) - {r_cid}
+        if extra_factored:
+            raise ValueError(
+                f"factored configs for unknown coordinates: "
+                f"{sorted(extra_factored)}")
+        if (factored_cfg is not None
+                and int(ns.random_effect_block_buckets) > 1):
+            # fail at parse time, not after N processes rendezvous and
+            # load data (the worker re-checks defensively)
+            raise ValueError(
+                "a factored coordinate needs a single block; drop "
+                "--random-effect-block-buckets")
         f_opt = driver.fixed_opt_grid[0].get(
             f_cid, GLMOptimizationConfiguration())
         r_opt = driver.random_opt_grid[0].get(
@@ -657,7 +670,8 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             # memmap files
             blocks_dir=(os.path.join(ns.random_effect_blocks_dir,
                                      f"{r_cid}.p{ns.process_id}")
-                        if ns.random_effect_blocks_dir else None))
+                        if ns.random_effect_blocks_dir else None),
+            factored=factored_cfg)
 
         re_table = result["random_effect"][r_cid]
         ids = sorted(re_table)

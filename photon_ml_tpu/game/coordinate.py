@@ -44,6 +44,7 @@ from photon_ml_tpu.game.models import (
     FixedEffectModel,
     RandomEffectModelInProjectedSpace,
 )
+from photon_ml_tpu.parallel.mesh import host_array
 from photon_ml_tpu.game.random_effect import (
     RandomEffectOptimizationProblem,
     score_random_effect,
@@ -204,9 +205,9 @@ class RandomEffectCoordinate:
         # report only real entities: the single-block path returns
         # entity-axis PAD lanes too (the bucketed path is already compact)
         nr = len(self.dataset.entity_codes)
-        tracker = RandomEffectTracker(np.asarray(iters)[:nr],
-                                      np.asarray(values)[:nr],
-                                      np.asarray(codes)[:nr])
+        tracker = RandomEffectTracker(host_array(iters)[:nr],
+                                      host_array(values)[:nr],
+                                      host_array(codes)[:nr])
         return new_coefs, tracker
 
     def score(self, coefs: Array) -> Array:
@@ -273,15 +274,25 @@ class FactoredRandomEffectCoordinate:
         e = self.dataset.num_entities
         d = self.dataset.reduced_dim
         # Random projection init (MFOptimizationConfiguration analog).
-        b0 = jax.random.normal(jax.random.PRNGKey(self.seed), (k, d)) / \
-            jnp.sqrt(k)
-        return jnp.zeros((e, k)), b0
+        # Explicit f32: under x64 the default dtype would draw DIFFERENT
+        # random bits, and the bilinear alternation amplifies an init
+        # difference into a different local optimum — the init must not
+        # depend on the precision mode (blocks are f32 regardless).
+        b0 = jax.random.normal(jax.random.PRNGKey(self.seed), (k, d),
+                               dtype=jnp.float32) / jnp.sqrt(k)
+        return jnp.zeros((e, k), jnp.float32), b0
 
     def update(self, state: Optional[tuple[Array, Array]],
                extra_scores: Array) -> tuple[tuple[Array, Array], Tracker]:
         coefs, B = state if state is not None else self.initial_state()
         ds = self.dataset
         offsets = ds.offsets_with(extra_scores)
+        # The init is drawn in f32 so its BITS don't depend on the x64
+        # mode; the running state then promotes to the ambient dtype (x64
+        # runs keep solving in f64, with the identical starting values).
+        acc = jnp.promote_types(jnp.promote_types(coefs.dtype, jnp.float32),
+                                offsets.dtype)
+        coefs, B = coefs.astype(acc), B.astype(acc)
         inner: list = []
         for _ in range(self.num_inner_iterations):
             # (1) latent-space per-entity fits on projected blocks.
@@ -292,9 +303,9 @@ class FactoredRandomEffectCoordinate:
             coefs, iters, values, codes = self.problem.run(lat_ds, offsets,
                                                            initial=coefs)
             nr = len(ds.entity_codes)
-            re_tracker = RandomEffectTracker(np.asarray(iters)[:nr],
-                                             np.asarray(values)[:nr],
-                                             np.asarray(codes)[:nr])
+            re_tracker = RandomEffectTracker(host_array(iters)[:nr],
+                                             host_array(values)[:nr],
+                                             host_array(codes)[:nr])
             # (2) projection-matrix fit on Kronecker features c_e ⊗ x.
             e, n, d = ds.X.shape
             k = self.latent_dim

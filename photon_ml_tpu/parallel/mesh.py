@@ -129,3 +129,24 @@ def shard_batch(batch, mesh: Mesh):
 
 def pad_rows_to_multiple(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _replicator(mesh: Mesh):
+    return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
+
+
+def host_array(x) -> np.ndarray:
+    """``np.asarray`` that also handles NON-fully-addressable global
+    arrays (multi-controller runs): such an array is first replicated over
+    its own mesh, after which every process holds the full value. The
+    host-side trackers (per-entity iteration/convergence counts) use this
+    so the same coordinate code runs single-chip, multi-chip, and
+    multi-host. The replicating jit is cached per mesh so repeated calls
+    don't re-trace."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        x = _replicator(x.sharding.mesh)(x)
+    return np.asarray(x)

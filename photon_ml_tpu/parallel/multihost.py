@@ -209,6 +209,7 @@ def run_game_worker(
     initialization_timeout: int = 60,
     heartbeat_timeout: int = 100,
     blocks_dir=None,
+    factored=None,
 ) -> dict:
     """One multi-host GAME training process: fixed + random effect CD.
 
@@ -262,7 +263,7 @@ def run_game_worker(
             process_id, num_processes, train_paths,
             feature_shard_sections, index_maps, fixed_coordinate,
             random_coordinate, task, num_iterations, num_buckets,
-            blocks_dir)
+            blocks_dir, factored)
     finally:
         jax.distributed.shutdown()
 
@@ -270,7 +271,7 @@ def run_game_worker(
 def _game_worker_body(
         process_id, num_processes, train_paths, feature_shard_sections,
         index_maps, fixed_coordinate, random_coordinate, task,
-        num_iterations, num_buckets, blocks_dir=None):
+        num_iterations, num_buckets, blocks_dir=None, factored=None):
     """Post-initialize body of :func:`run_game_worker` (imports deferred
     until the distributed backend is live)."""
     import jax
@@ -365,6 +366,10 @@ def _game_worker_body(
     # host's blocks, and keep_host_blocks means nothing is committed to a
     # single device before the global-mesh sharding below
     # (RandomEffectDataSet.scala:169-206's partitioned shuffle output).
+    if factored is not None and num_buckets != 1:
+        raise ValueError("a factored coordinate needs a single block "
+                         "(num_buckets=1): one projection matrix is "
+                         "shared across all entities")
     re_ds = build_random_effect_dataset_streamed(
         dataset_row_stream(gdata, re_cfg_local), re_cfg_local,
         raw_dim=gdata.shard_dim("re"),
@@ -422,6 +427,34 @@ def _game_worker_body(
     _replicate = jax.jit(lambda x: x,
                          out_shardings=NamedSharding(ent_mesh, P()))
 
+    # ---- factored random effect: same GLOBAL arrays, single-block view --
+    fac_coord = None
+    if factored is not None:
+        import dataclasses as _dc2
+
+        from photon_ml_tpu.game.coordinate import (
+            FactoredRandomEffectCoordinate,
+        )
+
+        fac_re_cfg, fac_latent_cfg, fac_mf_cfg = factored
+        b0 = re_ds.buckets[0]
+        # the factored coordinate's alternation (latent per-entity refit +
+        # Kronecker projection fit) runs on the single-block entity-
+        # sharded global arrays; its einsums/solves distribute under GSPMD
+        # (FactoredRandomEffectCoordinate.scala:39-257)
+        re_ds = _dc2.replace(
+            re_ds, X=b0.X, labels=b0.labels, base_offsets=b0.base_offsets,
+            weights=b0.weights, row_ids=b0.row_ids, buckets=None,
+            _reduced_dim=None)
+        fac_coord = FactoredRandomEffectCoordinate(
+            dataset=re_ds,
+            problem=RandomEffectOptimizationProblem(
+                config=fac_re_cfg, task=task),
+            latent_problem=GLMOptimizationProblem(
+                config=fac_latent_cfg, task=task),
+            latent_dim=fac_mf_cfg.num_factors,
+            num_inner_iterations=fac_mf_cfg.max_number_iterations)
+
     # ---- fixed-effect global batch: local rows only ----------------------
     f_mat = local.feature_shards[f_data_cfg.feature_shard_id].tocsr()
     X_loc = np.zeros((L, f_mat.shape[1]), np.float32)
@@ -475,27 +508,42 @@ def _game_worker_body(
         scores_fixed = gather_global(fixed_margins(X_g,
                                                    jnp.asarray(w_fixed)))
 
-        # random-effect update: entity-sharded distributed solve (the
-        # coefficients stay a global sharded array between iterations)
-        offs = re_ds.offsets_with(jnp.asarray(scores_fixed))
-        re_coefs, *_ = re_prob.run(
-            re_ds, offs,
-            initial=None if re_coefs is None else re_coefs)
-        scores_re = np.asarray(_replicate(
-            score_random_effect(re_ds, re_coefs))).astype(np.float32)
+        # random-effect update: entity-sharded distributed solve (state
+        # stays a global sharded array between iterations)
+        if fac_coord is not None:
+            re_coefs, _ = fac_coord.update(re_coefs,
+                                           jnp.asarray(scores_fixed))
+            scores_re = np.asarray(_replicate(
+                fac_coord.score(re_coefs))).astype(np.float32)
+            re_reg = fac_coord.regularization_value(re_coefs)
+        else:
+            offs = re_ds.offsets_with(jnp.asarray(scores_fixed))
+            re_coefs, *_ = re_prob.run(
+                re_ds, offs,
+                initial=None if re_coefs is None else re_coefs)
+            scores_re = np.asarray(_replicate(
+                score_random_effect(re_ds, re_coefs))).astype(np.float32)
+            re_reg = re_prob.regularization_value(re_coefs)
 
         total = scores_fixed + scores_re + off_g
         li = loss.loss(jnp.asarray(total), jnp.asarray(resp_g))
         objective = float(jnp.sum(jnp.asarray(wt_g) * li))
         objective += float(f_problem.regularization_value(
             jnp.asarray(w_fixed)))
-        objective += re_prob.regularization_value(re_coefs)
+        objective += re_reg
 
     # drop the pad entity from the returned RE table
     vocab = gdata.id_vocabs[id_type]
     keep = np.asarray([vocab[int(c)] != _PAD_ENTITY
                        for c in re_ds.entity_codes])
-    re_coefs_host = np.asarray(_replicate(re_coefs))
+    if fac_coord is not None:
+        lat, B = re_coefs
+        # publish in RAW space (latent @ projection), like the scoring
+        # path of FactoredRandomEffectModel.to_raw
+        re_coefs_host = (np.asarray(_replicate(lat))
+                         @ np.asarray(_replicate(B)))
+    else:
+        re_coefs_host = np.asarray(_replicate(re_coefs))
     re_table = {
         str(vocab[int(code)]): re_coefs_host[i]
         for i, code in enumerate(re_ds.entity_codes) if keep[i]}
@@ -508,6 +556,7 @@ def _game_worker_body(
         "rows_global": int(n_per.sum()),
         # witness: the RE entity axis really is sharded over every device
         "re_entity_axis_devices": int(ent_mesh.shape[ENTITY_AXIS]),
+        "factored": fac_coord is not None,
     }
 
 
