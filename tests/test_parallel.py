@@ -15,7 +15,16 @@ import pytest
 from photon_ml_tpu.data.batch import dense_batch, pad_batch
 from photon_ml_tpu.ops.aggregators import GLMObjective
 from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.optimize.config import (
+    GLMOptimizationConfiguration,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    TaskType,
+)
 from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.parallel.distributed import run_glm_shard_map
 from photon_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     ENTITY_AXIS,
@@ -103,17 +112,6 @@ def test_shard_batch_rejects_indivisible_rows(rng):
 def test_shard_map_fit_matches_local(rng, devices):
     """Explicit shard_map+psum fit == single-device fit (the manual
     collectives backend, parallel/distributed.py)."""
-    from photon_ml_tpu.optimize.config import (
-        GLMOptimizationConfiguration,
-        OptimizerType,
-        RegularizationContext,
-        RegularizationType,
-        TaskType,
-    )
-    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
-    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
-    from photon_ml_tpu.parallel.mesh import make_mesh, shard_batch
-
     n, d = 512, 32
     X = rng.normal(size=(n, d)).astype(np.float32)
     w_true = rng.normal(size=d).astype(np.float32)
@@ -142,17 +140,6 @@ def test_shard_map_fit_matches_local(rng, devices):
 
 
 def test_shard_map_fit_tron(rng, devices):
-    from photon_ml_tpu.optimize.config import (
-        GLMOptimizationConfiguration,
-        OptimizerType,
-        RegularizationContext,
-        RegularizationType,
-        TaskType,
-    )
-    from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
-    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
-    from photon_ml_tpu.parallel.mesh import make_mesh, shard_batch
-
     n, d = 256, 16
     X = rng.normal(size=(n, d)).astype(np.float32)
     y = (X @ rng.normal(size=d).astype(np.float32)
@@ -172,3 +159,75 @@ def test_shard_map_fit_tron(rng, devices):
     np.testing.assert_allclose(
         np.asarray(dist_model.coefficients.means),
         np.asarray(local_model.coefficients.means), rtol=2e-4, atol=2e-4)
+
+
+class TestShardMapGLMValidatorSweep:
+    """BaseGLMIntegTest analog on the DISTRIBUTED backend: every GLM task
+    trains through the shard_map+psum fit over the 8-device mesh, matches
+    the single-device solution, and its predictions satisfy the task's
+    validator contracts (supervised/*Validator.scala: finiteness,
+    probability range for classifiers, strict positivity for Poisson,
+    classification accuracy above chance)."""
+
+    CASES = [
+        ("LOGISTIC_REGRESSION", "LBFGS", "L2"),
+        ("LOGISTIC_REGRESSION", "TRON", "L2"),
+        ("LINEAR_REGRESSION", "LBFGS", "L2"),
+        ("LINEAR_REGRESSION", "TRON", "L2"),
+        ("POISSON_REGRESSION", "LBFGS", "L2"),
+        ("POISSON_REGRESSION", "LBFGS", "L1"),
+        ("SMOOTHED_HINGE_LOSS_LINEAR_SVM", "LBFGS", "L2"),
+    ]
+
+    @pytest.mark.parametrize("task_name,opt,reg", CASES)
+    def test_sharded_fit_validators(self, rng, devices, task_name, opt,
+                                    reg):
+        task = TaskType[task_name]
+        n, d = 480, 12
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = (rng.normal(size=d) * 0.6).astype(np.float32)
+        margin = X @ w_true
+        if task == TaskType.POISSON_REGRESSION:
+            y = rng.poisson(np.exp(np.clip(margin, -4, 2))).astype(
+                np.float32)
+        elif task == TaskType.LINEAR_REGRESSION:
+            y = (margin + 0.1 * rng.normal(size=n)).astype(np.float32)
+        else:
+            y = (rng.uniform(size=n)
+                 < 1 / (1 + np.exp(-margin))).astype(np.float32)
+        batch = dense_batch(X, y)
+
+        problem = GLMOptimizationProblem(
+            config=GLMOptimizationConfiguration(
+                max_iterations=40, tolerance=1e-8,
+                regularization_weight=0.5,
+                optimizer_type=OptimizerType[opt],
+                regularization_context=RegularizationContext(
+                    RegularizationType[reg])),
+            task=task)
+
+        local_model, _ = problem.run(batch)
+        mesh = make_mesh(num_data=len(devices), num_entity=1,
+                         devices=devices)
+        dist_model, _ = run_glm_shard_map(
+            problem, shard_batch(batch, mesh), mesh)
+
+        # distributed == local (treeAggregate-replacement contract)
+        np.testing.assert_allclose(
+            np.asarray(dist_model.coefficients.means),
+            np.asarray(local_model.coefficients.means),
+            rtol=2e-4, atol=2e-4)
+
+        # validator contracts on the distributed model's predictions
+        assert dist_model.validate_coefficients()
+        preds = np.asarray(dist_model.predict(jnp.asarray(X)))
+        assert np.all(np.isfinite(preds))
+        if task == TaskType.LOGISTIC_REGRESSION:
+            assert np.all((preds >= 0.0) & (preds <= 1.0))
+        if task == TaskType.POISSON_REGRESSION:
+            assert np.all(preds > 0.0)
+        if task in (TaskType.LOGISTIC_REGRESSION,
+                    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+            cls = np.asarray(dist_model.predict_class(jnp.asarray(X)))
+            assert set(np.unique(cls)) <= {0, 1}
+            assert np.mean(cls == y) > 0.7
