@@ -278,7 +278,7 @@ def test_sharded_fit_with_normalization(rng, devices):
     # published coefficients are raw-space: scoring raw data works
     preds = np.asarray(dist_model.predict(jnp.asarray(X)))
     assert np.all((preds >= 0) & (preds <= 1))
-    cls = (preds > 0.5).astype(np.float32)
+    cls = np.asarray(dist_model.predict_class(jnp.asarray(X)))
     assert np.mean(cls == y) > 0.7
 
 
@@ -290,8 +290,6 @@ def test_sharded_fit_with_box_constraints(rng, devices):
     as LBFGS.scala:42-150), so the contract is: the bound binds EXACTLY
     and identically on both backends, feasibility holds everywhere, and
     the achieved objectives agree."""
-    from photon_ml_tpu.ops.aggregators import GLMObjective
-    from photon_ml_tpu.ops.losses import get_loss
     from photon_ml_tpu.optimize.common import BoxConstraints
 
     n, d = 256, 6
