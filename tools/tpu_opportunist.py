@@ -45,10 +45,21 @@ _T0 = time.time()
 
 
 def _lastgood_age_secs() -> float | None:
+    """Age of the on-chip record by its OWN recorded_at timestamp — the
+    file mtime lies when an old record is seeded/copied into place."""
     try:
-        return time.time() - os.path.getmtime(LASTGOOD)
-    except OSError:
-        return None
+        with open(LASTGOOD) as fh:
+            rec = json.load(fh)
+        import datetime
+
+        ts = datetime.datetime.fromisoformat(rec["recorded_at"])
+        return (datetime.datetime.now(datetime.timezone.utc)
+                - ts).total_seconds()
+    except (OSError, ValueError, KeyError):
+        try:
+            return time.time() - os.path.getmtime(LASTGOOD)
+        except OSError:
+            return None
 
 
 def try_capture(probe_timeout: int, bench_timeout: int,
