@@ -610,31 +610,28 @@ def _run_multihost(ns: argparse.Namespace) -> None:
                      if c in driver.fixed_data_configs]
         re_ids = [c for c in driver.updating_sequence
                   if c in driver.random_data_configs]
-        if len(fixed_ids) != 1 or len(re_ids) != 1:
+        if len(fixed_ids) != 1 or not re_ids:
             raise ValueError(
-                "multi-host mode currently supports exactly one fixed + "
-                "one random-effect coordinate (plain or factored)")
+                "multi-host mode needs exactly one fixed coordinate and "
+                "at least one random-effect coordinate (plain or "
+                "factored)")
         if (len(driver.fixed_opt_grid) > 1 or len(driver.random_opt_grid) > 1
                 or len(driver.factored_grid) > 1):
             raise ValueError("multi-host mode supports a single grid point")
-        f_cid, r_cid = fixed_ids[0], re_ids[0]
-        factored_cfg = driver.factored_grid[0].get(r_cid)
-        extra_factored = set(driver.factored_grid[0]) - {r_cid}
+        f_cid = fixed_ids[0]
+        extra_factored = set(driver.factored_grid[0]) - set(re_ids)
         if extra_factored:
             raise ValueError(
                 f"factored configs for unknown coordinates: "
                 f"{sorted(extra_factored)}")
-        if (factored_cfg is not None
-                and int(ns.random_effect_block_buckets) > 1):
-            # fail at parse time, not after N processes rendezvous and
-            # load data (the worker re-checks defensively)
-            raise ValueError(
-                "a factored coordinate needs a single block; drop "
-                "--random-effect-block-buckets")
         f_opt = driver.fixed_opt_grid[0].get(
             f_cid, GLMOptimizationConfiguration())
-        r_opt = driver.random_opt_grid[0].get(
-            r_cid, GLMOptimizationConfiguration())
+        random_coordinates = [
+            (cid, driver.random_data_configs[cid],
+             driver.random_opt_grid[0].get(
+                 cid, GLMOptimizationConfiguration()),
+             driver.factored_grid[0].get(cid))
+            for cid in re_ids]
 
         # expand dirs to part files, then round-robin by process id
         from photon_ml_tpu.io.avro import expand_part_paths
@@ -661,31 +658,38 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             ns.process_id, ns.num_processes, ns.coordinator, local_files,
             driver.section_keys, driver.index_maps,
             (f_cid, driver.fixed_data_configs[f_cid], f_opt),
-            (r_cid, driver.random_data_configs[r_cid], r_opt),
+            random_coordinates,
             driver.task, num_iterations=ns.num_iterations,
             num_buckets=max(1, int(ns.random_effect_block_buckets)),
             initialization_timeout=ns.coordinator_timeout,
             heartbeat_timeout=ns.heartbeat_timeout,
             # per-process subdir: two processes must not write the same
-            # memmap files
+            # memmap files (the worker appends one subdir per coordinate)
             blocks_dir=(os.path.join(ns.random_effect_blocks_dir,
-                                     f"{r_cid}.p{ns.process_id}")
-                        if ns.random_effect_blocks_dir else None),
-            factored=factored_cfg)
+                                     f"p{ns.process_id}")
+                        if ns.random_effect_blocks_dir else None))
 
-        re_table = result["random_effect"][r_cid]
-        ids = sorted(re_table)
+        # one npz per process: fixed coefficients + per-coordinate tables
+        arrays = {
+            "fixed": result["fixed"][f_cid],
+            "objective": np.asarray(result["objective"]),
+            "re_coordinate_ids": np.asarray(
+                sorted(result["random_effect"])),
+        }
+        for cid, table in result["random_effect"].items():
+            ids = sorted(table)
+            arrays[f"re_ids__{cid}"] = np.asarray(ids)
+            arrays[f"re_coefs__{cid}"] = (
+                np.stack([table[i] for i in ids])
+                if ids else np.zeros((0, 0)))
         np.savez(
             os.path.join(ns.output_dir,
                          f"multihost_result.p{ns.process_id}.npz"),
-            fixed=result["fixed"][f_cid],
-            objective=np.asarray(result["objective"]),
-            re_ids=np.asarray(ids),
-            re_coefs=(np.stack([re_table[i] for i in ids])
-                      if ids else np.zeros((0, 0))))
+            **arrays)
         print(f"MULTIHOST_GAME_OK process={ns.process_id} "
               f"of={ns.num_processes} devices={result['global_devices']} "
               f"re_entity_axis={result['re_entity_axis_devices']} "
+              f"re_coordinates={','.join(sorted(result['random_effect']))} "
               f"rows={result['rows_global']} "
               f"objective={result['objective']:.6f}", flush=True)
     except Exception as e:

@@ -202,16 +202,15 @@ def run_game_worker(
     feature_shard_sections: dict,
     index_maps: dict,
     fixed_coordinate: tuple,
-    random_coordinate: tuple,
+    random_coordinates,
     task,
     num_iterations: int = 1,
     num_buckets: int = 1,
     initialization_timeout: int = 60,
     heartbeat_timeout: int = 100,
     blocks_dir=None,
-    factored=None,
 ) -> dict:
-    """One multi-host GAME training process: fixed + random effect CD.
+    """One multi-host GAME training process: fixed + random effects CD.
 
     The cluster-program analog of the reference's GAME training driver
     (cli/game/training/Driver.scala:642-726 — the driver IS the cluster
@@ -224,19 +223,25 @@ def run_game_worker(
       its local (padded) row range into the global mesh via
       ``jax.make_array_from_callback``; the L-BFGS fit runs through the
       shard_map+psum backend over all hosts' devices.
-    - **Scalar columns and the (narrow) random-effect shard are
-      host-allgathered**, then every process builds the identical padded
-      entity blocks and the blocks' entity axis is sharded over an
-      all-devices entity mesh: each device solves a contiguous slice of
-      entity lanes under the jitted vmapped solver (zero comm in the hot
-      loop) — the reference's entity-partitioned executors
-      (RandomEffectCoordinate.scala:104-113), now across hosts.
+    - **Scalar columns and the (narrow) random-effect shards are
+      host-allgathered**, then every process builds its OWN entity slice
+      of the padded blocks (per-host-sharded streamed build) and the
+      blocks' entity axis is sharded over an all-devices entity mesh:
+      each device solves a contiguous slice of entity lanes under the
+      jitted vmapped solver (zero comm in the hot loop) — the reference's
+      entity-partitioned executors (RandomEffectCoordinate.scala:104-113),
+      now across hosts.
 
     ``fixed_coordinate`` = (coord_id, FixedEffectDataConfiguration,
-    GLMOptimizationConfiguration); ``random_coordinate`` likewise with a
-    RandomEffectDataConfiguration. Returns a dict with the fixed
-    coefficients, per-entity RE coefficients keyed by raw entity id, and
-    the final objective — identical on every process.
+    GLMOptimizationConfiguration); ``random_coordinates`` is a LIST of
+    (coord_id, RandomEffectDataConfiguration,
+    GLMOptimizationConfiguration, factored_or_None) updated in order each
+    CD iteration — the full GAME shape (e.g. fixed + per-user + per-item)
+    runs as one cluster program. ``factored`` entries are
+    (re_cfg, latent_cfg, mf_cfg) tuples for factored coordinates. Returns
+    a dict with the fixed coefficients, a per-coordinate map of
+    per-entity RE coefficients keyed by raw entity id, and the final
+    objective — identical on every process.
     """
     import os
 
@@ -262,18 +267,20 @@ def run_game_worker(
         return _game_worker_body(
             process_id, num_processes, train_paths,
             feature_shard_sections, index_maps, fixed_coordinate,
-            random_coordinate, task, num_iterations, num_buckets,
-            blocks_dir, factored)
+            random_coordinates, task, num_iterations, num_buckets,
+            blocks_dir)
     finally:
         jax.distributed.shutdown()
 
 
 def _game_worker_body(
         process_id, num_processes, train_paths, feature_shard_sections,
-        index_maps, fixed_coordinate, random_coordinate, task,
-        num_iterations, num_buckets, blocks_dir=None, factored=None):
+        index_maps, fixed_coordinate, random_coordinates, task,
+        num_iterations, num_buckets, blocks_dir=None):
     """Post-initialize body of :func:`run_game_worker` (imports deferred
     until the distributed backend is live)."""
+    import os
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -300,15 +307,16 @@ def _game_worker_body(
     mesh = make_mesh(num_data=len(devs), num_entity=1, devices=devs)
 
     f_cid, f_data_cfg, f_opt_cfg = fixed_coordinate
-    r_cid, r_data_cfg, r_opt_cfg = random_coordinate
-    id_type = r_data_cfg.random_effect_type
+    id_types = sorted({cfg.random_effect_type
+                       for _, cfg, _, _ in random_coordinates})
 
     # ---- local ingestion: ONLY this process's part files -----------------
     local = load_game_dataset_avro(
         list(train_paths), feature_shard_sections, index_maps,
-        id_types=[id_type], response_required=True)
+        id_types=id_types, response_required=True)
     n_loc = local.num_samples
-    raw_ids_loc = local.id_vocabs[id_type][local.id_columns[id_type]]
+    raw_ids_loc = {t: local.id_vocabs[t][local.id_columns[t]]
+                   for t in id_types}
 
     # ---- padded canonical sample layout ----------------------------------
     # Every process pads its row range to the same L (multiple of the
@@ -334,49 +342,34 @@ def _game_worker_body(
     resp_loc = pad_local(local.responses)
     off_loc = pad_local(local.offsets)
     wt_loc = pad_local(local.weights)
-    ids_loc = np.full(L, _PAD_ENTITY, dtype=object)
-    ids_loc[:n_loc] = raw_ids_loc
 
-    # ---- allgather scalar columns + the RE shard -------------------------
+    # ---- allgather scalar columns + the RE shards ------------------------
     resp_g = np.concatenate(allgather_ragged(resp_loc))
     off_g = np.concatenate(allgather_ragged(off_loc))
     wt_g = np.concatenate(allgather_ragged(wt_loc))
-    ids_g = np.concatenate(allgather_strings(ids_loc))
-    re_mat_loc = local.feature_shards[r_data_cfg.feature_shard_id]
+    ids_g = {}
+    for t in id_types:
+        ids_loc = np.full(L, _PAD_ENTITY, dtype=object)
+        ids_loc[:n_loc] = raw_ids_loc[t]
+        ids_g[t] = np.concatenate(allgather_strings(ids_loc))
     import scipy.sparse as sp
 
-    re_pad = sp.vstack([
-        re_mat_loc.tocsr(),
-        sp.csr_matrix((L - n_loc, re_mat_loc.shape[1]))]).tocsr()
-    re_mat_g = sp.vstack(allgather_csr(re_pad)).tocsr()
+    shards_g = {}
+    for sname in sorted({cfg.feature_shard_id
+                         for _, cfg, _, _ in random_coordinates}):
+        mat_loc = local.feature_shards[sname].tocsr()
+        padded = sp.vstack([
+            mat_loc,
+            sp.csr_matrix((L - n_loc, mat_loc.shape[1]))]).tocsr()
+        shards_g[sname] = sp.vstack(allgather_csr(padded)).tocsr()
 
-    # identical global GameDataset view for the RE coordinate on every
+    # identical global GameDataset view for the RE coordinates on every
     # process (deterministic build → identical blocks/solves everywhere)
     gdata = GameDataset(
-        responses=resp_g, feature_shards={"re": re_mat_g},
+        responses=resp_g, feature_shards=shards_g,
         offsets=off_g.astype(np.float64), weights=wt_g.astype(np.float64))
-    gdata.encode_ids(id_type, ids_g)
-    import dataclasses as _dc
-
-    re_cfg_local = _dc.replace(r_data_cfg, feature_shard_id="re")
-    # Streamed HOST-side block build, PER-HOST SHARDED: every process
-    # computes the identical global grouping/plan from the O(N) scalar
-    # columns, then allocates and fills ONLY its own contiguous entity
-    # slice of every bucket (entity_shard) — no host ever holds another
-    # host's blocks, and keep_host_blocks means nothing is committed to a
-    # single device before the global-mesh sharding below
-    # (RandomEffectDataSet.scala:169-206's partitioned shuffle output).
-    if factored is not None and num_buckets != 1:
-        raise ValueError("a factored coordinate needs a single block "
-                         "(num_buckets=1): one projection matrix is "
-                         "shared across all entities")
-    re_ds = build_random_effect_dataset_streamed(
-        dataset_row_stream(gdata, re_cfg_local), re_cfg_local,
-        raw_dim=gdata.shard_dim("re"),
-        num_buckets=num_buckets, entity_axis_size=len(devs),
-        blocks_dir=blocks_dir, keep_host_blocks=True,
-        entity_shard=(process_id, num_processes))
-    re_prob = RandomEffectOptimizationProblem(config=r_opt_cfg, task=task)
+    for t in id_types:
+        gdata.encode_ids(t, ids_g[t])
 
     # ---- entity-axis sharding over ALL hosts' devices --------------------
     # The blocks are identical on every process (deterministic build);
@@ -413,47 +406,77 @@ def _game_worker_body(
 
         return jax.make_array_from_callback(full, sh, cb)
 
-    for block in re_ds.buckets:
-        assert block.local_entity_offset == process_id * block.X.shape[0]
-        for field in ("X", "labels", "base_offsets", "weights", "row_ids"):
-            setattr(block, field, to_global_ent(getattr(block, field)))
-    if re_ds.passive_X is not None:
-        # passive rows stay host-side numpy: they enter jitted scoring as
-        # replicated constants next to the entity-sharded coefficients
-        re_ds.passive_X = np.asarray(re_ds.passive_X)
-        re_ds.passive_entity = np.asarray(re_ds.passive_entity)
-        re_ds.passive_row_ids = np.asarray(re_ds.passive_row_ids)
-        re_ds.passive_offsets = np.asarray(re_ds.passive_offsets)
     _replicate = jax.jit(lambda x: x,
                          out_shardings=NamedSharding(ent_mesh, P()))
 
-    # ---- factored random effect: same GLOBAL arrays, single-block view --
-    fac_coord = None
-    if factored is not None:
-        import dataclasses as _dc2
+    # ---- per-coordinate setup: streamed per-host-sharded block builds ----
+    # Every process computes the identical global grouping/plan from the
+    # O(N) scalar columns, then allocates and fills ONLY its own
+    # contiguous entity slice of every bucket (entity_shard) — no host
+    # ever holds another host's blocks, and keep_host_blocks means nothing
+    # is committed to a single device before the global-mesh sharding
+    # (RandomEffectDataSet.scala:169-206's partitioned shuffle output).
+    # Factored coordinates run the latent-refit + Kronecker-fit
+    # alternation on the single-block entity-sharded global arrays
+    # (FactoredRandomEffectCoordinate.scala:39-257).
+    import dataclasses as _dc
 
-        from photon_ml_tpu.game.coordinate import (
-            FactoredRandomEffectCoordinate,
-        )
+    from photon_ml_tpu.game.coordinate import (
+        FactoredRandomEffectCoordinate,
+    )
 
-        fac_re_cfg, fac_latent_cfg, fac_mf_cfg = factored
-        b0 = re_ds.buckets[0]
-        # the factored coordinate's alternation (latent per-entity refit +
-        # Kronecker projection fit) runs on the single-block entity-
-        # sharded global arrays; its einsums/solves distribute under GSPMD
-        # (FactoredRandomEffectCoordinate.scala:39-257)
-        re_ds = _dc2.replace(
-            re_ds, X=b0.X, labels=b0.labels, base_offsets=b0.base_offsets,
-            weights=b0.weights, row_ids=b0.row_ids, buckets=None,
-            _reduced_dim=None)
-        fac_coord = FactoredRandomEffectCoordinate(
-            dataset=re_ds,
-            problem=RandomEffectOptimizationProblem(
-                config=fac_re_cfg, task=task),
-            latent_problem=GLMOptimizationProblem(
-                config=fac_latent_cfg, task=task),
-            latent_dim=fac_mf_cfg.num_factors,
-            num_inner_iterations=fac_mf_cfg.max_number_iterations)
+    coords = []
+    for cid, r_data_cfg, r_opt_cfg, factored in random_coordinates:
+        # a factored coordinate always gets a single block (one projection
+        # matrix is shared across all entities); plain coordinates keep
+        # the requested bucketing — mixing both kinds in one run is fine
+        re_ds = build_random_effect_dataset_streamed(
+            dataset_row_stream(gdata, r_data_cfg), r_data_cfg,
+            raw_dim=gdata.shard_dim(r_data_cfg.feature_shard_id),
+            num_buckets=1 if factored is not None else num_buckets,
+            entity_axis_size=len(devs),
+            blocks_dir=(None if blocks_dir is None
+                        else os.path.join(blocks_dir, cid)),
+            keep_host_blocks=True,
+            entity_shard=(process_id, num_processes))
+        for block in re_ds.buckets:
+            assert (block.local_entity_offset
+                    == process_id * block.X.shape[0])
+            for field in ("X", "labels", "base_offsets", "weights",
+                          "row_ids"):
+                setattr(block, field, to_global_ent(getattr(block, field)))
+        if re_ds.passive_X is not None:
+            # passive rows stay host-side numpy: they enter jitted
+            # scoring as replicated constants next to the entity-sharded
+            # coefficients
+            re_ds.passive_X = np.asarray(re_ds.passive_X)
+            re_ds.passive_entity = np.asarray(re_ds.passive_entity)
+            re_ds.passive_row_ids = np.asarray(re_ds.passive_row_ids)
+            re_ds.passive_offsets = np.asarray(re_ds.passive_offsets)
+        fac_coord = None
+        if factored is not None:
+            fac_re_cfg, fac_latent_cfg, fac_mf_cfg = factored
+            b0 = re_ds.buckets[0]
+            re_ds = _dc.replace(
+                re_ds, X=b0.X, labels=b0.labels,
+                base_offsets=b0.base_offsets, weights=b0.weights,
+                row_ids=b0.row_ids, buckets=None, _reduced_dim=None)
+            fac_coord = FactoredRandomEffectCoordinate(
+                dataset=re_ds,
+                problem=RandomEffectOptimizationProblem(
+                    config=fac_re_cfg, task=task),
+                latent_problem=GLMOptimizationProblem(
+                    config=fac_latent_cfg, task=task),
+                latent_dim=fac_mf_cfg.num_factors,
+                num_inner_iterations=fac_mf_cfg.max_number_iterations)
+        coords.append({
+            "cid": cid,
+            "id_type": r_data_cfg.random_effect_type,
+            "ds": re_ds,
+            "prob": RandomEffectOptimizationProblem(
+                config=r_opt_cfg, task=task),
+            "fac": fac_coord,
+        })
 
     # ---- fixed-effect global batch: local rows only ----------------------
     f_mat = local.feature_shards[f_data_cfg.feature_shard_id].tocsr()
@@ -489,16 +512,21 @@ def _game_worker_body(
     def fixed_margins(X, w):
         return X @ w
 
-    # ---- coordinate descent: fixed ⇄ random ------------------------------
+    # ---- coordinate descent: fixed ⇄ random effects ----------------------
+    # Offsets for each coordinate = base + Σ other coordinates' scores
+    # (CoordinateDescent.scala:143-151's partial-score subtraction).
     loss = get_loss(TASK_LOSS_NAME[task])
     scores_fixed = np.zeros(n_pad_total, np.float32)
-    scores_re = np.zeros(n_pad_total, np.float32)
+    scores_re = {c["cid"]: np.zeros(n_pad_total, np.float32)
+                 for c in coords}
+    states = {c["cid"]: None for c in coords}
+    regs = {c["cid"]: 0.0 for c in coords}
     w_fixed = None
-    re_coefs = None
     objective = None
     for _ in range(num_iterations):
-        # fixed update: offsets = base + RE scores (local slice only)
-        off_inj = off_loc + scores_re[process_id * L:(process_id + 1) * L]
+        # fixed update: offsets = base + Σ RE scores (local slice only)
+        re_sum = sum(scores_re.values())
+        off_inj = off_loc + re_sum[process_id * L:(process_id + 1) * L]
         batch_g = DenseBatch(X=X_g, labels=y_g,
                              offsets=to_global(off_inj), weights=w_g)
         model, _ = run_glm_shard_map(
@@ -508,55 +536,63 @@ def _game_worker_body(
         scores_fixed = gather_global(fixed_margins(X_g,
                                                    jnp.asarray(w_fixed)))
 
-        # random-effect update: entity-sharded distributed solve (state
-        # stays a global sharded array between iterations)
-        if fac_coord is not None:
-            re_coefs, _ = fac_coord.update(re_coefs,
-                                           jnp.asarray(scores_fixed))
-            scores_re = np.asarray(_replicate(
-                fac_coord.score(re_coefs))).astype(np.float32)
-            re_reg = fac_coord.regularization_value(re_coefs)
-        else:
-            offs = re_ds.offsets_with(jnp.asarray(scores_fixed))
-            re_coefs, *_ = re_prob.run(
-                re_ds, offs,
-                initial=None if re_coefs is None else re_coefs)
-            scores_re = np.asarray(_replicate(
-                score_random_effect(re_ds, re_coefs))).astype(np.float32)
-            re_reg = re_prob.regularization_value(re_coefs)
+        # random-effect updates in sequence: entity-sharded distributed
+        # solves (state stays a global sharded array between iterations)
+        for c in coords:
+            cid = c["cid"]
+            extra = scores_fixed + sum(
+                s for k, s in scores_re.items() if k != cid)
+            if c["fac"] is not None:
+                states[cid], _ = c["fac"].update(states[cid],
+                                                 jnp.asarray(extra))
+                scores_re[cid] = np.asarray(_replicate(
+                    c["fac"].score(states[cid]))).astype(np.float32)
+                regs[cid] = c["fac"].regularization_value(states[cid])
+            else:
+                offs = c["ds"].offsets_with(jnp.asarray(extra))
+                states[cid], *_ = c["prob"].run(
+                    c["ds"], offs, initial=states[cid])
+                scores_re[cid] = np.asarray(_replicate(
+                    score_random_effect(c["ds"], states[cid]))).astype(
+                        np.float32)
+                regs[cid] = c["prob"].regularization_value(states[cid])
 
-        total = scores_fixed + scores_re + off_g
+        total = scores_fixed + sum(scores_re.values()) + off_g
         li = loss.loss(jnp.asarray(total), jnp.asarray(resp_g))
         objective = float(jnp.sum(jnp.asarray(wt_g) * li))
         objective += float(f_problem.regularization_value(
             jnp.asarray(w_fixed)))
-        objective += re_reg
+        objective += sum(regs.values())
 
-    # drop the pad entity from the returned RE table
-    vocab = gdata.id_vocabs[id_type]
-    keep = np.asarray([vocab[int(c)] != _PAD_ENTITY
-                       for c in re_ds.entity_codes])
-    if fac_coord is not None:
-        lat, B = re_coefs
-        # publish in RAW space (latent @ projection), like the scoring
-        # path of FactoredRandomEffectModel.to_raw
-        re_coefs_host = (np.asarray(_replicate(lat))
-                         @ np.asarray(_replicate(B)))
-    else:
-        re_coefs_host = np.asarray(_replicate(re_coefs))
-    re_table = {
-        str(vocab[int(code)]): re_coefs_host[i]
-        for i, code in enumerate(re_ds.entity_codes) if keep[i]}
+    # drop the pad entity from the returned RE tables
+    random_effect = {}
+    factored_flags = {}
+    for c in coords:
+        vocab = gdata.id_vocabs[c["id_type"]]
+        codes = c["ds"].entity_codes
+        if c["fac"] is not None:
+            lat, B = states[c["cid"]]
+            # publish in RAW space (latent @ projection), like
+            # FactoredRandomEffectModel.to_raw
+            coefs_host = (np.asarray(_replicate(lat))
+                          @ np.asarray(_replicate(B)))
+        else:
+            coefs_host = np.asarray(_replicate(states[c["cid"]]))
+        random_effect[c["cid"]] = {
+            str(vocab[int(code)]): coefs_host[i]
+            for i, code in enumerate(codes)
+            if vocab[int(code)] != _PAD_ENTITY}
+        factored_flags[c["cid"]] = c["fac"] is not None
     return {
         "fixed": {f_cid: w_fixed},
-        "random_effect": {r_cid: re_table},
+        "random_effect": random_effect,
         "objective": objective,
         "num_processes": num_processes,
         "global_devices": len(devs),
         "rows_global": int(n_per.sum()),
         # witness: the RE entity axis really is sharded over every device
         "re_entity_axis_devices": int(ent_mesh.shape[ENTITY_AXIS]),
-        "factored": fac_coord is not None,
+        "factored": factored_flags,
     }
 
 

@@ -234,7 +234,7 @@ class TestMultihostGameDriver:
             assert "re_entity_axis=8" in out, out
         if optimizer == "LBFGS":
             for i in range(2):
-                bdir = root / "mh_blocks" / f"u.p{i}"
+                bdir = root / "mh_blocks" / f"p{i}" / "u"
                 assert any(f.endswith(".f32") for f in os.listdir(bdir)), \
                     f"no memmap blocks for process {i}"
 
@@ -243,18 +243,169 @@ class TestMultihostGameDriver:
                         allow_pickle=False) for i in range(2)]
         np.testing.assert_allclose(recs[0]["fixed"], recs[1]["fixed"],
                                    rtol=1e-6, atol=1e-7)
-        np.testing.assert_allclose(recs[0]["re_coefs"],
-                                   recs[1]["re_coefs"],
+        np.testing.assert_allclose(recs[0]["re_coefs__u"],
+                                   recs[1]["re_coefs__u"],
                                    rtol=1e-6, atol=1e-7)
 
         # parity vs the single-process driver
         np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
                                    rtol=5e-3, atol=5e-3)
-        ids = [str(s) for s in recs[0]["re_ids"]]
+        ids = [str(s) for s in recs[0]["re_ids__u"]]
         assert sorted(ids) == sorted(re_ref)
         for i, rid in enumerate(ids):
-            np.testing.assert_allclose(recs[0]["re_coefs"][i], re_ref[rid],
+            np.testing.assert_allclose(recs[0]["re_coefs__u"][i], re_ref[rid],
                                        rtol=5e-3, atol=5e-3)
+
+
+def _write_full_game_part(path, n, n_users, n_items, d_g, seed):
+    """Avro part with global + per-user + per-item one-hot-ish shards."""
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro import write_container
+
+    schema = dict(_GAME_SCHEMA)
+    schema["fields"] = schema["fields"] + [
+        {"name": "globalFeatures",
+         "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "userFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+        {"name": "itemFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+    ]
+    rng = np.random.default_rng(seed)
+    w_rng = np.random.default_rng(777)
+    w_g = w_rng.normal(size=d_g)
+    bu = w_rng.normal(size=n_users)
+    bi = w_rng.normal(size=n_items)
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        it = int(rng.integers(0, n_items))
+        xg = rng.normal(size=d_g)
+        margin = xg @ w_g + bu[u] + bi[it]
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        records.append({
+            "uid": f"s{seed}_{i}", "response": y, "offset": None,
+            "weight": None,
+            "metadataMap": {"userId": f"user{u}", "itemId": f"item{it}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(xg[j])}
+                               for j in range(d_g)],
+            "userFeatures": [{"name": "bias", "term": "",
+                              "value": 1.0}],
+            "itemFeatures": [{"name": "bias", "term": "",
+                              "value": 1.0}],
+        })
+    write_container(path, schema, records)
+
+
+class TestMultihostFullGame:
+    """2-process FULL-GAME shape (fixed + per-user + per-item) through the
+    CLI: multiple random-effect coordinates update in sequence each CD
+    iteration, each with its own entity-sharded blocks — the cluster-
+    program form of BASELINE config 5's coordinate structure."""
+
+    def test_cli_two_process_three_coordinates(self, tmp_path):
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        _write_full_game_part(str(data_dir / "part-00000.avro"),
+                              n=150, n_users=5, n_items=4, d_g=4, seed=60)
+        _write_full_game_part(str(data_dir / "part-00001.avro"),
+                              n=130, n_users=5, n_items=4, d_g=4, seed=61)
+        from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+        sets = NameAndTermFeatureSets.from_paths(
+            [str(data_dir)],
+            ["globalFeatures", "userFeatures", "itemFeatures"])
+        fs_dir = tmp_path / "fs"
+        sets.save(str(fs_dir))
+
+        def args(out):
+            return [
+                "--train-input-dirs", str(data_dir),
+                "--output-dir", out,
+                "--task-type", "LOGISTIC_REGRESSION",
+                "--feature-name-and-term-set-path", str(fs_dir),
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:globalFeatures|user:userFeatures"
+                "|item:itemFeatures",
+                "--updating-sequence", "g,perUser,perItem",
+                "--num-iterations", "2",
+                "--fixed-effect-data-configurations", "g:global,1",
+                "--fixed-effect-optimization-configurations",
+                "g:60,1e-9,0.1,1.0,LBFGS,L2",
+                "--random-effect-data-configurations",
+                "perUser:userId,user,1,-,-,-,identity"
+                "|perItem:itemId,item,1,-,-,-,identity",
+                "--random-effect-optimization-configurations",
+                "perUser:60,1e-9,0.5,1.0,LBFGS,L2"
+                "|perItem:60,1e-9,0.5,1.0,LBFGS,L2",
+                "--model-output-mode", "NONE",
+            ]
+
+        # single-process reference
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            parse_args,
+        )
+
+        driver = GameTrainingDriver(parse_args(
+            args(str(tmp_path / "single"))))
+        result = driver.run()
+        fixed_ref = np.asarray(
+            result.model.models["g"].coefficients.means)
+        refs = {}
+        for cid, id_type in (("perUser", "userId"), ("perItem", "itemId")):
+            m = result.model.models[cid]
+            if hasattr(m, "to_raw"):
+                m = m.to_raw()
+            vocab = driver.train_data.id_vocabs[id_type]
+            refs[cid] = {
+                str(vocab[int(c)]): np.asarray(m.coefficients[i])
+                for i, c in enumerate(m.entity_codes)}
+
+        # 2-process CLI run on split parts
+        port = _free_port()
+        mh_out = str(tmp_path / "mh")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "photon_ml_tpu.cli.game_training_driver",
+                 *args(mh_out),
+                 "--num-processes", "2", "--process-id", str(i),
+                 "--coordinator", f"127.0.0.1:{port}"],
+                env=_worker_env(4), cwd=_REPO, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            for i in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=420)
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        for i, (rc, out, err) in enumerate(outs):
+            assert rc == 0, (f"worker {i} rc={rc}\nstdout:\n{out}\n"
+                             f"stderr:\n{err}")
+            assert f"MULTIHOST_GAME_OK process={i}" in out, out
+            assert "re_coordinates=perItem,perUser" in out, out
+
+        recs = [np.load(os.path.join(mh_out, f"multihost_result.p{i}.npz"),
+                        allow_pickle=False) for i in range(2)]
+        np.testing.assert_allclose(recs[0]["fixed"], recs[1]["fixed"],
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
+                                   rtol=5e-3, atol=5e-3)
+        for cid in ("perUser", "perItem"):
+            ids = [str(s) for s in recs[0][f"re_ids__{cid}"]]
+            assert sorted(ids) == sorted(refs[cid]), cid
+            for i, rid in enumerate(ids):
+                np.testing.assert_allclose(
+                    recs[0][f"re_coefs__{cid}"][i], refs[cid][rid],
+                    rtol=5e-3, atol=5e-3, err_msg=f"{cid}:{rid}")
 
 
 class TestMultihostFactored:
@@ -356,10 +507,10 @@ class TestMultihostFactored:
                                    rtol=1e-6, atol=1e-7)
         np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
                                    rtol=5e-3, atol=5e-3)
-        ids = [str(s) for s in recs[0]["re_ids"]]
+        ids = [str(s) for s in recs[0]["re_ids__u"]]
         assert sorted(ids) == sorted(re_ref)
         for i, rid in enumerate(ids):
-            np.testing.assert_allclose(recs[0]["re_coefs"][i], re_ref[rid],
+            np.testing.assert_allclose(recs[0]["re_coefs__u"][i], re_ref[rid],
                                        rtol=5e-3, atol=5e-3,
                                        err_msg=rid)
 

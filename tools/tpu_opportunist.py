@@ -55,7 +55,9 @@ def _lastgood_age_secs() -> float | None:
         ts = datetime.datetime.fromisoformat(rec["recorded_at"])
         return (datetime.datetime.now(datetime.timezone.utc)
                 - ts).total_seconds()
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, TypeError):
+        # TypeError covers naive (tz-less) recorded_at timestamps and
+        # non-dict JSON — fall back to mtime like any other bad record
         try:
             return time.time() - os.path.getmtime(LASTGOOD)
         except OSError:
