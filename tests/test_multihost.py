@@ -302,9 +302,14 @@ class TestMultihostFullGame:
     """2-process FULL-GAME shape (fixed + per-user + per-item) through the
     CLI: multiple random-effect coordinates update in sequence each CD
     iteration, each with its own entity-sharded blocks — the cluster-
-    program form of BASELINE config 5's coordinate structure."""
+    program form of BASELINE config 5's coordinate structure. The "mixed"
+    variant combines a BUCKETED plain coordinate with a FACTORED one in
+    the same run (factored builds a single block; the plain coordinate
+    keeps its buckets), at one alternation for determinism (see
+    TestMultihostFactored on path-dependence)."""
 
-    def test_cli_two_process_three_coordinates(self, tmp_path):
+    @pytest.mark.parametrize("variant", ["plain", "mixed"])
+    def test_cli_two_process_three_coordinates(self, tmp_path, variant):
         data_dir = tmp_path / "data"
         data_dir.mkdir()
         _write_full_game_part(str(data_dir / "part-00000.avro"),
@@ -320,7 +325,7 @@ class TestMultihostFullGame:
         sets.save(str(fs_dir))
 
         def args(out):
-            return [
+            base = [
                 "--train-input-dirs", str(data_dir),
                 "--output-dir", out,
                 "--task-type", "LOGISTIC_REGRESSION",
@@ -329,17 +334,30 @@ class TestMultihostFullGame:
                 "global:globalFeatures|user:userFeatures"
                 "|item:itemFeatures",
                 "--updating-sequence", "g,perUser,perItem",
-                "--num-iterations", "2",
                 "--fixed-effect-data-configurations", "g:global,1",
                 "--fixed-effect-optimization-configurations",
                 "g:60,1e-9,0.1,1.0,LBFGS,L2",
                 "--random-effect-data-configurations",
                 "perUser:userId,user,1,-,-,-,identity"
                 "|perItem:itemId,item,1,-,-,-,identity",
-                "--random-effect-optimization-configurations",
-                "perUser:60,1e-9,0.5,1.0,LBFGS,L2"
-                "|perItem:60,1e-9,0.5,1.0,LBFGS,L2",
                 "--model-output-mode", "NONE",
+            ]
+            if variant == "plain":
+                return base + [
+                    "--num-iterations", "2",
+                    "--random-effect-optimization-configurations",
+                    "perUser:60,1e-9,0.5,1.0,LBFGS,L2"
+                    "|perItem:60,1e-9,0.5,1.0,LBFGS,L2",
+                ]
+            # mixed: bucketed plain per-user + factored per-item
+            return base + [
+                "--num-iterations", "1",
+                "--random-effect-optimization-configurations",
+                "perUser:60,1e-9,0.5,1.0,LBFGS,L2",
+                "--factored-random-effect-optimization-configurations",
+                "perItem:50,1e-9,0.5,1.0,LBFGS,L2"
+                ":50,1e-9,0.1,1.0,LBFGS,L2:1,2",
+                "--random-effect-block-buckets", "2",
             ]
 
         # single-process reference
