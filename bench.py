@@ -943,6 +943,10 @@ def _load_lastgood() -> dict | None:
 
 
 def _save_lastgood(record: dict) -> None:
+    """Write the on-chip last-good record. ONLY machine-recorded entries
+    go through here, and they never carry the ``seeded`` flag — that flag
+    marks hand-carried records (see BENCH_TPU_lastgood.json) so consumers
+    can tell reproducible evidence from seeded history."""
     import datetime
 
     try:

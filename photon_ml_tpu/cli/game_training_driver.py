@@ -179,7 +179,9 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "O(N) scalar columns instead of CSR + all padded "
                         "blocks; blocks page to device per solve")
     p.add_argument("--evaluator-type", default="")
-    p.add_argument("--model-output-mode", default=ModelOutputMode.ALL,
+    # default None (resolved to ALL single-process): multi-host must tell
+    # an explicit model-output request apart from the argparse default
+    p.add_argument("--model-output-mode", default=None,
                    choices=[ModelOutputMode.ALL, ModelOutputMode.BEST,
                             ModelOutputMode.NONE])
     p.add_argument("--num-output-files-for-random-effect-model", type=int,
@@ -197,8 +199,36 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "with (validated against the store's meta)")
     p.add_argument("--checkpoint-dir",
                    help="snapshot coordinate states after each CD sweep "
-                        "and auto-resume from the latest snapshot "
-                        "(single-grid-point runs only)")
+                        "and auto-resume from the latest INTACT snapshot "
+                        "(integrity-verified; single-grid-point runs only)")
+    # Divergence recovery (game/coordinate_descent.RecoveryPolicy): guard
+    # every coordinate update for non-finite states/objectives.
+    p.add_argument("--recovery-policy", default="none",
+                   choices=["none", "abort", "skip"],
+                   help="divergence handling per coordinate update: none "
+                        "(legacy fail-through), abort (retry then stop), "
+                        "skip (retry then keep last-good state and "
+                        "continue degraded)")
+    p.add_argument("--recovery-max-retries", type=int, default=2,
+                   help="damped retries from last-good state before the "
+                        "exhausted action applies")
+    p.add_argument("--recovery-damping", type=float, default=0.5,
+                   help="per-retry step damping factor toward the "
+                        "last-good state")
+    p.add_argument("--recovery-max-consecutive-failures", type=int,
+                   default=3,
+                   help="abort after this many consecutive skipped "
+                        "coordinate updates")
+    # Worker supervision (multi-host only): relaunch this host's crashed
+    # worker process with bounded exponential backoff + jitter.
+    p.add_argument("--max-worker-restarts", type=int, default=0,
+                   help="with --num-processes > 1: relaunch this host's "
+                        "crashed worker up to N times (0 = unsupervised)")
+    p.add_argument("--worker-backoff-base", type=float, default=1.0,
+                   help="supervisor backoff base seconds (doubles per "
+                        "restart)")
+    p.add_argument("--worker-backoff-max", type=float, default=30.0,
+                   help="supervisor backoff ceiling seconds")
     # Multi-host (multi-controller jax.distributed) execution: launch this
     # same driver once per host; each process ingests only its own share
     # of the avro part files (cli/game/training/Driver.scala:642-726 — the
@@ -444,9 +474,14 @@ class GameTrainingDriver:
                     "--checkpoint-dir supports single-grid-point runs only "
                     f"(got {len(combos)} grid combinations)")
             ckpt_mgr = CheckpointManager(self.ns.checkpoint_dir)
-            latest = ckpt_mgr.latest_step()
-            if latest is not None:
-                snap = ckpt_mgr.restore(latest)
+            # integrity-verified: restore() falls back past truncated/
+            # corrupt/partial step dirs to the newest intact snapshot
+            # (one verification pass — no separate latest_valid_step call)
+            try:
+                snap = ckpt_mgr.restore()
+            except FileNotFoundError:
+                snap = None
+            if snap is not None:
 
                 def _jnp_states(d):
                     return {cid: (tuple(jnp.asarray(s) for s in v)
@@ -462,6 +497,21 @@ class GameTrainingDriver:
                 self.logger.info(
                     f"resuming from checkpoint at iteration "
                     f"{start_iteration}")
+        recovery = None
+        events = None
+        if self.ns.recovery_policy != "none":
+            from photon_ml_tpu.game.coordinate_descent import RecoveryPolicy
+            from photon_ml_tpu.utils.events import EventEmitter
+
+            recovery = RecoveryPolicy(
+                max_retries=self.ns.recovery_max_retries,
+                on_exhausted=self.ns.recovery_policy,
+                damping=self.ns.recovery_damping,
+                max_consecutive_failures=(
+                    self.ns.recovery_max_consecutive_failures))
+            events = EventEmitter()
+            events.register_listener(
+                lambda e: self.logger.warn(f"recovery event: {e}"))
         for gi, (f_cfgs, r_cfgs, fac_cfgs) in enumerate(combos):
             desc = (f"grid[{gi}]: fixed={ {k: v.render() for k, v in f_cfgs.items()} } "
                     f"random={ {k: v.render() for k, v in r_cfgs.items()} }")
@@ -483,7 +533,9 @@ class GameTrainingDriver:
                     logger=self.logger,
                     checkpoint_manager=ckpt_mgr,
                     start_iteration=start_iteration,
-                    initial_best=initial_best)
+                    initial_best=initial_best,
+                    recovery=recovery,
+                    events=events)
             results.append((desc, result))
             metric = result.best_metric
             if metric is not None:
@@ -557,7 +609,8 @@ class GameTrainingDriver:
         with open(os.path.join(ns.output_dir, "metrics.json"), "w") as fh:
             json.dump(record, fh, indent=1)
 
-        if ns.model_output_mode != ModelOutputMode.NONE:
+        output_mode = ns.model_output_mode or ModelOutputMode.ALL
+        if output_mode != ModelOutputMode.NONE:
             entity_vocabs = dict(self.train_data.id_vocabs)
             model = (best_result.best_model if best_result.best_model
                      is not None else best_result.model)
@@ -566,7 +619,7 @@ class GameTrainingDriver:
                 self.index_maps, entity_vocabs=entity_vocabs,
                 num_output_files=ns.num_output_files_for_random_effect_model,
                 task=self.task)
-            if ns.model_output_mode == ModelOutputMode.ALL:
+            if output_mode == ModelOutputMode.ALL:
                 for gi, (_, result) in enumerate(results):
                     save_game_model(
                         result.model,
@@ -576,6 +629,47 @@ class GameTrainingDriver:
                             ns.num_output_files_for_random_effect_model),
                         task=self.task)
         return best_result
+
+
+def _check_multihost_args(ns: argparse.Namespace) -> None:
+    """Multi-host config validation, run BEFORE any worker (or supervisor)
+    starts: a deterministic config error must fail in under a second with
+    the real message, not burn a supervisor's restart budget. Fails fast
+    on flags the multi-host path does not implement — silently ignoring
+    them would hand a user expecting the single-process driver's outputs
+    (saved avro models, validation metrics, resumable checkpoints,
+    divergence recovery) nothing at all."""
+    if not ns.coordinator:
+        raise ValueError(
+            "--coordinator host:port is required with --num-processes > 1")
+    if not (ns.feature_name_and_term_set_path
+            or getattr(ns, "offheap_indexmap_dir", None)):
+        raise ValueError(
+            "multi-host mode needs pre-built feature maps: pass "
+            "--feature-name-and-term-set-path or --offheap-indexmap-dir "
+            "(every process must hold identical maps)")
+    unsupported = []
+    # the argparse default (None) is not a request for model output; only
+    # an EXPLICIT ALL/BEST is rejected
+    if ns.model_output_mode not in (None, ModelOutputMode.NONE):
+        unsupported.append(
+            f"--model-output-mode {ns.model_output_mode} (only NONE: "
+            f"results are written as multihost_result.p<i>.npz, not avro "
+            f"model dirs)")
+    if ns.validate_input_dirs:
+        unsupported.append("--validate-input-dirs")
+    if ns.evaluator_type.strip():
+        unsupported.append("--evaluator-type")
+    if ns.checkpoint_dir:
+        unsupported.append("--checkpoint-dir")
+    if ns.recovery_policy != "none":
+        unsupported.append(
+            "--recovery-policy (divergence recovery is wired into the "
+            "single-process coordinate-descent loop only)")
+    if unsupported:
+        raise ValueError(
+            "multi-host mode (--num-processes > 1) does not support: "
+            + "; ".join(unsupported))
 
 
 def _run_multihost(ns: argparse.Namespace) -> None:
@@ -591,15 +685,8 @@ def _run_multihost(ns: argparse.Namespace) -> None:
     from photon_ml_tpu.parallel.multihost import run_game_worker
     from photon_ml_tpu.utils.date_range import resolve_input_paths
 
-    if not ns.coordinator:
-        raise ValueError(
-            "--coordinator host:port is required with --num-processes > 1")
-    if not (ns.feature_name_and_term_set_path
-            or getattr(ns, "offheap_indexmap_dir", None)):
-        raise ValueError(
-            "multi-host mode needs pre-built feature maps: pass "
-            "--feature-name-and-term-set-path or --offheap-indexmap-dir "
-            "(every process must hold identical maps)")
+    # config was validated by _check_multihost_args in main() — the single
+    # validation site, BEFORE any supervisor starts
     os.makedirs(ns.output_dir, exist_ok=True)
     driver = GameTrainingDriver(ns, logger=PhotonLogger(
         os.path.join(ns.output_dir,
@@ -699,10 +786,66 @@ def _run_multihost(ns: argparse.Namespace) -> None:
         driver.logger.close()
 
 
+_SUPERVISED_ENV = "PHOTON_GAME_SUPERVISED"
+
+
+def _run_supervised(ns: argparse.Namespace, argv: Sequence[str]) -> None:
+    """Supervise this host's multi-host worker: re-exec the driver as a
+    child process and relaunch it with bounded exponential backoff +
+    jitter when it crashes (peer death included — the survivors error out
+    within the heartbeat bound and every host's supervisor re-forms the
+    gang on the coordinator). Restart counts land in the driver log and
+    on stdout (``SUPERVISOR_OK worker=<pid> restarts=<n>``)."""
+    import subprocess
+
+    from photon_ml_tpu.parallel.multihost import (
+        SupervisorExhaustedError,
+        WorkerSupervisor,
+    )
+
+    os.makedirs(ns.output_dir, exist_ok=True)
+    logger = PhotonLogger(
+        os.path.join(ns.output_dir,
+                     f"supervisor.p{ns.process_id}.log"), echo=False)
+    name = f"worker p{ns.process_id}"
+
+    def spawn(attempt: int):
+        env = dict(os.environ)
+        env[_SUPERVISED_ENV] = "1"
+        logger.info(f"{name}: launch attempt {attempt}")
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "photon_ml_tpu.cli.game_training_driver", *argv], env=env)
+
+    sup = WorkerSupervisor(
+        spawn, max_restarts=ns.max_worker_restarts,
+        backoff_base_seconds=ns.worker_backoff_base,
+        backoff_max_seconds=ns.worker_backoff_max,
+        name=name, log=logger.warn)
+    try:
+        restarts = sup.run()
+    except SupervisorExhaustedError as e:
+        logger.error(f"{name}: {e}")
+        logger.close()
+        raise SystemExit(
+            f"multi-host worker process {ns.process_id} failed permanently "
+            f"after {e.restarts} restart(s); see the per-process driver "
+            f"log under {ns.output_dir}") from e
+    logger.info(f"{name}: completed with {restarts} restart(s)")
+    logger.close()
+    print(f"SUPERVISOR_OK worker=p{ns.process_id} restarts={restarts}",
+          flush=True)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     enable_persistent_compile_cache()
-    ns = parse_args(argv if argv is not None else sys.argv[1:])
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    ns = parse_args(argv)
     if ns.num_processes > 1:
+        _check_multihost_args(ns)
+        if ns.max_worker_restarts > 0 and not os.environ.get(
+                _SUPERVISED_ENV):
+            return _run_supervised(ns, argv)
         return _run_multihost(ns)
     driver = GameTrainingDriver(ns)
     try:

@@ -17,6 +17,7 @@ all score vectors are sample-major ``[N]`` device arrays.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from typing import Callable, Optional
 
@@ -29,8 +30,71 @@ from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optimize.config import TASK_LOSS_NAME, TaskType
+from photon_ml_tpu.utils.events import (
+    EventEmitter,
+    FaultEvent,
+    RecoveryEvent,
+)
+from photon_ml_tpu.utils.faults import InjectedFault, fault_point
 
 Array = jnp.ndarray
+
+
+class CoordinateDivergenceError(RuntimeError):
+    """A coordinate update produced a non-finite state or objective."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPolicy:
+    """What to do when a coordinate update diverges (non-finite state or
+    objective) or raises an injected fault.
+
+    The reference never needed this — Spark re-ran lost lineage for free
+    but had no answer to numeric divergence either (SURVEY §5.4); here
+    both are handled by one policy:
+
+    - retry the update up to ``max_retries`` times from the last-good
+      state, damping the accepted step by ``damping**attempt``. Damping
+      rescues transient faults and finite-state overflows (an Inf
+      objective from an over-long step); a DETERMINISTIC NaN solve will
+      reproduce itself and exhaust the retries — the skip/abort action
+      below is what bounds that cost;
+    - when retries are exhausted, either ``skip`` the coordinate for this
+      sweep (keep the last-good state, continue degraded) or ``abort``;
+    - abort anyway after ``max_consecutive_failures`` consecutive skipped
+      updates — a run that skips every sweep is not making progress.
+    """
+
+    max_retries: int = 2
+    on_exhausted: str = "abort"  # "skip" | "abort"
+    damping: float = 0.5
+    max_consecutive_failures: int = 3
+
+    def __post_init__(self):
+        if self.on_exhausted not in ("skip", "abort"):
+            raise ValueError(
+                f"on_exhausted must be 'skip' or 'abort', "
+                f"got {self.on_exhausted!r}")
+
+
+def _state_leaves(state):
+    return state if isinstance(state, tuple) else (state,)
+
+
+def _state_is_finite(state) -> bool:
+    # device-side reduction: one scalar comes back per leaf instead of a
+    # full state copy (per-entity matrices can be millions of rows)
+    return all(bool(jnp.all(jnp.isfinite(jnp.asarray(leaf))))
+               for leaf in _state_leaves(state))
+
+
+def _damp_toward(good, candidate, factor: float):
+    """last_good + factor * (candidate - last_good), leaf-wise."""
+    def blend(g, c):
+        return g + factor * (jnp.asarray(c) - g)
+    if isinstance(candidate, tuple):
+        return tuple(blend(g, c) for g, c in zip(good, candidate))
+    return blend(jnp.asarray(good), candidate)
 
 
 def training_loss_evaluator(task: TaskType, labels: Array, weights: Array,
@@ -82,6 +146,8 @@ def run_coordinate_descent(
     checkpoint_manager=None,
     start_iteration: int = 0,
     initial_best: Optional[tuple] = None,
+    recovery: Optional[RecoveryPolicy] = None,
+    events: Optional[EventEmitter] = None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent over ``coordinates`` in dict order.
 
@@ -90,8 +156,15 @@ def run_coordinate_descent(
     describe the training samples (sample-major). Single-coordinate runs skip
     the partial-score machinery exactly like CoordinateDescent.scala:82-120's
     special case.
+
+    With a :class:`RecoveryPolicy`, every coordinate update is guarded for
+    non-finite states/objectives and injected faults; detected faults emit
+    :class:`FaultEvent`/:class:`RecoveryEvent` on ``events`` and follow the
+    policy (retry damped / skip degraded / abort). Without one, behavior
+    is the legacy fail-through (a NaN propagates to the caller).
     """
     log = logger or (lambda s: None)
+    emit = events.send_event if events is not None else (lambda e: None)
     ids = list(coordinates)
     n = {cid: coordinates[cid].num_samples for cid in ids}
     num_samples = next(iter(n.values()))
@@ -125,20 +198,96 @@ def run_coordinate_descent(
         best_states = dict(restored_states)
         best_model = publish_game_model(coordinates, best_states)
 
+    def attempt_update(cid, it, attempt):
+        """One (possibly damped) coordinate update from last-good state;
+        raises CoordinateDivergenceError on a non-finite result."""
+        coord = coordinates[cid]
+        partial = total - scores[cid]  # Σ other coordinates (:143-151)
+        cand, tracker = coord.update(states[cid], partial)
+        cand = fault_point("cd.update", arrays=cand)
+        if attempt > 0:
+            cand = _damp_toward(states[cid], cand,
+                                recovery.damping ** attempt)
+        new_score = coord.score(cand)
+        new_total = partial + new_score
+        reg = sum(coordinates[c].regularization_value(states[c])
+                  for c in ids if c != cid)
+        reg += coord.regularization_value(cand)
+        objective = loss_eval(new_total) + reg  # (:199-205)
+        if recovery is not None and (
+                not math.isfinite(objective) or not _state_is_finite(cand)):
+            raise CoordinateDivergenceError(
+                f"iter {it} coordinate {cid}: non-finite "
+                f"{'objective' if not math.isfinite(objective) else 'state'}"
+                f" (attempt {attempt})")
+        return cand, tracker, new_score, new_total, objective
+
+    consecutive_failures = 0
     for it in range(start_iteration, num_iterations):
         for cid in ids:
             t0 = time.time()
-            coord = coordinates[cid]
-            partial = total - scores[cid]  # Σ other coordinates (:143-151)
-            states[cid], tracker = coord.update(states[cid], partial)
-            new_score = coord.score(states[cid])
-            total = partial + new_score
-            scores[cid] = new_score
-
-            reg = sum(coordinates[c].regularization_value(states[c])
-                      for c in ids)
-            objective = loss_eval(total) + reg  # (:199-205)
+            attempt = 0
+            skipped = False
+            while True:
+                try:
+                    (cand, tracker, new_score, new_total,
+                     objective) = attempt_update(cid, it, attempt)
+                    break
+                except (InjectedFault, CoordinateDivergenceError,
+                        FloatingPointError) as e:
+                    if recovery is None:
+                        raise
+                    # an InjectedFault knows its origin site (e.g.
+                    # "optimizer.gradient"); label divergence detected
+                    # here as cd.update
+                    emit(FaultEvent(point=getattr(e, "point", "cd.update"),
+                                    coordinate_id=cid,
+                                    iteration=it, message=str(e)))
+                    log(f"iter {it} coordinate {cid}: FAULT "
+                        f"(attempt {attempt}): {e}")
+                    attempt += 1
+                    if attempt <= recovery.max_retries:
+                        emit(RecoveryEvent(action="retried",
+                                           coordinate_id=cid, iteration=it,
+                                           attempts=attempt))
+                        continue
+                    if recovery.on_exhausted == "skip":
+                        skipped = True
+                        break
+                    raise RuntimeError(
+                        f"coordinate descent aborted: coordinate {cid} "
+                        f"failed {attempt} attempt(s) at iteration {it} "
+                        f"(RecoveryPolicy on_exhausted='abort')") from e
             dt = time.time() - t0
+            if skipped:
+                # Keep the last-good state and its score; continue degraded
+                # (the reference's closest analog: a failed Spark stage
+                # retried elsewhere — here the coordinate just sits out).
+                consecutive_failures += 1
+                emit(RecoveryEvent(action="skipped", coordinate_id=cid,
+                                   iteration=it, attempts=attempt))
+                log(f"iter {it} coordinate {cid}: SKIPPED after "
+                    f"{attempt} failed attempt(s) — keeping last-good "
+                    f"state ({dt:.2f}s)")
+                if consecutive_failures >= recovery.max_consecutive_failures:
+                    emit(RecoveryEvent(action="aborted", coordinate_id=cid,
+                                       iteration=it, attempts=attempt))
+                    raise RuntimeError(
+                        f"coordinate descent aborted: "
+                        f"{consecutive_failures} consecutive coordinate "
+                        f"updates failed (RecoveryPolicy "
+                        f"max_consecutive_failures="
+                        f"{recovery.max_consecutive_failures})")
+                continue
+            if attempt > 0:
+                emit(RecoveryEvent(action="recovered", coordinate_id=cid,
+                                   iteration=it, attempts=attempt))
+                log(f"iter {it} coordinate {cid}: recovered after "
+                    f"{attempt} retry(ies)")
+            consecutive_failures = 0
+            states[cid] = cand
+            total = new_total
+            scores[cid] = new_score
             log(f"iter {it} coordinate {cid}: objective={objective:.6f} "
                 f"({dt:.2f}s) — {tracker.summary()}")
 

@@ -67,6 +67,18 @@ def solver_x0(acc_dtype, shape, initial: Optional[Array]) -> Array:
     return initial.astype(jnp.promote_types(acc_dtype, initial.dtype))
 
 
+def finite_step(accepted: Array, f: Array, g: Array) -> Array:
+    """Combine a step-acceptance flag with a non-finite guard.
+
+    A NaN/Inf objective or gradient must never enter the accepted solver
+    state: divergence then surfaces as ObjectiveNotImproving at the last
+    good iterate instead of poisoning the whole carry (and, under vmap,
+    every entity lane reduced with it). Every solver body routes its
+    accept flag through here.
+    """
+    return accepted & jnp.isfinite(f) & jnp.all(jnp.isfinite(g))
+
+
 def project_box(x: Array, box: Optional[BoxConstraints]) -> Array:
     if box is None:
         return x

@@ -26,6 +26,7 @@ from jax import lax
 from photon_ml_tpu.optimize.common import (
     BoxConstraints,
     RunHistory,
+    finite_step,
     project_box,
     should_continue,
 )
@@ -165,10 +166,14 @@ def _minimize_lbfgs_impl(
             )
             x_new = x_proj
 
+        # A step into a non-finite region is never accepted: the solver
+        # stops at the last good iterate (ObjectiveNotImproving).
+        ok = finite_step(ls.ok, f_new, g_new)
+
         s = x_new - c.x
         y = g_new - c.g
         sy = jnp.dot(s, y)
-        store = ls.ok & (sy > 1e-10)
+        store = ok & (sy > 1e-10)
 
         S = jnp.where(store, c.S.at[c.head].set(s), c.S)
         Y = jnp.where(store, c.Y.at[c.head].set(y), c.Y)
@@ -178,21 +183,21 @@ def _minimize_lbfgs_impl(
         head = jnp.where(store, (c.head + 1) % m, c.head)
 
         it_new = c.it + 1
-        values = c.values.at[it_new].set(jnp.where(ls.ok, f_new, c.f))
+        values = c.values.at[it_new].set(jnp.where(ok, f_new, c.f))
         grad_norms = c.grad_norms.at[it_new].set(
-            jnp.linalg.norm(jnp.where(ls.ok, g_new, c.g)))
-        x_acc = jnp.where(ls.ok, x_new, c.x)
+            jnp.linalg.norm(jnp.where(ok, g_new, c.g)))
+        x_acc = jnp.where(ok, x_new, c.x)
         iterates = (c.iterates.at[it_new].set(x_acc)
                     if track_iterates else None)
 
         return _LBFGSCarry(
             it=it_new,
             x=x_acc,
-            f=jnp.where(ls.ok, f_new, c.f),
-            g=jnp.where(ls.ok, g_new, c.g),
+            f=jnp.where(ok, f_new, c.f),
+            g=jnp.where(ok, g_new, c.g),
             prev_f=c.f,
             S=S, Y=Y, rho=rho, valid=valid, head=head,
-            made_progress=ls.ok,
+            made_progress=ok,
             values=values, grad_norms=grad_norms, iterates=iterates,
         )
 

@@ -30,6 +30,7 @@ from jax import lax
 from photon_ml_tpu.optimize.common import (
     BoxConstraints,
     RunHistory,
+    finite_step,
     project_box,
     should_continue,
 )
@@ -156,6 +157,8 @@ def _minimize_owlqn_impl(
             ls_cond, ls_body,
             (init_alpha, c.f, c.g, c.x, jnp.int32(0), jnp.bool_(False)),
         )
+        # Non-finite trial values never enter the carry (divergence guard).
+        accepted = finite_step(accepted, f_new, g_new)
 
         s = x_new - c.x
         y = g_new - c.g  # smooth gradient difference

@@ -169,17 +169,33 @@ class GLMOptimizationProblem:
         path would silently fall back to the two-pass XLA form on a pod).
         """
         from photon_ml_tpu.parallel.mesh import DATA_AXIS, get_default_mesh
+        from photon_ml_tpu.utils.faults import fault_point
 
         mesh = get_default_mesh()
         if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
             from photon_ml_tpu.parallel.distributed import run_glm_shard_map
 
-            return run_glm_shard_map(self, batch, mesh, initial=initial)
-        dim = batch.num_features
-        x0 = solver_x0(batch.acc_dtype, dim, initial)
-        obj = self.objective()
-        x, history, progressed = self.solve(obj, batch, x0)
-        return self.publish(x, history, progressed, obj, batch)
+            model, result = run_glm_shard_map(self, batch, mesh,
+                                              initial=initial)
+        else:
+            dim = batch.num_features
+            x0 = solver_x0(batch.acc_dtype, dim, initial)
+            obj = self.objective()
+            x, history, progressed = self.solve(obj, batch, x0)
+            model, result = self.publish(x, history, progressed, obj, batch)
+        # Host-level fault site (never inside the jitted solve, where an
+        # injection would bake into the compile cache): a nan-mode fault
+        # here simulates a diverged solve for the recovery-policy tests.
+        poisoned = fault_point("optimizer.gradient",
+                               arrays=result.coefficients)
+        if poisoned is not result.coefficients:
+            result = dataclasses.replace(result, coefficients=poisoned)
+            model = GeneralizedLinearModel(
+                Coefficients(means=self.normalization
+                             .transform_model_coefficients(poisoned),
+                             variances=model.coefficients.variances),
+                self.task)
+        return model, result
 
     def regularization_value(self, coef_normalized: Array) -> float:
         """lambda-weighted penalty of a (normalized-space) coefficient vector,

@@ -32,6 +32,7 @@ from jax import lax
 from photon_ml_tpu.optimize.common import (
     BoxConstraints,
     RunHistory,
+    finite_step,
     project_box,
     should_continue,
 )
@@ -171,14 +172,22 @@ def _minimize_tron_impl(
         gs = jnp.dot(c.g, step)
         predicted = -0.5 * (gs - jnp.dot(step, residual))
         f_try, g_try = value_and_grad_fn(x_try, data)
-        actual = c.f - f_try
+        # A non-finite trial objective is "infinitely bad" for the region
+        # arithmetic: every where-comparison on a NaN is False, which
+        # would otherwise leak a NaN alpha into delta and wedge the solve
+        # permanently — +inf instead drives the shrink branch, TRON's
+        # documented rejection remedy, until the step re-enters the
+        # finite region.
+        f_arith = jnp.where(jnp.isfinite(f_try), f_try,
+                            jnp.asarray(jnp.inf, dtype))
+        actual = c.f - f_arith
         step_norm = jnp.linalg.norm(step)
 
         # First iteration: tighten the initial region to the step scale.
         delta = jnp.where(c.it == 0, jnp.minimum(c.delta, step_norm), c.delta)
 
         # Step-scale prediction alpha (TRON.scala:201-206).
-        denom = f_try - c.f - gs
+        denom = f_arith - c.f - gs
         alpha = jnp.where(denom <= 0.0, _SIGMA3,
                           jnp.maximum(_SIGMA1, -0.5 * (gs / denom)))
 
@@ -200,7 +209,10 @@ def _minimize_tron_impl(
             ),
         )
 
-        improved = actual > _ETA0 * predicted
+        # Non-finite trial values count as an improvement failure (the NaN
+        # comparison already rejects f_try; the explicit guard also keeps a
+        # NaN gradient out of the accepted state).
+        improved = finite_step(actual > _ETA0 * predicted, f_try, g_try)
         x_new = jnp.where(improved, project_box(x_try, box) if box is not None
                           else x_try, c.x)
         if box is not None:

@@ -26,6 +26,29 @@ import argparse
 import numpy as np
 
 
+def _distributed_initialize(coordinator: str, num_processes: int,
+                            process_id: int,
+                            initialization_timeout: int = 300,
+                            heartbeat_timeout: int = 100) -> None:
+    """``jax.distributed.initialize`` with version-tolerant kwargs.
+
+    The timeout kwargs moved/appeared across jax releases
+    (``heartbeat_timeout_seconds`` does not exist in older ones); filter
+    by the live signature so a worker fails on REAL cluster problems, not
+    on a TypeError before it ever joins."""
+    import inspect
+
+    import jax
+
+    kwargs = dict(coordinator_address=coordinator,
+                  num_processes=num_processes, process_id=process_id,
+                  initialization_timeout=initialization_timeout,
+                  heartbeat_timeout_seconds=heartbeat_timeout)
+    params = inspect.signature(jax.distributed.initialize).parameters
+    jax.distributed.initialize(
+        **{k: v for k, v in kwargs.items() if k in params})
+
+
 def _synthetic(rows: int, dim: int, seed: int):
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(rows, dim)).astype(np.float32)
@@ -67,9 +90,7 @@ def run_worker(process_id: int, num_processes: int, coordinator: str,
     from photon_ml_tpu.parallel.distributed import run_glm_shard_map
     from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    _distributed_initialize(coordinator, num_processes, process_id)
     devs = jax.devices()  # GLOBAL device list across processes
     n_local = len(jax.local_devices())
     assert len(devs) == n_local * num_processes, (len(devs), n_local)
@@ -140,29 +161,34 @@ def allgather_ragged(arr: np.ndarray) -> list[np.ndarray]:
     pad = np.zeros((cap,) + arr.shape[1:], arr.dtype)
     pad[: arr.shape[0]] = arr
     g = np.asarray(mhu.process_allgather(pad))
+    if g.ndim == pad.ndim:  # single-process: no leading process axis added
+        g = g[None]
     return [g[p, : int(ns[p])] for p in range(len(ns))]
 
 
 def allgather_strings(strings: np.ndarray) -> list[np.ndarray]:
     """Exchange per-process string arrays (object/str dtype) across all
-    processes via a null-separated uint8 buffer."""
-    from jax.experimental import multihost_utils as mhu
-
-    joined = "\x00".join(str(s) for s in strings)
-    buf = np.frombuffer(joined.encode("utf-8"), dtype=np.uint8)
-    # fixed-size count: one collective, not allgather_ragged's two
-    counts = np.asarray(mhu.process_allgather(
-        np.asarray([len(strings)], dtype=np.int64))).reshape(-1)
-    bufs = allgather_ragged(buf)
+    processes. Each string is length-prefixed — a per-process int64 length
+    array rides alongside the concatenated UTF-8 buffer — so ids are
+    reconstructed by exact byte offsets and arbitrary content (including
+    NUL bytes, which a separator-based framing would mis-split on) round-
+    trips intact."""
+    encoded = [str(s).encode("utf-8") for s in strings]
+    lens = np.asarray([len(b) for b in encoded], dtype=np.int64)
+    buf = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+    lens_g = allgather_ragged(lens)
+    bufs_g = allgather_ragged(buf)
     out = []
-    for c, b in zip(counts, bufs):
-        k = int(c)
-        if k == 0:
+    for ln, b in zip(lens_g, bufs_g):
+        assert int(ln.sum()) == b.shape[0], (int(ln.sum()), b.shape[0])
+        if len(ln) == 0:
             out.append(np.zeros(0, dtype=object))
             continue
-        decoded = bytes(b).decode("utf-8").split("\x00")
-        assert len(decoded) == k, (len(decoded), k)
-        out.append(np.asarray(decoded, dtype=object))
+        ends = np.cumsum(ln)
+        raw = b.tobytes()
+        out.append(np.asarray(
+            [raw[e - n:e].decode("utf-8")
+             for n, e in zip(ln.tolist(), ends.tolist())], dtype=object))
     return out
 
 
@@ -188,9 +214,9 @@ def allgather_csr(mat) -> list:
 # Multi-host GAME training (fixed + random effect)
 # ---------------------------------------------------------------------------
 
-#: Pad-row entity id: never collides with data ids (and must not contain
-#: the "\x00" separator allgather_strings joins on); its coefficient row
-#: is dropped from results.
+#: Pad-row entity id: never collides with data ids (allgather_strings is
+#: length-prefixed, so the value itself is unconstrained); its coefficient
+#: row is dropped from results.
 _PAD_ENTITY = "\x01__pad__\x01"
 
 
@@ -252,15 +278,20 @@ def run_game_worker(
     if default_platform_is_cpu():
         jax.config.update("jax_platforms", "cpu")
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator, num_processes=num_processes,
-        process_id=process_id,
+    _distributed_initialize(
+        coordinator, num_processes, process_id,
         initialization_timeout=initialization_timeout,
-        heartbeat_timeout_seconds=heartbeat_timeout)
-    # Fault-injection hook for the committed failure-path tests: a worker
+        heartbeat_timeout=heartbeat_timeout)
+    # Fault-injection hooks for the committed failure-path tests: a worker
     # that dies mid-run (after joining the cluster, before any collective)
     # must surface as a bounded coordination error on the survivors, not a
-    # hang — Spark's task-failure semantics analog (SURVEY §5.3).
+    # hang — Spark's task-failure semantics analog (SURVEY §5.3). The
+    # registry point ("worker.start", tagged by process id) is the general
+    # switchboard (kill/delay/raise via PHOTON_FAULTS); the env hook below
+    # is the legacy spelling kept for the original survivor-bound test.
+    from photon_ml_tpu.utils.faults import fault_point
+
+    fault_point("worker.start", tag=str(process_id))
     if os.environ.get("PHOTON_MH_TEST_EXIT_AFTER_INIT") == str(process_id):
         os._exit(17)
     try:
@@ -594,6 +625,101 @@ def _game_worker_body(
         "re_entity_axis_devices": int(ent_mesh.shape[ENTITY_AXIS]),
         "factored": factored_flags,
     }
+
+
+# ---------------------------------------------------------------------------
+# Worker supervision: relaunch crashed worker processes with bounded backoff
+# ---------------------------------------------------------------------------
+
+
+class SupervisorExhaustedError(RuntimeError):
+    """The supervised worker kept failing past its restart budget."""
+
+    def __init__(self, name: str, restarts: int, last_rc: int):
+        super().__init__(
+            f"{name}: worker failed permanently after {restarts} "
+            f"restart(s) (last exit code {last_rc})")
+        self.restarts = restarts
+        self.last_rc = last_rc
+
+
+class WorkerSupervisor:
+    """Relaunch a crashed worker process with bounded exponential backoff.
+
+    The Spark-driver analog of task retry, lifted to the process level:
+    each host runs one supervisor around its worker. When any gang member
+    dies, the survivors' collectives error out within the heartbeat bound
+    (see TestMultihostFailurePaths), every host's supervisor relaunches
+    its own worker, and the gang re-forms on the coordinator — no cross-
+    host control plane is needed. Backoff is exponential with
+    deterministic per-(name, attempt) jitter so a whole gang restarting
+    at once doesn't hammer the coordinator in lockstep.
+
+    ``spawn(attempt)`` must start the worker and return an object with
+    ``wait() -> returncode`` (subprocess.Popen fits).
+    """
+
+    def __init__(self, spawn, max_restarts: int = 2,
+                 backoff_base_seconds: float = 1.0,
+                 backoff_max_seconds: float = 30.0,
+                 jitter_fraction: float = 0.25,
+                 name: str = "worker", log=None):
+        self.spawn = spawn
+        self.max_restarts = max_restarts
+        self.backoff_base_seconds = backoff_base_seconds
+        self.backoff_max_seconds = backoff_max_seconds
+        self.jitter_fraction = jitter_fraction
+        self.name = name
+        self.log = log or (lambda s: None)
+        self.restart_count = 0
+
+    def backoff_seconds(self, attempt: int) -> float:
+        """Exponential backoff for restart ``attempt`` (1-based) with a
+        deterministic jitter derived from (name, attempt) — reproducible
+        runs, de-synchronized gang members."""
+        import zlib
+
+        base = min(self.backoff_base_seconds * (2.0 ** (attempt - 1)),
+                   self.backoff_max_seconds)
+        seed = zlib.crc32(f"{self.name}:{attempt}".encode()) / 0xFFFFFFFF
+        return base * (1.0 + self.jitter_fraction * (2.0 * seed - 1.0))
+
+    def run(self) -> int:
+        """Run the worker to successful completion; returns the number of
+        restarts it took. Raises SupervisorExhaustedError once
+        ``max_restarts`` relaunches have failed."""
+        import time
+
+        while True:
+            attempt = self.restart_count
+            proc = self.spawn(attempt)
+            try:
+                rc = proc.wait()
+            except BaseException:
+                # an interrupted/crashed supervisor must not orphan a
+                # live worker (it would keep training and hold the
+                # coordinator port/gang slot)
+                for method in ("terminate", "kill"):
+                    try:
+                        getattr(proc, method, lambda: None)()
+                    except OSError:
+                        pass
+                if hasattr(proc, "poll"):
+                    proc.wait()
+                raise
+            if rc == 0:
+                return self.restart_count
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                self.log(f"{self.name}: exit code {rc}; restart budget "
+                         f"({self.max_restarts}) exhausted")
+                raise SupervisorExhaustedError(
+                    self.name, self.restart_count - 1, rc)
+            delay = self.backoff_seconds(self.restart_count)
+            self.log(f"{self.name}: exit code {rc}; restart "
+                     f"{self.restart_count}/{self.max_restarts} in "
+                     f"{delay:.1f}s")
+            time.sleep(delay)
 
 
 def main(argv=None):

@@ -8,6 +8,13 @@ TPU-idiomatic upgrade the survey prescribes: periodic snapshots of
 restart. Format: one directory per step holding a JSON manifest (structure +
 scalars) and an ``.npz`` of array leaves — readable without the framework.
 
+Hardened against torn and corrupted writes (the Spark-lineage-free world
+owns its own durability): data files carry crc32 checksums in the
+manifest, every file is fsync'd before the atomic rename publishes the
+step, and :meth:`CheckpointManager.latest_valid_step` verifies integrity
+so a restore falls back PAST a truncated/corrupt/partial step dir to the
+newest intact one instead of dying on it.
+
 API mirrors an orbax CheckpointManager (save/restore/latest_step/all_steps)
 without taking the dependency for plain-array states.
 """
@@ -17,13 +24,21 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import zlib
 from typing import Any, Optional
 
 import numpy as np
 
+from photon_ml_tpu.utils.faults import fault_point
+
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"
 _STEP_PREFIX = "step_"
+_TMP_SUFFIX = ".tmp"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """An explicitly requested step failed integrity verification."""
 
 
 def _flatten(obj: Any, path: str, arrays: dict[str, np.ndarray]):
@@ -55,8 +70,37 @@ def _unflatten(spec: Any, arrays: dict[str, np.ndarray]) -> Any:
     return arrays[spec["key"]]
 
 
+def _file_crc32(path: str) -> str:
+    crc = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class CheckpointManager:
-    """Step-indexed checkpoint directory with retention."""
+    """Step-indexed checkpoint directory with retention + integrity."""
 
     def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
         self.directory = directory
@@ -69,7 +113,8 @@ class CheckpointManager:
     def all_steps(self) -> list[int]:
         steps = []
         for name in os.listdir(self.directory):
-            if name.startswith(_STEP_PREFIX):
+            if name.startswith(_STEP_PREFIX) \
+                    and not name.endswith(_TMP_SUFFIX):
                 manifest = os.path.join(self.directory, name, _MANIFEST)
                 if os.path.exists(manifest):  # ignore partial writes
                     steps.append(int(name[len(_STEP_PREFIX):]))
@@ -79,30 +124,84 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    # -- integrity ---------------------------------------------------------
+
+    def verify_step(self, step: int) -> bool:
+        """True when ``step``'s manifest parses and every checksummed file
+        is present with matching crc32. Pre-checksum (v1) step dirs pass
+        on file presence alone."""
+        d = self._step_dir(step)
+        try:
+            with open(os.path.join(d, _MANIFEST)) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            return False
+        if manifest.get("step") != step or "skeleton" not in manifest:
+            return False
+        checksums = manifest.get("checksums")
+        if checksums is None:  # v1 manifest: presence check only
+            return os.path.exists(os.path.join(d, _ARRAYS))
+        for name, crc in checksums.items():
+            path = os.path.join(d, name)
+            try:
+                if _file_crc32(path) != crc:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def latest_valid_step(self) -> Optional[int]:
+        """Newest step that passes integrity verification, scanning back
+        past truncated/corrupt/partial step dirs (the restore entry point
+        after an unclean shutdown)."""
+        for step in reversed(self.all_steps()):
+            if self.verify_step(step):
+                return step
+        return None
+
+    # -- save/restore ------------------------------------------------------
+
     def save(self, step: int, state: Any) -> None:
-        """Atomic-ish: write into a tmp dir, then rename."""
+        """Durable and atomic: write + checksum + fsync into a tmp dir,
+        then rename; the manifest carries the data files' crc32s."""
         final = self._step_dir(step)
-        tmp = final + ".tmp"
+        tmp = final + _TMP_SUFFIX
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         arrays: dict[str, np.ndarray] = {}
         skeleton = _flatten(state, "root", arrays)
-        np.savez(os.path.join(tmp, _ARRAYS), **arrays)
+        arrays_path = os.path.join(tmp, _ARRAYS)
+        np.savez(arrays_path, **arrays)
+        _fsync_file(arrays_path)
         # manifest written LAST: its presence marks the step complete
         with open(os.path.join(tmp, _MANIFEST), "w") as fh:
-            json.dump({"step": step, "skeleton": skeleton}, fh)
+            json.dump({"step": step, "format_version": 2,
+                       "checksums": {_ARRAYS: _file_crc32(arrays_path)},
+                       "skeleton": skeleton}, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        fault_point("ckpt.save", path=tmp)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)
+        _fsync_dir(self.directory)
         self._retain()
 
     def restore(self, step: Optional[int] = None) -> Any:
+        """Restore ``step``, or (by default) the newest step that passes
+        integrity verification. An explicitly requested corrupt step
+        raises :class:`CheckpointCorruptionError` rather than returning
+        garbage."""
         if step is None:
-            step = self.latest_step()
+            step = self.latest_valid_step()
             if step is None:
                 raise FileNotFoundError(
-                    f"no checkpoints under {self.directory}")
+                    f"no valid checkpoints under {self.directory}")
+        elif not self.verify_step(step):
+            raise CheckpointCorruptionError(
+                f"checkpoint step {step} under {self.directory} failed "
+                f"integrity verification")
         d = self._step_dir(step)
         with open(os.path.join(d, _MANIFEST)) as fh:
             manifest = json.load(fh)

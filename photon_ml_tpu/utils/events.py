@@ -51,6 +51,33 @@ class PhotonOptimizationLogEvent(Event):
     per_iteration_metrics: Optional[list[dict[str, float]]] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class FaultEvent(Event):
+    """A detected (or injected) fault: non-finite objective/state, an
+    exception out of a coordinate update, a failed checkpoint write. The
+    robustness layer's observable record (no reference analog — Spark's
+    lineage recovery was silent)."""
+
+    point: str  # fault-point name, e.g. "cd.update"
+    coordinate_id: Optional[str] = None
+    iteration: Optional[int] = None
+    message: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryEvent(Event):
+    """The recovery action taken for a fault: ``retried`` (re-ran the
+    update from last-good state), ``recovered`` (a retry produced a finite
+    state), ``skipped`` (kept last-good and moved on, degraded), or
+    ``aborted`` (policy exhausted)."""
+
+    action: str
+    coordinate_id: Optional[str] = None
+    iteration: Optional[int] = None
+    attempts: int = 0
+    message: str = ""
+
+
 EventListener = Callable[[Event], None]
 
 

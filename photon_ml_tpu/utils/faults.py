@@ -1,0 +1,294 @@
+"""Deterministic fault injection: named fault points with scripted failures.
+
+The reference inherited fault tolerance from Spark's lineage-based task
+retry (SURVEY §1, §5.4) and never had to test its own failure paths; the
+multi-controller JAX port owns every failure mode itself, so it needs a
+way to script them reproducibly. This module is the single switchboard:
+production code calls :func:`fault_point` at named sites and tests (or an
+operator drilling a cluster) arm failures against those names.
+
+Fault points instrumented in the codebase:
+
+- ``cd.update``          — after each coordinate-descent coordinate update
+                           (game/coordinate_descent.py)
+- ``optimizer.gradient`` — on the solver output of a GLM solve
+                           (optimize/problem.py)
+- ``ckpt.save``          — after a checkpoint's tmp dir is fully written,
+                           before the atomic rename (utils/checkpoint.py)
+- ``worker.start``       — in a multi-host worker right after
+                           ``jax.distributed.initialize``
+                           (parallel/multihost.py)
+
+Modes: ``raise`` (InjectedFault), ``nan`` (poison the arrays passed to the
+point), ``delay`` (sleep), ``corrupt`` (flip bytes of the file/dir passed
+to the point), ``kill`` (``os._exit``).
+
+Arming:
+
+- programmatic: ``arm("cd.update", "raise", times=2)``
+- environment:  ``PHOTON_FAULTS="worker.start@0=kill:1;ckpt.save=raise:1"``
+  — ``point[@tag]=mode[:times[:arg]]``, ``;``-separated. ``times`` bounds
+  total firings (default 1); ``arg`` is seconds for ``delay`` and the exit
+  code for ``kill``. A ``@tag`` suffix restricts the spec to call sites
+  passing that ``tag`` (e.g. the multi-host process id), so one shared
+  environment can target a single worker of a gang.
+
+Cross-process accounting: when ``PHOTON_FAULTS_STATE_DIR`` is set, each
+firing atomically claims a marker file there (``O_CREAT|O_EXCL``), so a
+``times=1`` kill fires in exactly one process incarnation even after a
+supervisor relaunches the worker with the same environment — the property
+the gang-restart tests depend on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Optional
+
+ENV_SPECS = "PHOTON_FAULTS"
+ENV_STATE_DIR = "PHOTON_FAULTS_STATE_DIR"
+
+MODES = ("raise", "nan", "delay", "corrupt", "kill")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``raise``-mode fault point (and by mis-armed specs)."""
+
+    def __init__(self, point: str, message: str = ""):
+        super().__init__(message or f"injected fault at {point!r}")
+        self.point = point
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed failure: fires at ``point`` up to ``times`` times."""
+
+    point: str
+    mode: str
+    times: int = 1
+    tag: Optional[str] = None  # only fire for matching fault_point(tag=...)
+    delay_seconds: float = 1.0
+    exit_code: int = 17
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown fault mode {self.mode!r}; "
+                             f"expected one of {MODES}")
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed specs + per-point hit counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: list[FaultSpec] = []
+        self._hits: dict[str, int] = {}
+        self._env_loaded = False
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, point: str, mode: str, times: int = 1,
+            tag: Optional[str] = None, delay_seconds: float = 1.0,
+            exit_code: int = 17) -> FaultSpec:
+        spec = FaultSpec(point=point, mode=mode, times=times, tag=tag,
+                         delay_seconds=delay_seconds, exit_code=exit_code)
+        with self._lock:
+            self._specs.append(spec)
+        return spec
+
+    def disarm_all(self) -> None:
+        with self._lock:
+            self._specs.clear()
+            self._hits.clear()
+            # a later env change (tests monkeypatching PHOTON_FAULTS) must
+            # be re-read after an explicit reset
+            self._env_loaded = False
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    # -- env parsing -------------------------------------------------------
+
+    def _ensure_env_loaded(self) -> None:
+        with self._lock:
+            if self._env_loaded:
+                return
+            self._env_loaded = True
+            raw = os.environ.get(ENV_SPECS, "")
+        for spec in parse_fault_specs(raw):
+            with self._lock:
+                self._specs.append(spec)
+
+    # -- firing ------------------------------------------------------------
+
+    def _claim(self, spec: FaultSpec) -> bool:
+        """Reserve one firing of ``spec``; False when its budget is spent.
+
+        With a state dir the budget is shared across processes via
+        exclusive-create marker files; otherwise it is per-process.
+        """
+        state_dir = os.environ.get(ENV_STATE_DIR)
+        if not state_dir:
+            with self._lock:
+                if spec.fired >= spec.times:
+                    return False
+                spec.fired += 1
+                return True
+        os.makedirs(state_dir, exist_ok=True)
+        # the key carries the FULL spec identity (not just point+mode):
+        # two distinct specs on the same point must not contend for the
+        # same markers and silently starve one another's budget
+        key = "_".join(str(p) for p in (
+            spec.point, spec.tag or "", spec.mode, spec.times,
+            spec.delay_seconds, spec.exit_code)).replace(os.sep, "_")
+        for n in range(spec.times):
+            marker = os.path.join(state_dir, f"{key}.{n}")
+            try:
+                fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                with self._lock:
+                    spec.fired += 1
+                return True
+            except FileExistsError:
+                continue
+        return False
+
+    def fire(self, point: str, tag: Optional[str] = None,
+             arrays: Any = None, path: Optional[str] = None) -> Any:
+        """Run the fault protocol for ``point``; returns ``arrays``
+        (possibly poisoned). See :func:`fault_point`."""
+        self._ensure_env_loaded()
+        with self._lock:
+            specs = [s for s in self._specs
+                     if s.point == point and (s.tag is None or s.tag == tag)]
+        if not specs:
+            return arrays
+        for spec in specs:
+            if not self._claim(spec):
+                continue
+            with self._lock:
+                self._hits[point] = self._hits.get(point, 0) + 1
+            if spec.mode == "raise":
+                raise InjectedFault(point)
+            if spec.mode == "delay":
+                time.sleep(spec.delay_seconds)
+            elif spec.mode == "kill":
+                os._exit(spec.exit_code)
+            elif spec.mode == "nan":
+                arrays = poison_arrays(arrays)
+            elif spec.mode == "corrupt":
+                if path is None:
+                    raise InjectedFault(
+                        point, f"corrupt-mode fault at {point!r} needs a "
+                               f"path at the call site")
+                corrupt_path(path)
+        return arrays
+
+
+def parse_fault_specs(raw: str) -> list[FaultSpec]:
+    """Parse the ``PHOTON_FAULTS`` syntax (see module docstring)."""
+    specs = []
+    for item in raw.split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, rhs = item.partition("=")
+        if not rhs:
+            raise ValueError(f"bad fault spec {item!r}: expected "
+                             f"point[@tag]=mode[:times[:arg]]")
+        point, _, tag = name.partition("@")
+        parts = rhs.split(":")
+        mode = parts[0]
+        times = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+        kwargs: dict[str, Any] = {}
+        if len(parts) > 2 and parts[2]:
+            if mode == "delay":
+                kwargs["delay_seconds"] = float(parts[2])
+            elif mode == "kill":
+                kwargs["exit_code"] = int(parts[2])
+        specs.append(FaultSpec(point=point.strip(), mode=mode, times=times,
+                               tag=tag or None, **kwargs))
+    return specs
+
+
+def poison_arrays(arrays: Any) -> Any:
+    """NaN-fill every FLOAT array leaf of a (possibly nested) structure;
+    scalars and None pass through untouched. Integer/bool leaves are left
+    intact rather than silently filled with a finite sentinel
+    (``full_like(int_arr, nan)`` yields INT_MIN, which would evade every
+    is-finite divergence guard and corrupt state without tripping
+    recovery)."""
+    import numpy as np
+
+    if arrays is None:
+        return None
+    if isinstance(arrays, dict):
+        return {k: poison_arrays(v) for k, v in arrays.items()}
+    if isinstance(arrays, (list, tuple)):
+        out = [poison_arrays(v) for v in arrays]
+        return type(arrays)(out)
+    if hasattr(arrays, "shape") and hasattr(arrays, "dtype"):
+        import jax.numpy as jnp
+
+        # jnp.issubdtype, not np: it also classifies ml_dtypes like
+        # bfloat16 as inexact
+        if not jnp.issubdtype(arrays.dtype, jnp.inexact):
+            return arrays
+        if isinstance(arrays, np.ndarray):
+            return np.full_like(arrays, np.nan)
+        return jnp.full_like(arrays, jnp.nan)
+    return arrays
+
+
+def corrupt_path(path: str) -> None:
+    """Flip bytes in the middle of ``path`` (a file), or of every regular
+    file under it (a directory) — the scripted disk-corruption primitive
+    the checkpoint-hardening tests drive."""
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            sub = os.path.join(path, name)
+            if os.path.isfile(sub):
+                corrupt_path(sub)
+        return
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        chunk = fh.read(min(64, max(1, size - size // 2)))
+        fh.seek(size // 2)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+_REGISTRY = FaultRegistry()
+
+
+def arm(point: str, mode: str, times: int = 1, tag: Optional[str] = None,
+        **kwargs) -> FaultSpec:
+    """Arm a fault programmatically (tests); see FaultRegistry.arm."""
+    return _REGISTRY.arm(point, mode, times=times, tag=tag, **kwargs)
+
+
+def disarm_all() -> None:
+    _REGISTRY.disarm_all()
+
+
+def hits(point: str) -> int:
+    """How many times faults fired at ``point`` in THIS process."""
+    return _REGISTRY.hits(point)
+
+
+def fault_point(point: str, tag: Optional[str] = None, arrays: Any = None,
+                path: Optional[str] = None) -> Any:
+    """Declare a named fault site. No-op (returns ``arrays`` unchanged)
+    unless a matching spec is armed via :func:`arm` or ``PHOTON_FAULTS``.
+
+    ``arrays`` is the structure a ``nan``-mode fault poisons; ``path`` is
+    the file/dir a ``corrupt``-mode fault flips bytes in; ``tag`` lets a
+    spec target one caller (e.g. one process id) among many.
+    """
+    return _REGISTRY.fire(point, tag=tag, arrays=arrays, path=path)

@@ -1,0 +1,584 @@
+"""Fault-tolerance layer: injection registry, divergence recovery,
+hardened checkpoints, and the worker supervisor's local semantics.
+
+The multi-process gang-restart end-to-end test lives in
+tests/test_zz_supervisor_multihost.py (sorts last; needs a backend with
+multiprocess support)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
+from photon_ml_tpu.game.coordinate_descent import (
+    RecoveryPolicy,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.game.dataset import (
+    GameDataset,
+    build_fixed_effect_dataset,
+)
+from photon_ml_tpu.optimize.config import (
+    GLMOptimizationConfiguration,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    TaskType,
+)
+from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
+    CheckpointManager,
+)
+from photon_ml_tpu.utils.events import (
+    EventEmitter,
+    FaultEvent,
+    RecoveryEvent,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection registry
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_unarmed_point_is_noop(self):
+        arr = np.ones(3)
+        out = faults.fault_point("cd.update", arrays=arr)
+        assert out is arr
+        assert faults.hits("cd.update") == 0
+
+    def test_raise_mode_with_times_budget(self):
+        faults.arm("cd.update", "raise", times=2)
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault):
+                faults.fault_point("cd.update")
+        # budget spent: third call passes through
+        faults.fault_point("cd.update")
+        assert faults.hits("cd.update") == 2
+
+    def test_nan_mode_poisons_nested_arrays(self):
+        faults.arm("optimizer.gradient", "nan")
+        state = {"a": np.ones(4), "b": (jnp.ones(2), 7, None)}
+        out = faults.fault_point("optimizer.gradient", arrays=state)
+        assert np.isnan(out["a"]).all()
+        assert np.isnan(np.asarray(out["b"][0])).all()
+        assert out["b"][1] == 7 and out["b"][2] is None
+        # second call: budget (default 1) spent
+        arr = np.ones(3)
+        assert faults.fault_point("optimizer.gradient", arrays=arr) is arr
+
+    def test_nan_mode_leaves_integer_arrays_intact(self):
+        # full_like(int, nan) would write finite INT_MIN — a "poison"
+        # invisible to every is-finite guard; int leaves must pass through
+        ints = np.arange(4)
+        codes = jnp.arange(3, dtype=jnp.int32)
+        out = faults.poison_arrays({"i": ints, "c": codes,
+                                    "f": np.ones(2),
+                                    "bf": jnp.ones(2, jnp.bfloat16)})
+        np.testing.assert_array_equal(out["i"], ints)
+        np.testing.assert_array_equal(np.asarray(out["c"]), codes)
+        assert np.isnan(out["f"]).all()
+        assert jnp.isnan(out["bf"].astype(jnp.float32)).all()
+
+    def test_tag_filtering(self):
+        faults.arm("worker.start", "raise", tag="1")
+        faults.fault_point("worker.start", tag="0")  # other worker: no-op
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("worker.start", tag="1")
+
+    def test_env_spec_parsing(self):
+        specs = faults.parse_fault_specs(
+            "worker.start@0=kill:1:7; ckpt.save=raise ;"
+            "cd.update=delay:2:0.5")
+        by_point = {(s.point, s.tag): s for s in specs}
+        kill = by_point[("worker.start", "0")]
+        assert kill.mode == "kill" and kill.times == 1 and kill.exit_code == 7
+        assert by_point[("ckpt.save", None)].mode == "raise"
+        delay = by_point[("cd.update", None)]
+        assert delay.times == 2 and delay.delay_seconds == 0.5
+        with pytest.raises(ValueError):
+            faults.parse_fault_specs("not-a-spec")
+        with pytest.raises(ValueError):
+            faults.parse_fault_specs("p=badmode")
+
+    def test_state_dir_shares_budget_across_registries(self, tmp_path,
+                                                       monkeypatch):
+        """times=1 fires in exactly one registry incarnation when a state
+        dir is set — the cross-process-restart invariant."""
+        monkeypatch.setenv(faults.ENV_STATE_DIR, str(tmp_path / "st"))
+        r1 = faults.FaultRegistry()
+        r2 = faults.FaultRegistry()  # the relaunched process
+        for r in (r1, r2):
+            r.arm("worker.start", "raise", times=1)
+        with pytest.raises(faults.InjectedFault):
+            r1.fire("worker.start")
+        r2.fire("worker.start")  # no-op: budget claimed by r1
+        assert r2.hits("worker.start") == 0
+
+    def test_corrupt_mode_flips_file_bytes(self, tmp_path):
+        path = tmp_path / "blob.bin"
+        path.write_bytes(bytes(range(200)))
+        faults.arm("ckpt.save", "corrupt")
+        faults.fault_point("ckpt.save", path=str(path))
+        assert path.read_bytes() != bytes(range(200))
+        assert len(path.read_bytes()) == 200  # flipped, not truncated
+
+
+# ---------------------------------------------------------------------------
+# Optimizer non-finite guards
+# ---------------------------------------------------------------------------
+
+
+class TestOptimizerNaNGuards:
+    """A poisoned region of the objective must never enter the accepted
+    solver state: the run stops finite at the last good iterate."""
+
+    @staticmethod
+    def _poisoned_vg(x, data):
+        # smooth quadratic with a NaN cliff for x[0] < -0.5; the minimum
+        # at x = -1 lies INSIDE the cliff so iterates head toward it
+        f = jnp.sum((x + 1.0) ** 2)
+        g = 2.0 * (x + 1.0)
+        bad = x[0] < -0.5
+        nan = jnp.asarray(jnp.nan, x.dtype)
+        return jnp.where(bad, nan, f), jnp.where(bad, nan, g)
+
+    def _check(self, x, history):
+        assert np.isfinite(np.asarray(x)).all()
+        k = int(history.num_iterations)
+        assert np.isfinite(np.asarray(history.values)[: k + 1]).all()
+
+    def test_lbfgs_stops_finite(self):
+        from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
+
+        x, history, _ = minimize_lbfgs(
+            self._poisoned_vg, jnp.zeros(3), max_iter=25)
+        self._check(x, history)
+
+    def test_owlqn_stops_finite(self):
+        from photon_ml_tpu.optimize.owlqn import minimize_owlqn
+
+        x, history, _ = minimize_owlqn(
+            self._poisoned_vg, jnp.zeros(3), l1=0.01, max_iter=25)
+        self._check(x, history)
+
+    def test_tron_stops_finite(self):
+        from photon_ml_tpu.optimize.tron import minimize_tron
+
+        def hvp(x, v, data):
+            return 2.0 * v
+
+        x, history, _ = minimize_tron(
+            self._poisoned_vg, hvp, jnp.zeros(3), max_iter=25)
+        self._check(x, history)
+
+    def test_tron_nan_overshoot_shrinks_region_and_recovers(self):
+        """A NaN trial must act as 'infinitely bad' in the region update
+        (shrink delta and retry), not wedge the trust radius at NaN: the
+        initial delta = ||g0|| here overshoots into the NaN cliff on the
+        very first step."""
+        from photon_ml_tpu.optimize.tron import minimize_tron
+
+        def vg(x, data):
+            f = jnp.sum((x + 5.0) ** 2)
+            g = 2.0 * (x + 5.0)
+            bad = jnp.any(jnp.abs(x) > 1.0)
+            nan = jnp.asarray(jnp.nan, x.dtype)
+            return jnp.where(bad, nan, f), jnp.where(bad, nan, g)
+
+        def hvp(x, v, data):
+            return 2.0 * v
+
+        x0 = jnp.full(2, 0.9)
+        x, history, _ = minimize_tron(vg, hvp, x0, max_iter=30)
+        self._check(x, history)
+        # made real progress toward the finite-region boundary at -1
+        assert int(history.num_iterations) >= 1
+        f0 = float(np.asarray(history.values)[0])
+        fk = float(np.asarray(history.values)[int(history.num_iterations)])
+        assert fk < f0
+
+
+# ---------------------------------------------------------------------------
+# Coordinate-descent divergence recovery
+# ---------------------------------------------------------------------------
+
+
+def _fixed_coordinate(rng, n=300, d=5, lam=0.1):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    p = 1.0 / (1.0 + np.exp(-(X @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float64)
+    data = GameDataset(responses=y,
+                       feature_shards={"global": sp.csr_matrix(X)})
+    coord = FixedEffectCoordinate(
+        dataset=build_fixed_effect_dataset(data, "global"),
+        problem=GLMOptimizationProblem(
+            config=GLMOptimizationConfiguration(
+                max_iterations=40, tolerance=1e-8,
+                regularization_weight=lam,
+                optimizer_type=OptimizerType.LBFGS,
+                regularization_context=RegularizationContext(
+                    RegularizationType.L2)),
+            task=TaskType.LOGISTIC_REGRESSION))
+    return data, coord
+
+
+def _run_cd(data, coord, iters=2, **kw):
+    return run_coordinate_descent(
+        {"g": coord}, iters, TaskType.LOGISTIC_REGRESSION,
+        jnp.asarray(data.responses), jnp.asarray(data.weights),
+        jnp.asarray(data.offsets), **kw)
+
+
+class TestRecoveryPolicy:
+    def test_nan_poison_at_optimizer_gradient_retries_to_parity(self, rng):
+        """Acceptance path: a NaN-poisoned solve triggers the retry policy
+        and the run converges to a finite objective — with damping=1 the
+        retry is an exact re-solve, so the result matches the unfaulted
+        run bit-for-bit."""
+        data, coord = _fixed_coordinate(rng)
+        ref = _run_cd(data, coord, iters=2)
+
+        faults.arm("optimizer.gradient", "nan", times=1)
+        seen = []
+        emitter = EventEmitter()
+        emitter.register_listener(seen.append)
+        res = _run_cd(
+            data, coord, iters=2,
+            recovery=RecoveryPolicy(max_retries=2, on_exhausted="abort",
+                                    damping=1.0),
+            events=emitter)
+
+        objs = [s.objective for s in res.states]
+        assert np.isfinite(objs).all()
+        np.testing.assert_allclose(
+            objs[-1], ref.states[-1].objective, rtol=1e-12)
+        kinds = [type(e).__name__ for e in seen]
+        assert "FaultEvent" in kinds and "RecoveryEvent" in kinds
+        recov = [e for e in seen if isinstance(e, RecoveryEvent)]
+        assert {"retried", "recovered"} <= {e.action for e in recov}
+
+    def test_default_damped_retry_converges_finite(self, rng):
+        data, coord = _fixed_coordinate(rng)
+        faults.arm("optimizer.gradient", "nan", times=1)
+        res = _run_cd(data, coord, iters=3, recovery=RecoveryPolicy())
+        assert np.isfinite([s.objective for s in res.states]).all()
+
+    def test_no_policy_propagates_fault(self, rng):
+        data, coord = _fixed_coordinate(rng)
+        faults.arm("cd.update", "raise", times=1)
+        with pytest.raises(faults.InjectedFault):
+            _run_cd(data, coord, iters=1)
+
+    def test_abort_policy_raises_after_retries(self, rng):
+        data, coord = _fixed_coordinate(rng)
+        faults.arm("cd.update", "raise", times=10)
+        with pytest.raises(RuntimeError, match="aborted"):
+            _run_cd(data, coord, iters=1,
+                    recovery=RecoveryPolicy(max_retries=1,
+                                            on_exhausted="abort"))
+        assert faults.hits("cd.update") == 2  # initial + 1 retry
+
+    def test_skip_policy_continues_degraded(self, rng):
+        data, coord = _fixed_coordinate(rng)
+        # first update (and its retry) fails; later sweeps succeed
+        faults.arm("cd.update", "raise", times=2)
+        seen = []
+        emitter = EventEmitter()
+        emitter.register_listener(seen.append)
+        res = _run_cd(
+            data, coord, iters=3,
+            recovery=RecoveryPolicy(max_retries=1, on_exhausted="skip",
+                                    max_consecutive_failures=3),
+            events=emitter)
+        # skipped sweep records no history entry; the others recovered
+        assert len(res.states) == 2
+        assert np.isfinite([s.objective for s in res.states]).all()
+        assert any(isinstance(e, RecoveryEvent) and e.action == "skipped"
+                   for e in seen)
+
+    def test_consecutive_skips_abort(self, rng):
+        data, coord = _fixed_coordinate(rng)
+        faults.arm("cd.update", "raise", times=100)
+        with pytest.raises(RuntimeError, match="consecutive"):
+            _run_cd(data, coord, iters=5,
+                    recovery=RecoveryPolicy(
+                        max_retries=0, on_exhausted="skip",
+                        max_consecutive_failures=2))
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RecoveryPolicy(on_exhausted="explode")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint hardening
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointHardening:
+    def _mk(self, tmp_path, steps=3):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=None)
+        for s in range(1, steps + 1):
+            mgr.save(s, {"step": s, "coefs": np.full(4, float(s))})
+        return mgr
+
+    def test_manifest_carries_checksums(self, tmp_path):
+        mgr = self._mk(tmp_path, steps=1)
+        with open(os.path.join(mgr._step_dir(1), "manifest.json")) as fh:
+            manifest = json.load(fh)
+        assert manifest["format_version"] == 2
+        assert "arrays.npz" in manifest["checksums"]
+        assert mgr.verify_step(1)
+
+    def test_truncated_arrays_falls_back(self, tmp_path):
+        mgr = self._mk(tmp_path)
+        arrays = os.path.join(mgr._step_dir(3), "arrays.npz")
+        size = os.path.getsize(arrays)
+        with open(arrays, "r+b") as fh:
+            fh.truncate(size // 2)
+        assert mgr.latest_step() == 3  # presence says 3...
+        assert mgr.latest_valid_step() == 2  # ...integrity says 2
+        assert mgr.restore()["step"] == 2
+        with pytest.raises(CheckpointCorruptionError):
+            mgr.restore(3)
+
+    def test_corrupted_bytes_fall_back(self, tmp_path):
+        mgr = self._mk(tmp_path)
+        faults.arm("ckpt.save", "corrupt")
+        faults.fault_point("ckpt.save",
+                           path=os.path.join(mgr._step_dir(3),
+                                             "arrays.npz"))
+        assert mgr.latest_valid_step() == 2
+        assert mgr.restore()["step"] == 2
+
+    def test_missing_manifest_falls_back(self, tmp_path):
+        mgr = self._mk(tmp_path)
+        os.remove(os.path.join(mgr._step_dir(3), "manifest.json"))
+        assert mgr.latest_valid_step() == 2
+        assert mgr.restore()["step"] == 2
+
+    def test_stale_tmp_dir_ignored(self, tmp_path):
+        mgr = self._mk(tmp_path)
+        stale = mgr._step_dir(4) + ".tmp"
+        os.makedirs(stale)
+        with open(os.path.join(stale, "manifest.json"), "w") as fh:
+            fh.write("{}")
+        assert mgr.all_steps() == [1, 2, 3]
+        assert mgr.latest_valid_step() == 3
+
+    def test_all_corrupt_means_no_valid_step(self, tmp_path):
+        mgr = self._mk(tmp_path, steps=1)
+        os.remove(os.path.join(mgr._step_dir(1), "manifest.json"))
+        assert mgr.latest_valid_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+    def test_v1_manifest_without_checksums_still_loads(self, tmp_path):
+        mgr = self._mk(tmp_path, steps=1)
+        mpath = os.path.join(mgr._step_dir(1), "manifest.json")
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        del manifest["checksums"], manifest["format_version"]
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        assert mgr.latest_valid_step() == 1
+        assert mgr.restore(1)["step"] == 1
+
+    def test_cd_resumes_past_corrupt_step_to_parity(self, rng, tmp_path):
+        """Acceptance path: corrupt the newest checkpoint; resume falls
+        back to the previous valid step and coordinate descent reproduces
+        the uninterrupted run."""
+        data, coord = _fixed_coordinate(rng)
+        ref = _run_cd(data, coord, iters=3)
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=None)
+        _run_cd(data, coord, iters=3, checkpoint_manager=mgr)
+        # corrupt the final snapshot (step 3): resume must use step 2
+        faults.arm("ckpt.save", "corrupt")
+        faults.fault_point("ckpt.save", path=mgr._step_dir(3))
+        step = mgr.latest_valid_step()
+        assert step == 2
+        snap = mgr.restore()
+        restored = {cid: jnp.asarray(v)
+                    for cid, v in snap["states"].items()}
+        res = _run_cd(data, coord, iters=3, initial_states=restored,
+                      start_iteration=int(snap["iteration"]))
+        np.testing.assert_allclose(res.states[-1].objective,
+                                   ref.states[-1].objective, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# allgather_strings framing (single-process collective)
+# ---------------------------------------------------------------------------
+
+
+class TestAllgatherStrings:
+    def test_nul_bytes_and_unicode_round_trip(self):
+        from photon_ml_tpu.parallel.multihost import allgather_strings
+
+        ids = np.asarray(["plain", "", "nul\x00inside", "uñicode☃",
+                          "\x00", "trailing\x00"], dtype=object)
+        (out,) = allgather_strings(ids)
+        assert out.tolist() == ids.tolist()
+
+    def test_empty(self):
+        from photon_ml_tpu.parallel.multihost import allgather_strings
+
+        (out,) = allgather_strings(np.zeros(0, dtype=object))
+        assert out.tolist() == []
+
+
+# ---------------------------------------------------------------------------
+# Worker supervisor (process-local semantics)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+
+    def wait(self):
+        return self._rc
+
+
+class TestWorkerSupervisor:
+    def test_relaunches_until_success(self):
+        from photon_ml_tpu.parallel.multihost import WorkerSupervisor
+
+        rcs = iter([3, 1, 0])
+        launches = []
+        sup = WorkerSupervisor(
+            lambda attempt: (launches.append(attempt),
+                             _FakeProc(next(rcs)))[1],
+            max_restarts=3, backoff_base_seconds=0.01, name="w0")
+        assert sup.run() == 2
+        assert launches == [0, 1, 2]
+
+    def test_exhaustion_raises_terminal_error(self):
+        from photon_ml_tpu.parallel.multihost import (
+            SupervisorExhaustedError,
+            WorkerSupervisor,
+        )
+
+        sup = WorkerSupervisor(lambda a: _FakeProc(9), max_restarts=2,
+                               backoff_base_seconds=0.01, name="w1")
+        with pytest.raises(SupervisorExhaustedError,
+                           match="after 2 restart"):
+            sup.run()
+        assert sup.restart_count == 3
+
+    def test_backoff_exponential_bounded_jittered(self):
+        from photon_ml_tpu.parallel.multihost import WorkerSupervisor
+
+        sup = WorkerSupervisor(lambda a: None, backoff_base_seconds=1.0,
+                               backoff_max_seconds=8.0,
+                               jitter_fraction=0.25, name="host3")
+        delays = [sup.backoff_seconds(k) for k in range(1, 8)]
+        for k, d in enumerate(delays, start=1):
+            base = min(1.0 * 2 ** (k - 1), 8.0)
+            assert base * 0.75 <= d <= base * 1.25
+        # deterministic: same (name, attempt) → same jitter
+        assert delays == [sup.backoff_seconds(k) for k in range(1, 8)]
+        # jitter de-synchronizes differently-named gang members
+        other = WorkerSupervisor(lambda a: None, backoff_base_seconds=1.0,
+                                 backoff_max_seconds=8.0,
+                                 jitter_fraction=0.25, name="host4")
+        assert any(abs(a - b) > 1e-9 for a, b in
+                   zip(delays, [other.backoff_seconds(k)
+                                for k in range(1, 8)]))
+
+    def test_real_subprocess_restart(self, tmp_path):
+        """End-to-end with real processes: the script dies once (state
+        file), the supervisor relaunches it, the second run succeeds."""
+        from photon_ml_tpu.parallel.multihost import WorkerSupervisor
+
+        marker = tmp_path / "died_once"
+        script = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    sys.exit(13)\n"
+            "print('WORK_DONE')\n")
+
+        def spawn(attempt):
+            return subprocess.Popen([sys.executable, "-c", script])
+
+        sup = WorkerSupervisor(spawn, max_restarts=2,
+                               backoff_base_seconds=0.05, name="real")
+        assert sup.run() == 1
+
+
+# ---------------------------------------------------------------------------
+# Multi-host driver flag validation
+# ---------------------------------------------------------------------------
+
+
+class TestMultihostFlagValidation:
+    def _args(self, out, **extra):
+        base = [
+            "--train-input-dirs", "unused",
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-name-and-term-set-path", "unused-fs",
+            "--feature-shard-id-to-feature-section-keys-map", "g:f",
+            "--updating-sequence", "g",
+            "--num-processes", "2", "--process-id", "0",
+            "--coordinator", "127.0.0.1:1",
+            "--model-output-mode", "NONE",
+        ]
+        for k, v in extra.items():
+            base += [f"--{k.replace('_', '-')}", v]
+        return base
+
+    @pytest.mark.parametrize("flag,value,needle", [
+        ("model_output_mode", "ALL", "--model-output-mode"),
+        ("validate_input_dirs", "some/dir", "--validate-input-dirs"),
+        ("evaluator_type", "AUC", "--evaluator-type"),
+        ("checkpoint_dir", "ck", "--checkpoint-dir"),
+        ("recovery_policy", "skip", "--recovery-policy"),
+    ])
+    def test_unsupported_flags_raise(self, tmp_path, flag, value, needle):
+        # through main(): validation must fire BEFORE any supervisor or
+        # worker starts (the single _check_multihost_args site)
+        from photon_ml_tpu.cli.game_training_driver import main
+
+        args = self._args(str(tmp_path / "out"))
+        if flag == "model_output_mode":
+            args = [a if a != "NONE" else value for a in args]
+        else:
+            args += [f"--{flag.replace('_', '-')}", value]
+        with pytest.raises(ValueError, match="does not support") as ei:
+            main(args + ["--max-worker-restarts", "3"])
+        assert needle in str(ei.value)
+
+    def test_default_model_output_mode_not_rejected(self, tmp_path):
+        """Omitting --model-output-mode (argparse default) must NOT trip
+        the unsupported-flags check — only an explicit ALL/BEST does."""
+        from photon_ml_tpu.cli.game_training_driver import main
+
+        args = [a for a in self._args(str(tmp_path / "out"))
+                if a not in ("--model-output-mode", "NONE")]
+        # gets past validation, then fails on the nonexistent feature-set
+        # path — NOT on the unsupported-flags ValueError
+        with pytest.raises(FileNotFoundError):
+            main(args)

@@ -1,0 +1,152 @@
+"""Supervised multi-host GAME training: kill one worker mid-run, watch
+every host's supervisor re-form the gang with backoff, and check the
+completed run's coefficients against an un-faulted reference.
+
+Named to sort LAST: it is the most expensive test in the suite and must
+not displace earlier tests inside the tier-1 time budget. Skips (after a
+cheap probe) on jax builds whose CPU backend lacks multiprocess
+computation support — the supervisor's process-local semantics are
+covered unconditionally in tests/test_fault_tolerance.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from test_multihost import (
+    _REPO,
+    _free_port,
+    _game_cli_args,
+    _worker_env,
+    _write_game_part,
+)
+
+_PROBE = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+jax.distributed.initialize("127.0.0.1:%d", 2, %d,
+                           initialization_timeout=30)
+devs = jax.devices()
+mesh = Mesh(np.array(devs), ("d",))
+arr = jax.make_array_from_callback(
+    (len(devs),), NamedSharding(mesh, P("d")), lambda idx: np.ones(1))
+out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+assert float(np.asarray(out)) == len(devs)
+print("MH_PROBE_OK", flush=True)
+jax.distributed.shutdown()
+"""
+
+
+@pytest.fixture(scope="module")
+def multiprocess_backend():
+    """Skip the module when 2-process global-mesh computations don't run
+    on this backend (e.g. 'Multiprocess computations aren't implemented
+    on the CPU backend')."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _PROBE % (port, i)],
+                         env=_worker_env(2), cwd=_REPO, text=True,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    if any(rc != 0 or "MH_PROBE_OK" not in out for rc, out in outs):
+        pytest.skip("backend does not support multiprocess computations: "
+                    + outs[0][1].strip().splitlines()[-1][:200])
+
+
+def test_supervisor_relaunches_killed_worker_to_parity(
+        tmp_path, multiprocess_backend):
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _write_game_part(str(data_dir / "part-00000.avro"),
+                     n=120, n_users=5, d_g=4, d_u=2, seed=30)
+    _write_game_part(str(data_dir / "part-00001.avro"),
+                     n=100, n_users=5, d_g=4, d_u=2, seed=31)
+    from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+    sets = NameAndTermFeatureSets.from_paths(
+        [str(data_dir)], ["globalFeatures", "userFeatures"])
+    fs_dir = tmp_path / "fs"
+    sets.save(str(fs_dir))
+
+    # -- un-faulted single-process reference ------------------------------
+    from photon_ml_tpu.cli.game_training_driver import (
+        GameTrainingDriver,
+        parse_args,
+    )
+
+    driver = GameTrainingDriver(parse_args(_game_cli_args(
+        str(data_dir), str(tmp_path / "single"), str(fs_dir),
+        num_iterations=1)))
+    result = driver.run()
+    fixed_ref = np.asarray(result.model.models["g"].coefficients.means)
+
+    # -- supervised 2-process gang with worker 0 killed once --------------
+    # worker 0 (the coordinator host) dies right after joining the
+    # cluster; worker 1's collectives error within the heartbeat bound;
+    # both supervisors relaunch and the fresh gang completes. The faults
+    # state dir makes the kill fire exactly once across relaunches.
+    port = _free_port()
+    mh_out = str(tmp_path / "mh")
+    procs = []
+    for i in range(2):
+        env = _worker_env(4)
+        env["PHOTON_FAULTS"] = "worker.start@0=kill:1:21"
+        env["PHOTON_FAULTS_STATE_DIR"] = str(tmp_path / "fault_state")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "photon_ml_tpu.cli.game_training_driver",
+             *_game_cli_args(str(data_dir), mh_out, str(fs_dir),
+                             num_iterations=1),
+             "--num-processes", "2", "--process-id", str(i),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--coordinator-timeout", "60",
+             "--heartbeat-timeout", "10",
+             "--max-worker-restarts", "3",
+             "--worker-backoff-base", "2.0"],
+            env=env, cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (f"supervisor {i} rc={rc}\nstdout:\n{out}\n"
+                         f"stderr:\n{err}")
+        assert f"MULTIHOST_GAME_OK process={i}" in out, out
+        assert f"SUPERVISOR_OK worker=p{i} restarts=" in out, out
+    # the killed worker really was relaunched (and the kill really fired)
+    restarts0 = int(outs[0][1].split("restarts=")[-1].split()[0])
+    assert restarts0 >= 1, outs[0][1]
+
+    # -- parity vs the un-faulted reference -------------------------------
+    recs = [np.load(os.path.join(mh_out, f"multihost_result.p{i}.npz"),
+                    allow_pickle=False) for i in range(2)]
+    np.testing.assert_allclose(recs[0]["fixed"], recs[1]["fixed"],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
+                               rtol=5e-3, atol=5e-3)

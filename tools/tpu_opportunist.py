@@ -50,6 +50,11 @@ def _lastgood_age_secs() -> float | None:
     try:
         with open(LASTGOOD) as fh:
             rec = json.load(fh)
+        if rec.get("seeded"):
+            # hand-carried record, not machine evidence: never lets the
+            # watcher skip a capture — only bench.py's own on-chip runs
+            # (which omit the flag) count as fresh
+            return None
         import datetime
 
         ts = datetime.datetime.fromisoformat(rec["recorded_at"])
