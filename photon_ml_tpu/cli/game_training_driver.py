@@ -199,8 +199,19 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "with (validated against the store's meta)")
     p.add_argument("--checkpoint-dir",
                    help="snapshot coordinate states after each CD sweep "
-                        "and auto-resume from the latest INTACT snapshot "
-                        "(integrity-verified; single-grid-point runs only)")
+                        "(plus mid-sweep at the --checkpoint-every-"
+                        "coordinates cadence) and auto-resume from the "
+                        "latest INTACT snapshot (integrity-verified; "
+                        "single-grid-point runs only). In multi-host mode "
+                        "process 0 owns the snapshots and broadcasts the "
+                        "restored state to the re-formed gang, so a "
+                        "supervisor restart resumes training instead of "
+                        "restarting it")
+    p.add_argument("--checkpoint-every-coordinates", type=int, default=0,
+                   help="with --checkpoint-dir: additionally snapshot "
+                        "after every Nth coordinate update, so a crash "
+                        "inside a long sweep replays at most N updates "
+                        "instead of the whole sweep (0 = sweep-end only)")
     # Divergence recovery (game/coordinate_descent.RecoveryPolicy): guard
     # every coordinate update for non-finite states/objectives.
     p.add_argument("--recovery-policy", default="none",
@@ -219,6 +230,12 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    default=3,
                    help="abort after this many consecutive skipped "
                         "coordinate updates")
+    p.add_argument("--recovery-quarantine-after", type=int, default=0,
+                   help="per-coordinate failure budget: a coordinate "
+                        "whose retries exhaust this many times is "
+                        "QUARANTINED (frozen at last-good state, descent "
+                        "continues without it) instead of burning the "
+                        "global budget; 0 disables")
     # Worker supervision (multi-host only): relaunch this host's crashed
     # worker process with bounded exponential backoff + jitter.
     p.add_argument("--max-worker-restarts", type=int, default=0,
@@ -463,9 +480,7 @@ class GameTrainingDriver:
         combos = list(itertools.product(
             self.fixed_opt_grid, self.random_opt_grid, self.factored_grid))
         ckpt_mgr = None
-        initial_states = None
-        initial_best = None
-        start_iteration = 0
+        resume_snapshot = None
         if self.ns.checkpoint_dir:
             from photon_ml_tpu.utils.checkpoint import CheckpointManager
 
@@ -475,28 +490,19 @@ class GameTrainingDriver:
                     f"(got {len(combos)} grid combinations)")
             ckpt_mgr = CheckpointManager(self.ns.checkpoint_dir)
             # integrity-verified: restore() falls back past truncated/
-            # corrupt/partial step dirs to the newest intact snapshot
-            # (one verification pass — no separate latest_valid_step call)
+            # corrupt/partial step dirs to the newest intact snapshot; a
+            # dir with steps but NO intact one raises (data loss must not
+            # silently retrain from scratch), only an empty dir is fresh
             try:
-                snap = ckpt_mgr.restore()
+                resume_snapshot = ckpt_mgr.restore()
             except FileNotFoundError:
-                snap = None
-            if snap is not None:
-
-                def _jnp_states(d):
-                    return {cid: (tuple(jnp.asarray(s) for s in v)
-                                  if isinstance(v, tuple)
-                                  else jnp.asarray(v))
-                            for cid, v in d.items()}
-
-                initial_states = _jnp_states(snap["states"])
-                start_iteration = int(snap["iteration"])
-                if snap.get("best_states") is not None:
-                    initial_best = (snap.get("best_metric"),
-                                    _jnp_states(snap["best_states"]))
+                resume_snapshot = None
+            if resume_snapshot is not None:
                 self.logger.info(
-                    f"resuming from checkpoint at iteration "
-                    f"{start_iteration}")
+                    f"resuming from checkpoint at sweep "
+                    f"{resume_snapshot.get('sweep', resume_snapshot.get('iteration', 0))} "
+                    f"coordinate "
+                    f"{resume_snapshot.get('coordinate_index', 0)}")
         recovery = None
         events = None
         if self.ns.recovery_policy != "none":
@@ -508,7 +514,8 @@ class GameTrainingDriver:
                 on_exhausted=self.ns.recovery_policy,
                 damping=self.ns.recovery_damping,
                 max_consecutive_failures=(
-                    self.ns.recovery_max_consecutive_failures))
+                    self.ns.recovery_max_consecutive_failures),
+                quarantine_after=self.ns.recovery_quarantine_after)
             events = EventEmitter()
             events.register_listener(
                 lambda e: self.logger.warn(f"recovery event: {e}"))
@@ -529,13 +536,17 @@ class GameTrainingDriver:
                                        else None),
                     higher_is_better=(first_spec.better_than(1.0, 0.0)
                                       if first_spec else True),
-                    initial_states=initial_states,
                     logger=self.logger,
                     checkpoint_manager=ckpt_mgr,
-                    start_iteration=start_iteration,
-                    initial_best=initial_best,
+                    checkpoint_every_coordinates=(
+                        self.ns.checkpoint_every_coordinates),
+                    resume_snapshot=resume_snapshot,
                     recovery=recovery,
                     events=events)
+            if result.quarantined:
+                self.logger.warn(
+                    f"{desc}: quarantined coordinates (frozen at "
+                    f"last-good state): {result.quarantined}")
             results.append((desc, result))
             metric = result.best_metric
             if metric is not None:
@@ -573,6 +584,13 @@ class GameTrainingDriver:
         best, results = self.train()
         _, best_result, best_desc = best
         self.logger.info(f"best model: {best_desc}")
+        quarantined_all = sorted({cid for _, r in results
+                                  for cid in r.quarantined})
+        if quarantined_all:
+            self.logger.warn(
+                f"run summary: {len(quarantined_all)} coordinate(s) "
+                f"quarantined (frozen at last-good state): "
+                f"{quarantined_all}")
 
         # Persist the training/validation record per grid point (the GAME
         # analog of the legacy driver's metrics.json; the reference only
@@ -586,8 +604,10 @@ class GameTrainingDriver:
         record = {
             "best": {"description": best_desc,
                      "metric": _finite(best_result.best_metric)},
+            "quarantined": quarantined_all,
             "grid": [
                 {"description": desc,
+                 "quarantined": result.quarantined,
                  "states": [
                      {"iteration": s.iteration,
                       "coordinate": s.coordinate_id,
@@ -637,8 +657,9 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
     the real message, not burn a supervisor's restart budget. Fails fast
     on flags the multi-host path does not implement — silently ignoring
     them would hand a user expecting the single-process driver's outputs
-    (saved avro models, validation metrics, resumable checkpoints,
-    divergence recovery) nothing at all."""
+    (saved avro models, validation metrics, divergence recovery) nothing
+    at all. --checkpoint-dir IS supported: process 0 owns the snapshots
+    and the restored state is broadcast to the re-formed gang."""
     if not ns.coordinator:
         raise ValueError(
             "--coordinator host:port is required with --num-processes > 1")
@@ -660,8 +681,6 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
         unsupported.append("--validate-input-dirs")
     if ns.evaluator_type.strip():
         unsupported.append("--evaluator-type")
-    if ns.checkpoint_dir:
-        unsupported.append("--checkpoint-dir")
     if ns.recovery_policy != "none":
         unsupported.append(
             "--recovery-policy (divergence recovery is wired into the "
@@ -670,6 +689,16 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
         raise ValueError(
             "multi-host mode (--num-processes > 1) does not support: "
             + "; ".join(unsupported))
+    if ns.checkpoint_dir and ns.process_id == 0 \
+            and os.path.isdir(ns.checkpoint_dir):
+        # An all-corrupt checkpoint dir is a TERMINAL condition: surface
+        # it here, before any worker or supervisor starts, instead of
+        # letting each restart burn a heartbeat timeout on the same
+        # CheckpointCorruptionError inside the gang (only process 0 can
+        # check — the other hosts need not share the filesystem).
+        from photon_ml_tpu.utils.checkpoint import CheckpointManager
+
+        CheckpointManager(ns.checkpoint_dir).raise_if_all_corrupt()
 
 
 def _run_multihost(ns: argparse.Namespace) -> None:
@@ -750,6 +779,10 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             num_buckets=max(1, int(ns.random_effect_block_buckets)),
             initialization_timeout=ns.coordinator_timeout,
             heartbeat_timeout=ns.heartbeat_timeout,
+            # process 0 owns the snapshots; the restored state is
+            # broadcast to the whole (re-formed) gang on startup
+            checkpoint_dir=ns.checkpoint_dir,
+            checkpoint_every_coordinates=ns.checkpoint_every_coordinates,
             # per-process subdir: two processes must not write the same
             # memmap files (the worker appends one subdir per coordinate)
             blocks_dir=(os.path.join(ns.random_effect_blocks_dir,

@@ -31,6 +31,7 @@ from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optimize.config import TASK_LOSS_NAME, TaskType
 from photon_ml_tpu.utils.events import (
+    CoordinateQuarantinedEvent,
     EventEmitter,
     FaultEvent,
     RecoveryEvent,
@@ -63,18 +64,35 @@ class RecoveryPolicy:
       sweep (keep the last-good state, continue degraded) or ``abort``;
     - abort anyway after ``max_consecutive_failures`` consecutive skipped
       updates — a run that skips every sweep is not making progress.
+
+    ``quarantine_after`` adds a PER-COORDINATE failure budget on top of
+    the global policy: when > 0, a coordinate whose retries exhaust is
+    skipped for the sweep (degraded, regardless of ``on_exhausted``)
+    until it has accumulated ``quarantine_after`` exhausted updates
+    across the run, at which point it is QUARANTINED — frozen at its
+    last-good state, announced with a
+    :class:`~photon_ml_tpu.utils.events.CoordinateQuarantinedEvent`, and
+    excluded from further sweeps while the rest of the descent continues.
+    One chronically-diverging coordinate then costs its own bounded
+    budget instead of burning the global retry/consecutive-failure
+    budgets or aborting the whole run.
     """
 
     max_retries: int = 2
     on_exhausted: str = "abort"  # "skip" | "abort"
     damping: float = 0.5
     max_consecutive_failures: int = 3
+    quarantine_after: int = 0  # 0 = per-coordinate budget disabled
 
     def __post_init__(self):
         if self.on_exhausted not in ("skip", "abort"):
             raise ValueError(
                 f"on_exhausted must be 'skip' or 'abort', "
                 f"got {self.on_exhausted!r}")
+        if self.quarantine_after < 0:
+            raise ValueError(
+                f"quarantine_after must be >= 0, "
+                f"got {self.quarantine_after}")
 
 
 def _state_leaves(state):
@@ -128,6 +146,23 @@ class CoordinateDescentResult:
     states: list[CoordinateDescentState]
     best_model: Optional[GameModel] = None
     best_metric: Optional[float] = None
+    # Coordinates frozen at last-good state by the per-coordinate failure
+    # budget (RecoveryPolicy.quarantine_after) — surfaced in the driver
+    # summary and metrics.json.
+    quarantined: list[str] = dataclasses.field(default_factory=list)
+
+
+def _to_np_states(d: dict) -> dict:
+    return {cid: (tuple(np.asarray(s) for s in d[cid])
+                  if isinstance(d[cid], tuple)
+                  else np.asarray(d[cid]))
+            for cid in d}
+
+
+def _to_jnp_states(d: dict) -> dict:
+    return {cid: (tuple(jnp.asarray(s) for s in v)
+                  if isinstance(v, tuple) else jnp.asarray(v))
+            for cid, v in d.items()}
 
 
 def run_coordinate_descent(
@@ -148,6 +183,9 @@ def run_coordinate_descent(
     initial_best: Optional[tuple] = None,
     recovery: Optional[RecoveryPolicy] = None,
     events: Optional[EventEmitter] = None,
+    checkpoint_every_coordinates: int = 0,
+    start_coordinate: int = 0,
+    resume_snapshot: Optional[dict] = None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent over ``coordinates`` in dict order.
 
@@ -160,8 +198,24 @@ def run_coordinate_descent(
     With a :class:`RecoveryPolicy`, every coordinate update is guarded for
     non-finite states/objectives and injected faults; detected faults emit
     :class:`FaultEvent`/:class:`RecoveryEvent` on ``events`` and follow the
-    policy (retry damped / skip degraded / abort). Without one, behavior
+    policy (retry damped / skip degraded / abort, plus per-coordinate
+    quarantine when ``quarantine_after`` is set). Without one, behavior
     is the legacy fail-through (a NaN propagates to the caller).
+
+    Checkpointing: with a ``checkpoint_manager`` a snapshot lands after
+    every completed sweep, and — when ``checkpoint_every_coordinates``
+    = N > 0 — additionally after every Nth coordinate update, so a crash
+    inside a long sweep replays at most N updates instead of the whole
+    sweep. A snapshot carries everything a BIT-EXACT resume needs:
+    ``(sweep, coordinate_index, per-coordinate states AND scores, RNG
+    stream positions, recovery counters, the quarantine set, the running
+    best)``. Resume by passing the restored dict as ``resume_snapshot``
+    (preferred — it repopulates all of the above; the legacy
+    ``initial_states``/``start_iteration``/``initial_best`` trio still
+    works for sweep-boundary snapshots). The score total is recomputed
+    canonically (ids order, from zero) after every update rather than
+    maintained incrementally, so a resumed run sees float-identical
+    partial scores to the uninterrupted one.
     """
     log = logger or (lambda s: None)
     emit = events.send_event if events is not None else (lambda e: None)
@@ -173,21 +227,65 @@ def run_coordinate_descent(
 
     loss_eval = training_loss_evaluator(task, labels, weights, offsets)
 
+    consecutive_failures = 0
+    coordinate_failures: dict[str, int] = {}
+    quarantined: set[str] = set()
+    restored_scores = None
+    if resume_snapshot is not None:
+        snap = resume_snapshot
+        initial_states = _to_jnp_states(snap["states"])
+        start_iteration = int(snap.get("sweep", snap.get("iteration", 0)))
+        start_coordinate = int(snap.get("coordinate_index", 0))
+        if snap.get("best_states") is not None:
+            initial_best = (snap.get("best_metric"),
+                            _to_jnp_states(snap["best_states"]))
+        if snap.get("scores") is not None:
+            restored_scores = {cid: jnp.asarray(v)
+                               for cid, v in snap["scores"].items()}
+        # RNG stream positions: a down-sampling coordinate's PRNG key is
+        # seed + update count, so the counter IS the key state
+        for cid, cnt in (snap.get("update_counts") or {}).items():
+            if cid in coordinates and hasattr(coordinates[cid],
+                                              "_update_count"):
+                coordinates[cid]._update_count = int(cnt)
+        consecutive_failures = int(snap.get("consecutive_failures", 0))
+        coordinate_failures = {k: int(v) for k, v in
+                               (snap.get("coordinate_failures")
+                                or {}).items()}
+        quarantined = set(snap.get("quarantined") or [])
+
     # Init: zero states, zero scores (CoordinateDescent.scala:93-101).
     states = dict(initial_states or {})
     resumed = set(states)
     for cid in ids:
         if cid not in states:
             states[cid] = coordinates[cid].initial_state()
-    # Restored coordinates must contribute their scores from the start —
-    # zeros would make the first resumed sweep optimize against offsets
-    # that pretend the other coordinates' models don't exist.
-    scores = {cid: (coordinates[cid].score(states[cid])
-                    if cid in resumed else jnp.zeros(num_samples))
-              for cid in ids}
-    total = jnp.zeros(num_samples)
-    for cid in ids:
-        total = total + scores[cid]
+
+    def canonical_total(score_map):
+        """Σ scores in ids order from zero — the ONE summation order used
+        everywhere, so a resume that rebuilds the total from restored
+        scores reproduces the uninterrupted run's floats exactly."""
+        t = jnp.zeros(num_samples)
+        for c in ids:
+            t = t + score_map[c]
+        return t
+
+    if restored_scores is not None:
+        # Mid-sweep resume: scores come back verbatim from the snapshot
+        # (recomputing them from states would be wrong for coordinates
+        # that have never been updated — score(initial_state) need not be
+        # zero under normalization shifts).
+        scores = {cid: (restored_scores[cid] if cid in restored_scores
+                        else jnp.zeros(num_samples)) for cid in ids}
+    else:
+        # Restored coordinates must contribute their scores from the
+        # start — zeros would make the first resumed sweep optimize
+        # against offsets that pretend the other coordinates' models
+        # don't exist.
+        scores = {cid: (coordinates[cid].score(states[cid])
+                        if cid in resumed else jnp.zeros(num_samples))
+                  for cid in ids}
+    total = canonical_total(scores)
 
     history: list[CoordinateDescentState] = []
     best_model = None
@@ -198,13 +296,13 @@ def run_coordinate_descent(
         best_states = dict(restored_states)
         best_model = publish_game_model(coordinates, best_states)
 
-    def attempt_update(cid, it, attempt):
+    def attempt_update(cid, ci, it, attempt):
         """One (possibly damped) coordinate update from last-good state;
         raises CoordinateDivergenceError on a non-finite result."""
         coord = coordinates[cid]
         partial = total - scores[cid]  # Σ other coordinates (:143-151)
         cand, tracker = coord.update(states[cid], partial)
-        cand = fault_point("cd.update", arrays=cand)
+        cand = fault_point("cd.update", tag=f"{it}.{ci}", arrays=cand)
         if attempt > 0:
             cand = _damp_toward(states[cid], cand,
                                 recovery.damping ** attempt)
@@ -220,18 +318,58 @@ def run_coordinate_descent(
                 f"iter {it} coordinate {cid}: non-finite "
                 f"{'objective' if not math.isfinite(objective) else 'state'}"
                 f" (attempt {attempt})")
-        return cand, tracker, new_score, new_total, objective
+        return cand, tracker, new_score, objective
 
-    consecutive_failures = 0
+    last_saved_step = None
+
+    def save_snapshot(sweep, next_ci):
+        """Persist the full resume state as of 'about to run coordinate
+        ``next_ci`` of ``sweep``'; a completed sweep normalizes to the
+        next sweep's coordinate 0. Step number = global update count, so
+        mid-sweep and sweep-end snapshots share one monotone sequence."""
+        nonlocal last_saved_step
+        if next_ci >= len(ids):
+            sweep, next_ci = sweep + 1, 0
+        step = sweep * len(ids) + next_ci
+        if step == last_saved_step:
+            return
+        checkpoint_manager.save(step, {
+            "sweep": sweep,
+            "coordinate_index": next_ci,
+            # legacy field: completed sweeps (pre-mid-sweep readers)
+            "iteration": sweep,
+            "states": _to_np_states(states),
+            "scores": {cid: np.asarray(scores[cid]) for cid in ids},
+            "best_metric": (None if best_metric is None
+                            else float(best_metric)),
+            "best_states": (None if best_states is None
+                            else _to_np_states(best_states)),
+            "update_counts": {
+                cid: int(getattr(coordinates[cid], "_update_count"))
+                for cid in ids
+                if hasattr(coordinates[cid], "_update_count")},
+            "consecutive_failures": int(consecutive_failures),
+            "coordinate_failures": dict(coordinate_failures),
+            "quarantined": sorted(quarantined),
+        })
+        last_saved_step = step
+
     for it in range(start_iteration, num_iterations):
-        for cid in ids:
+        fault_point("cd.sweep", tag=str(it))
+        for ci, cid in enumerate(ids):
+            if it == start_iteration and ci < start_coordinate:
+                continue  # mid-sweep resume: these updates already ran
+            if cid in quarantined:
+                continue  # frozen at last-good state
             t0 = time.time()
             attempt = 0
             skipped = False
+            budgeted_skip = False
+            quarantine_now = False
             while True:
                 try:
-                    (cand, tracker, new_score, new_total,
-                     objective) = attempt_update(cid, it, attempt)
+                    (cand, tracker, new_score,
+                     objective) = attempt_update(cid, ci, it, attempt)
                     break
                 except (InjectedFault, CoordinateDivergenceError,
                         FloatingPointError) as e:
@@ -251,6 +389,22 @@ def run_coordinate_descent(
                                            coordinate_id=cid, iteration=it,
                                            attempts=attempt))
                         continue
+                    if recovery.quarantine_after > 0:
+                        # per-coordinate budget: skip degraded until THIS
+                        # coordinate's own budget exhausts, then freeze it
+                        # (the global on_exhausted action never fires for
+                        # budgeted coordinates — that is the point, and
+                        # budgeted skips don't count toward the global
+                        # consecutive-failure abort either)
+                        coordinate_failures[cid] = (
+                            coordinate_failures.get(cid, 0) + 1)
+                        if (coordinate_failures[cid]
+                                >= recovery.quarantine_after):
+                            quarantine_now = True
+                        else:
+                            skipped = True
+                            budgeted_skip = True
+                        break
                     if recovery.on_exhausted == "skip":
                         skipped = True
                         break
@@ -259,17 +413,37 @@ def run_coordinate_descent(
                         f"failed {attempt} attempt(s) at iteration {it} "
                         f"(RecoveryPolicy on_exhausted='abort')") from e
             dt = time.time() - t0
+            if quarantine_now:
+                quarantined.add(cid)
+                emit(CoordinateQuarantinedEvent(
+                    coordinate_id=cid, iteration=it,
+                    failures=coordinate_failures[cid],
+                    message=(f"{coordinate_failures[cid]} exhausted "
+                             f"update(s); frozen at last-good state")))
+                log(f"iter {it} coordinate {cid}: QUARANTINED after "
+                    f"{coordinate_failures[cid]} exhausted update(s) — "
+                    f"frozen at last-good state, descent continues "
+                    f"({dt:.2f}s)")
+                if checkpoint_manager is not None:
+                    save_snapshot(it, ci + 1)
+                continue
             if skipped:
                 # Keep the last-good state and its score; continue degraded
                 # (the reference's closest analog: a failed Spark stage
                 # retried elsewhere — here the coordinate just sits out).
-                consecutive_failures += 1
+                # A BUDGETED skip is bounded by the coordinate's own
+                # quarantine budget, so it must not also burn the global
+                # consecutive-failure budget (it would abort the run
+                # before the quarantine ever triggered).
+                if not budgeted_skip:
+                    consecutive_failures += 1
                 emit(RecoveryEvent(action="skipped", coordinate_id=cid,
                                    iteration=it, attempts=attempt))
                 log(f"iter {it} coordinate {cid}: SKIPPED after "
                     f"{attempt} failed attempt(s) — keeping last-good "
                     f"state ({dt:.2f}s)")
-                if consecutive_failures >= recovery.max_consecutive_failures:
+                if (not budgeted_skip and consecutive_failures
+                        >= recovery.max_consecutive_failures):
                     emit(RecoveryEvent(action="aborted", coordinate_id=cid,
                                        iteration=it, attempts=attempt))
                     raise RuntimeError(
@@ -286,8 +460,9 @@ def run_coordinate_descent(
                     f"{attempt} retry(ies)")
             consecutive_failures = 0
             states[cid] = cand
-            total = new_total
             scores[cid] = new_score
+            # canonical, never incrementally drifted: resume parity
+            total = canonical_total(scores)
             log(f"iter {it} coordinate {cid}: objective={objective:.6f} "
                 f"({dt:.2f}s) — {tracker.summary()}")
 
@@ -310,27 +485,20 @@ def run_coordinate_descent(
                 iteration=it, coordinate_id=cid, objective=objective,
                 seconds=dt, tracker=tracker, validation_metrics=metrics))
 
-        if checkpoint_manager is not None:
-            def _np_states(d):
-                return {
-                    cid: (tuple(np.asarray(s) for s in d[cid])
-                          if isinstance(d[cid], tuple)
-                          else np.asarray(d[cid]))
-                    for cid in d}
+            if (checkpoint_manager is not None
+                    and checkpoint_every_coordinates > 0
+                    and (it * len(ids) + ci + 1)
+                    % checkpoint_every_coordinates == 0):
+                save_snapshot(it, ci + 1)
 
-            checkpoint_manager.save(it + 1, {
-                "iteration": it + 1,
-                "states": _np_states(states),
-                "best_metric": (None if best_metric is None
-                                else float(best_metric)),
-                "best_states": (None if best_states is None
-                                else _np_states(best_states)),
-            })
+        if checkpoint_manager is not None:
+            save_snapshot(it, len(ids))
 
     final = publish_game_model(coordinates, states)
     return CoordinateDescentResult(model=final, states=history,
                                    best_model=best_model,
-                                   best_metric=best_metric)
+                                   best_metric=best_metric,
+                                   quarantined=sorted(quarantined))
 
 
 def publish_game_model(coordinates: dict[str, Coordinate], states: dict

@@ -235,6 +235,8 @@ def run_game_worker(
     initialization_timeout: int = 60,
     heartbeat_timeout: int = 100,
     blocks_dir=None,
+    checkpoint_dir=None,
+    checkpoint_every_coordinates: int = 0,
 ) -> dict:
     """One multi-host GAME training process: fixed + random effects CD.
 
@@ -268,6 +270,14 @@ def run_game_worker(
     a dict with the fixed coefficients, a per-coordinate map of
     per-entity RE coefficients keyed by raw entity id, and the final
     objective — identical on every process.
+
+    With ``checkpoint_dir``, process 0 snapshots the CD state after each
+    sweep (plus mid-sweep at the ``checkpoint_every_coordinates``
+    cadence) and, on startup, restores the newest intact snapshot and
+    BROADCASTS it to the whole gang — so a gang re-formed after a
+    supervisor restart resumes training mid-run instead of restarting
+    from scratch. Only process 0 ever touches the directory; the other
+    hosts need no shared filesystem.
     """
     import os
 
@@ -299,7 +309,7 @@ def run_game_worker(
             process_id, num_processes, train_paths,
             feature_shard_sections, index_maps, fixed_coordinate,
             random_coordinates, task, num_iterations, num_buckets,
-            blocks_dir)
+            blocks_dir, checkpoint_dir, checkpoint_every_coordinates)
     finally:
         jax.distributed.shutdown()
 
@@ -307,7 +317,8 @@ def run_game_worker(
 def _game_worker_body(
         process_id, num_processes, train_paths, feature_shard_sections,
         index_maps, fixed_coordinate, random_coordinates, task,
-        num_iterations, num_buckets, blocks_dir=None):
+        num_iterations, num_buckets, blocks_dir=None, checkpoint_dir=None,
+        checkpoint_every_coordinates=0):
     """Post-initialize body of :func:`run_game_worker` (imports deferred
     until the distributed backend is live)."""
     import os
@@ -355,13 +366,29 @@ def _game_worker_body(
     # rows fall entirely inside one process; pad rows carry weight 0. The
     # layout requires UNIFORM local device counts — verify instead of
     # silently computing mismatched L's and wedging the collectives.
-    n_all = allgather_ragged(np.asarray([n_loc, n_local], np.int64))
+    # -1 = checkpointing off; otherwise the cadence value. Both the flag's
+    # PRESENCE and its CADENCE shape the collective schedule (snapshot
+    # broadcast + per-save state resharding on every member), so either
+    # mismatched across the gang would deadlock it until the heartbeat
+    # bound — fail fast with the real reason instead.
+    ckpt_sig = (-1 if checkpoint_dir is None
+                else int(checkpoint_every_coordinates))
+    n_all = allgather_ragged(np.asarray([n_loc, n_local, ckpt_sig],
+                                        np.int64))
     n_per = np.asarray([int(x[0]) for x in n_all])
     dev_per = np.asarray([int(x[1]) for x in n_all])
     if not (dev_per == n_local).all():
         raise RuntimeError(
             f"multi-host GAME needs identical per-process device counts, "
             f"got {dev_per.tolist()}")
+    ckpt_per = np.asarray([int(x[2]) for x in n_all])
+    if ckpt_per.min() != ckpt_per.max():
+        raise RuntimeError(
+            f"checkpoint config must be identical on EVERY process of "
+            f"the gang (process 0 alone touches --checkpoint-dir, but "
+            f"all members issue the snapshot collectives at the same "
+            f"--checkpoint-every-coordinates cadence); got per-process "
+            f"values {ckpt_per.tolist()} (-1 = checkpointing off)")
     L = int(-(-int(n_per.max()) // n_local) * n_local)
     n_pad_total = L * num_processes
 
@@ -543,9 +570,18 @@ def _game_worker_body(
     def fixed_margins(X, w):
         return X @ w
 
-    # ---- coordinate descent: fixed ⇄ random effects ----------------------
-    # Offsets for each coordinate = base + Σ other coordinates' scores
-    # (CoordinateDescent.scala:143-151's partial-score subtraction).
+    # ---- checkpoint/resume: process 0 owns the snapshots -----------------
+    # Only process 0 reads/writes checkpoint_dir (no shared filesystem
+    # needed); the restored snapshot rides a host allgather as one
+    # serialized byte buffer, so a gang RE-FORMED after a supervisor
+    # restart resumes from the identical mid-run state on every host.
+    from photon_ml_tpu.utils.checkpoint import (
+        CheckpointManager,
+        dumps_state,
+        loads_state,
+    )
+    from photon_ml_tpu.utils.faults import fault_point
+
     loss = get_loss(TASK_LOSS_NAME[task])
     scores_fixed = np.zeros(n_pad_total, np.float32)
     scores_re = {c["cid"]: np.zeros(n_pad_total, np.float32)
@@ -554,25 +590,113 @@ def _game_worker_body(
     regs = {c["cid"]: 0.0 for c in coords}
     w_fixed = None
     objective = None
-    for _ in range(num_iterations):
-        # fixed update: offsets = base + Σ RE scores (local slice only)
-        re_sum = sum(scores_re.values())
-        off_inj = off_loc + re_sum[process_id * L:(process_id + 1) * L]
-        batch_g = DenseBatch(X=X_g, labels=y_g,
-                             offsets=to_global(off_inj), weights=w_g)
-        model, _ = run_glm_shard_map(
-            f_problem, batch_g, mesh,
-            initial=None if w_fixed is None else jnp.asarray(w_fixed))
-        w_fixed = np.asarray(model.coefficients.means)
-        scores_fixed = gather_global(fixed_margins(X_g,
-                                                   jnp.asarray(w_fixed)))
+    update_seq = 1 + len(coords)  # fixed + each RE coordinate, in order
+    start_it, start_ci = 0, 0
+
+    ckpt_mgr = None
+    if checkpoint_dir is not None:
+        snap = None
+        if process_id == 0:
+            ckpt_mgr = CheckpointManager(checkpoint_dir)
+            try:
+                snap = ckpt_mgr.restore()
+            except FileNotFoundError:
+                snap = None
+        payload = dumps_state(snap) if snap is not None else b""
+        root = allgather_ragged(np.frombuffer(payload, np.uint8))[0]
+        if root.size:
+            snap = loads_state(root.tobytes())
+            start_it = int(snap["sweep"])
+            start_ci = int(snap["coordinate_index"])
+            if snap["w_fixed"] is not None:
+                w_fixed = np.asarray(snap["w_fixed"])
+            scores_fixed = np.asarray(snap["scores_fixed"])
+            scores_re = {c["cid"]: np.asarray(snap["scores_re"][c["cid"]])
+                         for c in coords}
+            states = {c["cid"]: snap["re_states"][c["cid"]]
+                      for c in coords}
+            regs = {c["cid"]: snap["regs"][c["cid"]] for c in coords}
+            objective = snap["objective"]
+            if process_id == 0:
+                print(f"MULTIHOST_RESUME sweep={start_it} "
+                      f"coordinate={start_ci}", flush=True)
+
+    def _host_state(v):
+        """Coordinate state → replicated host numpy (None passes through;
+        factored states are (latent, projection) tuples)."""
+        if v is None:
+            return None
+        if isinstance(v, tuple):
+            return tuple(np.asarray(_replicate(x)) for x in v)
+        return np.asarray(_replicate(v))
+
+    last_saved_step = [None]
+
+    def save_snapshot(sweep, next_ci):
+        # EVERY process runs this at the same program points: resharding
+        # the entity-sharded global RE states to replicated host copies
+        # (_host_state → _replicate) is a collective, so all gang members
+        # must participate — only the WRITE below is process 0's alone.
+        if checkpoint_dir is None:
+            return
+        if next_ci >= update_seq:
+            sweep, next_ci = sweep + 1, 0
+        step = sweep * update_seq + next_ci
+        if step == last_saved_step[0]:
+            return
+        state = {
+            "sweep": sweep,
+            "coordinate_index": next_ci,
+            "w_fixed": None if w_fixed is None else np.asarray(w_fixed),
+            "scores_fixed": np.asarray(scores_fixed),
+            "scores_re": {cid: np.asarray(s)
+                          for cid, s in scores_re.items()},
+            "re_states": {cid: _host_state(states[cid]) for cid in states},
+            "regs": {cid: float(r) for cid, r in regs.items()},
+            "objective": (None if objective is None else float(objective)),
+        }
+        if ckpt_mgr is not None:
+            ckpt_mgr.save(step, state)
+        last_saved_step[0] = step
+
+    def maybe_save(sweep, next_ci):
+        # sweep-end saves go through save_snapshot directly (after the
+        # objective is computed); the cadence only covers mid-sweep points
+        if (checkpoint_every_coordinates > 0 and next_ci < update_seq
+                and (sweep * update_seq + next_ci)
+                % checkpoint_every_coordinates == 0):
+            save_snapshot(sweep, next_ci)
+
+    # ---- coordinate descent: fixed ⇄ random effects ----------------------
+    # Offsets for each coordinate = base + Σ other coordinates' scores
+    # (CoordinateDescent.scala:143-151's partial-score subtraction).
+    for it in range(start_it, num_iterations):
+        fault_point("cd.sweep", tag=str(it))
+        skip_before = start_ci if it == start_it else 0
+        if skip_before <= 0:
+            # fixed update (update index 0):
+            # offsets = base + Σ RE scores (local slice only)
+            re_sum = sum(scores_re.values())
+            off_inj = off_loc + re_sum[process_id * L:(process_id + 1) * L]
+            batch_g = DenseBatch(X=X_g, labels=y_g,
+                                 offsets=to_global(off_inj), weights=w_g)
+            model, _ = run_glm_shard_map(
+                f_problem, batch_g, mesh,
+                initial=None if w_fixed is None else jnp.asarray(w_fixed))
+            w_fixed = np.asarray(model.coefficients.means)
+            scores_fixed = gather_global(fixed_margins(X_g,
+                                                       jnp.asarray(w_fixed)))
+            maybe_save(it, 1)
 
         # random-effect updates in sequence: entity-sharded distributed
         # solves (state stays a global sharded array between iterations)
-        for c in coords:
+        for k, c in enumerate(coords):
+            ci = k + 1
+            if ci < skip_before:
+                continue  # mid-sweep resume: already ran before the crash
             cid = c["cid"]
             extra = scores_fixed + sum(
-                s for k, s in scores_re.items() if k != cid)
+                s for kk, s in scores_re.items() if kk != cid)
             if c["fac"] is not None:
                 states[cid], _ = c["fac"].update(states[cid],
                                                  jnp.asarray(extra))
@@ -587,6 +711,7 @@ def _game_worker_body(
                     score_random_effect(c["ds"], states[cid]))).astype(
                         np.float32)
                 regs[cid] = c["prob"].regularization_value(states[cid])
+            maybe_save(it, ci + 1)
 
         total = scores_fixed + sum(scores_re.values()) + off_g
         li = loss.loss(jnp.asarray(total), jnp.asarray(resp_g))
@@ -594,6 +719,7 @@ def _game_worker_body(
         objective += float(f_problem.regularization_value(
             jnp.asarray(w_fixed)))
         objective += sum(regs.values())
+        save_snapshot(it, update_seq)  # sweep end, objective included
 
     # drop the pad entity from the returned RE tables
     random_effect = {}

@@ -13,7 +13,11 @@ owns its own durability): data files carry crc32 checksums in the
 manifest, every file is fsync'd before the atomic rename publishes the
 step, and :meth:`CheckpointManager.latest_valid_step` verifies integrity
 so a restore falls back PAST a truncated/corrupt/partial step dir to the
-newest intact one instead of dying on it.
+newest intact one instead of dying on it. Retention never prunes the
+last verified snapshot (corrupt newer steps don't garbage-collect the
+only intact fallback), and both halves of the durability story are
+drillable: ``ckpt.save`` fires before the atomic rename, ``ckpt.restore``
+fires on the step about to be read.
 
 API mirrors an orbax CheckpointManager (save/restore/latest_step/all_steps)
 without taking the dependency for plain-array states.
@@ -21,6 +25,7 @@ without taking the dependency for plain-array states.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import shutil
@@ -29,7 +34,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from photon_ml_tpu.utils.faults import fault_point
+from photon_ml_tpu.utils.faults import fault_point, hits as fault_hits
 
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"
@@ -68,6 +73,31 @@ def _unflatten(spec: Any, arrays: dict[str, np.ndarray]) -> Any:
     if kind == "scalar":
         return spec["value"]
     return arrays[spec["key"]]
+
+
+def dumps_state(state: Any) -> bytes:
+    """Serialize a checkpoint-shaped structure (nested dict/list/tuple with
+    scalar and NUMERIC array leaves) to one self-describing byte string —
+    the same skeleton+npz format as an on-disk step, zipped in memory. Used
+    by the multi-host resume path: process 0 restores the snapshot and
+    broadcasts these bytes to the re-formed gang, so every process resumes
+    from the identical state without sharing a filesystem."""
+    arrays: dict[str, np.ndarray] = {}
+    skeleton = _flatten(state, "root", arrays)
+    # keys are "root..."-prefixed, so the skeleton entry can't collide
+    arrays["__skeleton__"] = np.frombuffer(
+        json.dumps(skeleton).encode("utf-8"), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def loads_state(data: bytes) -> Any:
+    """Inverse of :func:`dumps_state`."""
+    with np.load(io.BytesIO(data)) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    skeleton = json.loads(arrays.pop("__skeleton__").tobytes().decode())
+    return _unflatten(skeleton, arrays)
 
 
 def _file_crc32(path: str) -> str:
@@ -181,27 +211,68 @@ class CheckpointManager:
                        "skeleton": skeleton}, fh)
             fh.flush()
             os.fsync(fh.fileno())
+        fired_before = fault_hits("ckpt.save")
         fault_point("ckpt.save", path=tmp)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)
         _fsync_dir(self.directory)
-        self._retain()
+        # the bytes just checksummed+fsync'd are known-good unless a
+        # ckpt.save drill tampered with them — skip re-reading them in
+        # retention's verified-step scan on the common path
+        self._retain(trusted_step=(
+            None if fault_hits("ckpt.save") != fired_before else step))
+
+    def raise_if_all_corrupt(self) -> None:
+        """Raise :class:`CheckpointCorruptionError` when the directory
+        HAS step dirs but none passes verification — the caller must not
+        silently retrain from scratch over recoverable data loss. Quiet
+        on an empty or healthy directory. (Also the pre-flight check the
+        multi-host driver runs before any supervisor starts.)"""
+        if self.all_steps() and self.latest_valid_step() is None:
+            raise CheckpointCorruptionError(
+                f"checkpoint dir {self.directory} holds "
+                f"{len(self.all_steps())} step(s) but none passes "
+                f"integrity verification — refusing to silently start "
+                f"over; clear the directory to retrain from scratch")
+
+    def _latest_valid_or_raise(self) -> int:
+        step = self.latest_valid_step()
+        if step is not None:
+            return step
+        self.raise_if_all_corrupt()
+        raise FileNotFoundError(
+            f"no valid checkpoints under {self.directory}")
 
     def restore(self, step: Optional[int] = None) -> Any:
         """Restore ``step``, or (by default) the newest step that passes
         integrity verification. An explicitly requested corrupt step
         raises :class:`CheckpointCorruptionError` rather than returning
-        garbage."""
-        if step is None:
-            step = self.latest_valid_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no valid checkpoints under {self.directory}")
-        elif not self.verify_step(step):
-            raise CheckpointCorruptionError(
-                f"checkpoint step {step} under {self.directory} failed "
-                f"integrity verification")
+        garbage; so does a directory that HAS step dirs but none intact —
+        silently pretending no checkpoint existed would make a caller
+        retrain from scratch over recoverable data loss. A directory with
+        no steps at all raises FileNotFoundError (a fresh run).
+
+        The ``ckpt.restore`` fault point fires on the step about to be
+        read, BEFORE it is read: a ``corrupt``-mode drill flips its bytes
+        and the default path must fall back to an older intact step, the
+        mirror image of the ``ckpt.save`` drill. The integrity scan is
+        re-run only when a fault actually fired (the hit counter moved) —
+        the common restore pays for ONE scan."""
+        explicit = step is not None
+        if not explicit:
+            step = self._latest_valid_or_raise()
+        fired_before = fault_hits("ckpt.restore")
+        fault_point("ckpt.restore", path=self._step_dir(step))
+        if explicit:
+            if not self.verify_step(step):
+                raise CheckpointCorruptionError(
+                    f"checkpoint step {step} under {self.directory} "
+                    f"failed integrity verification")
+        elif fault_hits("ckpt.restore") != fired_before:
+            # a drill just touched the chosen step: re-resolve so a
+            # corrupt-mode fault exercises the real fallback path
+            step = self._latest_valid_or_raise()
         d = self._step_dir(step)
         with open(os.path.join(d, _MANIFEST)) as fh:
             manifest = json.load(fh)
@@ -209,9 +280,29 @@ class CheckpointManager:
             arrays = {k: npz[k] for k in npz.files}
         return _unflatten(manifest["skeleton"], arrays)
 
-    def _retain(self) -> None:
+    def _retain(self, trusted_step: Optional[int] = None) -> None:
+        """Prune to the newest ``max_to_keep`` steps — but never
+        garbage-collect the only VERIFIED snapshot: if every step inside
+        the keep window is corrupt (torn writes racing a crash), the
+        newest verified step outside the window survives too, so a later
+        restore still has something intact to fall back to.
+        ``trusted_step`` is a step known valid without re-reading it
+        (save() just checksummed its bytes), so the common save pays no
+        verification I/O at all."""
         if self.max_to_keep is None:
             return
         steps = self.all_steps()
-        for step in steps[:-self.max_to_keep]:
-            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        if len(steps) <= self.max_to_keep:
+            return  # nothing would be pruned: skip the verification scan
+        keep = set(steps[-self.max_to_keep:])
+        # newest-first: the just-written step usually verifies on the
+        # first pass, so a pruning save costs one crc read-back at most
+        if trusted_step not in keep and not any(
+                self.verify_step(s) for s in sorted(keep, reverse=True)):
+            for s in reversed(steps):
+                if s not in keep and self.verify_step(s):
+                    keep.add(s)
+                    break
+        for step in steps:
+            if step not in keep:
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)

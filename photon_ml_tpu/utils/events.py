@@ -78,6 +78,21 @@ class RecoveryEvent(Event):
     message: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class CoordinateQuarantinedEvent(Event):
+    """A coordinate exhausted its per-coordinate failure budget
+    (``RecoveryPolicy.quarantine_after``) and is frozen at its last-good
+    state for the rest of the run; the other coordinates keep descending.
+    The chronically-diverging-coordinate terminal record — one bad
+    coordinate no longer burns the global retry budget or aborts the
+    run."""
+
+    coordinate_id: str
+    iteration: int
+    failures: int
+    message: str = ""
+
+
 EventListener = Callable[[Event], None]
 
 

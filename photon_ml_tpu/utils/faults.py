@@ -10,11 +10,24 @@ operator drilling a cluster) arm failures against those names.
 Fault points instrumented in the codebase:
 
 - ``cd.update``          — after each coordinate-descent coordinate update
-                           (game/coordinate_descent.py)
+                           (game/coordinate_descent.py); tagged
+                           ``"<sweep>.<coordinate_index>"`` so a drill can
+                           kill one SPECIFIC update mid-sweep (e.g.
+                           ``cd.update@1.1=kill:1``)
+- ``cd.sweep``           — at the top of each coordinate-descent sweep,
+                           tagged with the sweep index (both the
+                           single-process loop in
+                           game/coordinate_descent.py and the multi-host
+                           one in parallel/multihost.py)
 - ``optimizer.gradient`` — on the solver output of a GLM solve
                            (optimize/problem.py)
 - ``ckpt.save``          — after a checkpoint's tmp dir is fully written,
                            before the atomic rename (utils/checkpoint.py)
+- ``ckpt.restore``       — on the checkpoint step about to be read, before
+                           it is read (utils/checkpoint.py); ``corrupt``
+                           flips its bytes so the restore must fall back
+                           to an older intact step, ``raise`` fails the
+                           restore outright
 - ``worker.start``       — in a multi-host worker right after
                            ``jax.distributed.initialize``
                            (parallel/multihost.py)

@@ -1,0 +1,32 @@
+"""Tier-1 wiring for tools/crash_resume_drill.py: the self-contained
+crash→resume→verify drill must pass on every commit, so checkpoint/resume
+regressions fail loudly in CI instead of surfacing as lost work on a TPU
+pod. The drill itself (real subprocess kill via an injected
+``cd.update@1.1=kill`` fault, mid-sweep resume, bit-exact final-state
+parity, all-corrupt refusal) lives in the tool; this test just runs it."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_crash_resume_drill_end_to_end(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # a fault armed by an outer harness must not leak into the drill's
+    # own carefully-scripted fault schedule
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "tools", "crash_resume_drill.py"),
+         "--workdir", str(tmp_path), "--sweeps", "3"],
+        env=env, cwd=_REPO, text=True, capture_output=True, timeout=420)
+    assert p.returncode == 0, (
+        f"drill failed rc={p.returncode}\nstdout:\n{p.stdout}\n"
+        f"stderr:\n{p.stderr}")
+    assert "DRILL_OK" in p.stdout, p.stdout
+    assert "bit-exact" in p.stdout, p.stdout
+    assert "refused cleanly" in p.stdout, p.stdout

@@ -860,6 +860,60 @@ class TestCheckpointResume:
         import os
         assert os.path.isdir(os.path.join(str(tmp_path / "out2"), "best"))
 
+    def test_mid_sweep_checkpoints_and_quarantine_summary(self, tmp_path):
+        """--checkpoint-every-coordinates lands mid-sweep snapshots, and a
+        coordinate that exhausts --recovery-quarantine-after is frozen,
+        the run completes, and metrics.json reports it."""
+        from photon_ml_tpu.utils import faults
+        from photon_ml_tpu.utils.checkpoint import CheckpointManager
+
+        faults.disarm_all()
+        train = str(tmp_path / "train.avro")
+        _make_game_avro(train, n=200, seed=7)
+        ckpt = str(tmp_path / "ckpt")
+        out = str(tmp_path / "out")
+        # the per-user coordinate (index 1) fails in both sweeps: budget 1
+        # quarantines it at the first exhausted update
+        faults.arm("cd.update", "raise", tag="0.1")
+        faults.arm("cd.update", "raise", tag="1.1")
+        try:
+            game_main([
+                "--train-input-dirs", train,
+                "--output-dir", out,
+                "--task-type", "LOGISTIC_REGRESSION",
+                "--feature-shard-id-to-feature-section-keys-map",
+                "global:globalFeatures|user:userFeatures",
+                "--updating-sequence", "fixed,perUser",
+                "--num-iterations", "2",
+                "--fixed-effect-data-configurations", "fixed:global,1",
+                "--fixed-effect-optimization-configurations",
+                "fixed:15,1e-7,0.1,1,LBFGS,L2",
+                "--random-effect-data-configurations",
+                "perUser:userId,user,1",
+                "--random-effect-optimization-configurations",
+                "perUser:15,1e-7,1,1,LBFGS,L2",
+                "--checkpoint-dir", ckpt,
+                "--checkpoint-every-coordinates", "1",
+                "--recovery-policy", "skip",
+                "--recovery-max-retries", "0",
+                "--recovery-quarantine-after", "1",
+            ])
+        finally:
+            faults.disarm_all()
+        with open(os.path.join(out, "metrics.json")) as fh:
+            record = json.load(fh)
+        assert record["quarantined"] == ["perUser"]
+        assert record["grid"][0]["quarantined"] == ["perUser"]
+        # only fixed-effect updates landed in the training record
+        assert {s["coordinate"]
+                for s in record["grid"][0]["states"]} == {"fixed"}
+        # mid-sweep snapshots exist and the newest carries the quarantine
+        mgr = CheckpointManager(ckpt)
+        assert len(mgr.all_steps()) >= 2
+        snap = mgr.restore()
+        assert snap["quarantined"] == ["perUser"]
+        assert os.path.isdir(os.path.join(out, "best"))
+
     def test_dated_inputs(self, tmp_path):
         day_dir = tmp_path / "data" / "daily" / "2026" / "07" / "01"
         day_dir.mkdir(parents=True)

@@ -327,6 +327,211 @@ class TestRecoveryPolicy:
     def test_policy_validation(self):
         with pytest.raises(ValueError):
             RecoveryPolicy(on_exhausted="explode")
+        with pytest.raises(ValueError):
+            RecoveryPolicy(quarantine_after=-1)
+
+
+def _two_coordinates(rng, n=300, n_users=6):
+    """Fixed + per-user random effect over one synthetic sample axis."""
+    from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_ml_tpu.game.dataset import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+    )
+
+    d_g, d_u = 4, 3
+    Xg = rng.normal(size=(n, d_g))
+    Xu = rng.normal(size=(n, d_u))
+    users = rng.integers(0, n_users, size=n)
+    w = rng.normal(size=d_g)
+    W = rng.normal(size=(n_users, d_u))
+    margin = Xg @ w + np.einsum("nd,nd->n", Xu, W[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float64)
+    data = GameDataset(responses=y,
+                       feature_shards={"global": sp.csr_matrix(Xg),
+                                       "per_user": sp.csr_matrix(Xu)})
+    data.encode_ids("userId", users)
+
+    def cfg(lam):
+        return GLMOptimizationConfiguration(
+            max_iterations=25, tolerance=1e-8, regularization_weight=lam,
+            optimizer_type=OptimizerType.LBFGS,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2))
+
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            dataset=build_fixed_effect_dataset(data, "global"),
+            problem=GLMOptimizationProblem(
+                config=cfg(0.1), task=TaskType.LOGISTIC_REGRESSION)),
+        "perUser": RandomEffectCoordinate(
+            dataset=build_random_effect_dataset(
+                data, RandomEffectDataConfiguration(
+                    "userId", "per_user", 1)),
+            problem=RandomEffectOptimizationProblem(
+                config=cfg(0.5), task=TaskType.LOGISTIC_REGRESSION)),
+    }
+    return data, coords
+
+
+def _run_cd2(data, coords, iters, **kw):
+    return run_coordinate_descent(
+        coords, iters, TaskType.LOGISTIC_REGRESSION,
+        jnp.asarray(data.responses), jnp.asarray(data.weights),
+        jnp.asarray(data.offsets), **kw)
+
+
+def _final_arrays(result):
+    """Published per-coordinate coefficient arrays for exact comparison."""
+    out = {}
+    for cid, m in result.model.models.items():
+        inner = getattr(m, "model", None)
+        out[cid] = np.asarray(inner.coefficients.means if inner is not None
+                              else m.coefficients_projected)
+    return out
+
+
+class TestCoordinateQuarantine:
+    """Per-coordinate failure budgets: a chronically-diverging coordinate
+    is frozen at last-good state while the rest keeps descending."""
+
+    def test_chronic_coordinate_is_quarantined_run_completes(self, rng):
+        from photon_ml_tpu.utils.events import CoordinateQuarantinedEvent
+
+        data, coords = _two_coordinates(rng)
+        # perUser (coordinate index 1) fails in sweeps 0 and 1; budget 2
+        faults.arm("cd.update", "raise", tag="0.1")
+        faults.arm("cd.update", "raise", tag="1.1")
+        seen = []
+        emitter = EventEmitter()
+        emitter.register_listener(seen.append)
+        res = _run_cd2(
+            data, coords, iters=3,
+            recovery=RecoveryPolicy(max_retries=0, on_exhausted="abort",
+                                    quarantine_after=2,
+                                    max_consecutive_failures=2),
+            events=emitter)
+        # the run completed despite on_exhausted="abort": the budgeted
+        # coordinate was skipped once, then quarantined
+        assert res.quarantined == ["perUser"]
+        q = [e for e in seen if isinstance(e, CoordinateQuarantinedEvent)]
+        assert len(q) == 1
+        assert q[0].coordinate_id == "perUser" and q[0].failures == 2
+        assert q[0].iteration == 1
+        # fixed kept updating every sweep; perUser never landed a update
+        by_cid = {}
+        for s in res.states:
+            by_cid.setdefault(s.coordinate_id, []).append(s)
+        assert len(by_cid["fixed"]) == 3
+        assert "perUser" not in by_cid
+        assert np.isfinite([s.objective for s in res.states]).all()
+
+    def test_budgeted_skips_do_not_burn_global_budget(self, rng):
+        """A budgeted coordinate's skips are bounded by ITS quarantine
+        budget and must not trip the global consecutive-failure abort
+        first — the docstring's whole promise."""
+        data, coord = _fixed_coordinate(rng)
+        faults.arm("cd.update", "raise", times=100)
+        res = _run_cd(
+            data, coord, iters=5,
+            recovery=RecoveryPolicy(max_retries=0, quarantine_after=3,
+                                    max_consecutive_failures=2))
+        # without the budget the run would abort at 2 consecutive skips;
+        # with it, the coordinate is quarantined at its own bound of 3
+        assert res.quarantined == ["g"]
+        assert res.states == []
+
+    def test_quarantined_coordinate_keeps_last_good_state(self, rng):
+        data, coords = _two_coordinates(rng)
+        # perUser succeeds in sweep 0, then fails forever from sweep 1
+        for it in range(1, 4):
+            faults.arm("cd.update", "raise", tag=f"{it}.1")
+        res = _run_cd2(
+            data, coords, iters=4,
+            recovery=RecoveryPolicy(max_retries=0, quarantine_after=1))
+        assert res.quarantined == ["perUser"]
+        # the published perUser model is the sweep-0 state, not zeros
+        final = _final_arrays(res)
+        assert np.abs(final["perUser"]).max() > 0
+
+    def test_quarantine_state_survives_checkpoint_resume(self, rng,
+                                                         tmp_path):
+        """The quarantine set and per-coordinate failure counters ride
+        the snapshot: a resumed run does not retry a frozen coordinate."""
+        data, coords = _two_coordinates(rng)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=None)
+        faults.arm("cd.update", "raise", tag="0.1")
+        _run_cd2(data, coords, iters=2, checkpoint_manager=mgr,
+                 recovery=RecoveryPolicy(max_retries=0, quarantine_after=1))
+        snap = mgr.restore()
+        assert snap["quarantined"] == ["perUser"]
+        assert snap["coordinate_failures"] == {"perUser": 1}
+        # resume two more sweeps: no faults armed, but perUser stays out
+        _, coords2 = _two_coordinates(np.random.default_rng(42))
+        res = _run_cd2(data, coords2, iters=4, resume_snapshot=snap,
+                       recovery=RecoveryPolicy(max_retries=0,
+                                               quarantine_after=1))
+        assert res.quarantined == ["perUser"]
+        assert all(s.coordinate_id == "fixed" for s in res.states)
+
+
+class TestMidSweepCheckpointResume:
+    """The tentpole invariant: a run killed INSIDE a sweep resumes from
+    its last completed coordinate update and finishes bit-exactly equal
+    to the uninterrupted run."""
+
+    def test_mid_sweep_resume_is_bit_exact(self, rng, tmp_path):
+        data, coords = _two_coordinates(rng)
+        ref = _run_cd2(data, coords, iters=3)
+
+        # interrupted run: per-coordinate snapshots, killed (via raise —
+        # same control flow as a crash, in-process) at sweep 1 coord 1
+        _, coords_b = _two_coordinates(np.random.default_rng(42))
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=None)
+        faults.arm("cd.update", "raise", tag="1.1")
+        with pytest.raises(faults.InjectedFault):
+            _run_cd2(data, coords_b, iters=3, checkpoint_manager=mgr,
+                     checkpoint_every_coordinates=1)
+        snap = mgr.restore()
+        assert (int(snap["sweep"]), int(snap["coordinate_index"])) == (1, 1)
+
+        _, coords_c = _two_coordinates(np.random.default_rng(42))
+        res = _run_cd2(data, coords_c, iters=3, checkpoint_manager=mgr,
+                       checkpoint_every_coordinates=1,
+                       resume_snapshot=snap)
+        # resumed history covers exactly the post-crash updates
+        assert [(s.iteration, s.coordinate_id) for s in res.states] == [
+            (1, "perUser"), (2, "fixed"), (2, "perUser")]
+        ref_final = _final_arrays(ref)
+        res_final = _final_arrays(res)
+        for cid in ref_final:
+            assert np.array_equal(ref_final[cid], res_final[cid]), \
+                f"coordinate {cid} not bit-exact after mid-sweep resume"
+        assert (res.states[-1].objective == ref.states[-1].objective)
+
+    def test_snapshot_carries_full_resume_state(self, rng, tmp_path):
+        data, coords = _two_coordinates(rng)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=None)
+        _run_cd2(data, coords, iters=1, checkpoint_manager=mgr,
+                 checkpoint_every_coordinates=1)
+        # one mid-sweep snapshot (after fixed) + the sweep-end snapshot
+        assert mgr.all_steps() == [1, 2]
+        mid = mgr.restore(1)
+        assert (mid["sweep"], mid["coordinate_index"]) == (0, 1)
+        assert set(mid["scores"]) == {"fixed", "perUser"}
+        # a never-updated coordinate's score is stored as zeros, NOT
+        # recomputed from its initial state on resume
+        assert np.all(mid["scores"]["perUser"] == 0)
+        assert np.abs(mid["scores"]["fixed"]).max() > 0
+        assert mid["update_counts"] == {"fixed": 1}
+        assert mid["consecutive_failures"] == 0
+        assert mid["quarantined"] == []
+        end = mgr.restore(2)
+        assert (end["sweep"], end["coordinate_index"]) == (1, 0)
+        assert end["iteration"] == 1  # legacy field: completed sweeps
 
 
 # ---------------------------------------------------------------------------
@@ -402,6 +607,71 @@ class TestCheckpointHardening:
             json.dump(manifest, fh)
         assert mgr.latest_valid_step() == 1
         assert mgr.restore(1)["step"] == 1
+
+    def test_retention_never_prunes_sole_valid_step(self, tmp_path):
+        """Corrupt newer steps must not garbage-collect the only VERIFIED
+        snapshot: the keep window holds no intact step, so the newest
+        valid one outside it survives retention."""
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        mgr.save(1, {"step": 1})
+        # ckpt.save corrupt flips the tmp dir's bytes BEFORE the rename:
+        # the published steps 2 and 3 are both born corrupt
+        faults.arm("ckpt.save", "corrupt", times=2)
+        mgr.save(2, {"step": 2})
+        mgr.save(3, {"step": 3})
+        assert mgr.all_steps() == [1, 2, 3]  # 1 NOT pruned
+        assert mgr.latest_valid_step() == 1
+        assert mgr.restore()["step"] == 1
+        # a fresh valid save releases the hold on the old step
+        mgr.save(4, {"step": 4})
+        assert mgr.all_steps() == [3, 4]
+        assert mgr.restore()["step"] == 4
+
+    def test_restore_fault_point_raise(self, tmp_path):
+        mgr = self._mk(tmp_path, steps=2)
+        faults.arm("ckpt.restore", "raise")
+        with pytest.raises(faults.InjectedFault):
+            mgr.restore()
+        assert mgr.restore()["step"] == 2  # budget spent: restore works
+
+    def test_restore_fault_point_corrupt_falls_back(self, tmp_path):
+        """corrupt-mode ckpt.restore flips the step about to be read
+        BEFORE it is read — the restore must fall back to the previous
+        intact step, mirroring the ckpt.save drill."""
+        mgr = self._mk(tmp_path)
+        faults.arm("ckpt.restore", "corrupt")
+        assert mgr.restore()["step"] == 2
+        assert mgr.latest_valid_step() == 2  # step 3 really was flipped
+
+    def test_all_steps_corrupt_bytes_raise_cleanly(self, tmp_path):
+        """A dir that HAS snapshots but none intact must refuse with a
+        clean error — silently pretending no checkpoint existed would
+        retrain from scratch over recoverable data loss."""
+        mgr = self._mk(tmp_path, steps=2)
+        for s in (1, 2):
+            faults.corrupt_path(mgr._step_dir(s))
+        with pytest.raises(CheckpointCorruptionError,
+                           match="none passes integrity"):
+            mgr.restore()
+
+    def test_state_bytes_round_trip(self):
+        """dumps_state/loads_state (the multi-host resume broadcast
+        payload) preserve structure, dtypes, and values exactly."""
+        from photon_ml_tpu.utils.checkpoint import dumps_state, loads_state
+
+        state = {"sweep": 2, "coordinate_index": 1, "objective": None,
+                 "w": np.arange(5, dtype=np.float64) / 3.0,
+                 "re": {"u": (np.ones((2, 3), np.float32), 7)},
+                 "flags": [True, "x", 1.5]}
+        out = loads_state(dumps_state(state))
+        assert out["sweep"] == 2 and out["objective"] is None
+        assert out["flags"] == [True, "x", 1.5]
+        assert isinstance(out["re"]["u"], tuple) and out["re"]["u"][1] == 7
+        assert out["w"].dtype == np.float64
+        np.testing.assert_array_equal(out["w"], state["w"])
+        np.testing.assert_array_equal(out["re"]["u"][0],
+                                      state["re"]["u"][0])
+        assert out["re"]["u"][0].dtype == np.float32
 
     def test_cd_resumes_past_corrupt_step_to_parity(self, rng, tmp_path):
         """Acceptance path: corrupt the newest checkpoint; resume falls
@@ -554,7 +824,6 @@ class TestMultihostFlagValidation:
         ("model_output_mode", "ALL", "--model-output-mode"),
         ("validate_input_dirs", "some/dir", "--validate-input-dirs"),
         ("evaluator_type", "AUC", "--evaluator-type"),
-        ("checkpoint_dir", "ck", "--checkpoint-dir"),
         ("recovery_policy", "skip", "--recovery-policy"),
     ])
     def test_unsupported_flags_raise(self, tmp_path, flag, value, needle):
@@ -570,6 +839,36 @@ class TestMultihostFlagValidation:
         with pytest.raises(ValueError, match="does not support") as ei:
             main(args + ["--max-worker-restarts", "3"])
         assert needle in str(ei.value)
+
+    def test_checkpoint_dir_is_supported_multihost(self, tmp_path):
+        """--checkpoint-dir passes multi-host validation now (process 0
+        owns the snapshots): the run proceeds past the flag check and
+        fails later on the nonexistent feature-set path instead."""
+        from photon_ml_tpu.cli.game_training_driver import main
+
+        args = self._args(str(tmp_path / "out")) + [
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--checkpoint-every-coordinates", "1"]
+        with pytest.raises(FileNotFoundError):
+            main(args)
+
+    def test_all_corrupt_checkpoint_dir_fails_before_supervisor(
+            self, tmp_path):
+        """An all-corrupt checkpoint dir is terminal: process 0 must fail
+        in the pre-supervisor validation pass, not burn the restart
+        budget re-hitting it inside the gang."""
+        from photon_ml_tpu.cli.game_training_driver import main
+
+        ckpt = tmp_path / "ck"
+        mgr = CheckpointManager(str(ckpt))
+        mgr.save(1, {"step": 1})
+        faults.corrupt_path(str(mgr._step_dir(1)))
+        faults.disarm_all()
+        with pytest.raises(CheckpointCorruptionError,
+                           match="none passes integrity"):
+            main(self._args(str(tmp_path / "out"))
+                 + ["--checkpoint-dir", str(ckpt),
+                    "--max-worker-restarts", "3"])
 
     def test_default_model_output_mode_not_rejected(self, tmp_path):
         """Omitting --model-output-mode (argparse default) must NOT trip

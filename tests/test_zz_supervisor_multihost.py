@@ -150,3 +150,89 @@ def test_supervisor_relaunches_killed_worker_to_parity(
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
                                rtol=5e-3, atol=5e-3)
+
+
+def test_supervised_gang_resumes_from_checkpoint(
+        tmp_path, multiprocess_backend):
+    """Multi-host RESUME (not just restart): the gang trains with
+    process-0-owned checkpoints, one worker is killed at the top of
+    sweep 1 (after sweep 0's snapshots landed), every host's supervisor
+    relaunches, and the re-formed gang must resume from the broadcast
+    snapshot — witnessed by the MULTIHOST_RESUME marker — and finish to
+    parity with an uninterrupted single-process run."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _write_game_part(str(data_dir / "part-00000.avro"),
+                     n=120, n_users=5, d_g=4, d_u=2, seed=40)
+    _write_game_part(str(data_dir / "part-00001.avro"),
+                     n=100, n_users=5, d_g=4, d_u=2, seed=41)
+    from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+    sets = NameAndTermFeatureSets.from_paths(
+        [str(data_dir)], ["globalFeatures", "userFeatures"])
+    fs_dir = tmp_path / "fs"
+    sets.save(str(fs_dir))
+
+    from photon_ml_tpu.cli.game_training_driver import (
+        GameTrainingDriver,
+        parse_args,
+    )
+
+    driver = GameTrainingDriver(parse_args(_game_cli_args(
+        str(data_dir), str(tmp_path / "single"), str(fs_dir),
+        num_iterations=2)))
+    result = driver.run()
+    fixed_ref = np.asarray(result.model.models["g"].coefficients.means)
+
+    # the kill fires at cd.sweep@1 — strictly after sweep 0's sweep-end
+    # snapshot — in exactly ONE process incarnation (shared state dir)
+    port = _free_port()
+    mh_out = str(tmp_path / "mh")
+    ckpt = str(tmp_path / "ckpt")
+    procs = []
+    for i in range(2):
+        env = _worker_env(4)
+        env["PHOTON_FAULTS"] = "cd.sweep@1=kill:1:23"
+        env["PHOTON_FAULTS_STATE_DIR"] = str(tmp_path / "fault_state")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "photon_ml_tpu.cli.game_training_driver",
+             *_game_cli_args(str(data_dir), mh_out, str(fs_dir),
+                             num_iterations=2),
+             "--num-processes", "2", "--process-id", str(i),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--coordinator-timeout", "60",
+             "--heartbeat-timeout", "10",
+             "--max-worker-restarts", "3",
+             "--worker-backoff-base", "2.0",
+             "--checkpoint-dir", ckpt,
+             "--checkpoint-every-coordinates", "1"],
+            env=env, cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (f"supervisor {i} rc={rc}\nstdout:\n{out}\n"
+                         f"stderr:\n{err}")
+        assert f"MULTIHOST_GAME_OK process={i}" in out, out
+        assert f"SUPERVISOR_OK worker=p{i} restarts=" in out, out
+    # a genuine RESUME: process 0 restored a sweep-1 snapshot and
+    # broadcast it; at least one restart really happened
+    assert "MULTIHOST_RESUME sweep=1" in outs[0][1], outs[0][1]
+    assert any(int(o[1].split("restarts=")[-1].split()[0]) >= 1
+               for o in outs), [o[1] for o in outs]
+
+    recs = [np.load(os.path.join(mh_out, f"multihost_result.p{i}.npz"),
+                    allow_pickle=False) for i in range(2)]
+    np.testing.assert_allclose(recs[0]["fixed"], recs[1]["fixed"],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
+                               rtol=5e-3, atol=5e-3)
