@@ -171,6 +171,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "(rows, dims), cutting FLOPs/HBM on skewed entity "
                         "sizes (SURVEY hard part 1; not applied to "
                         "factored coordinates, which need one block)")
+    p.add_argument("--re-lane-compaction-chunk", type=int, default=0,
+                   help="solve random-effect entity blocks in iteration "
+                        "chunks of this size, compacting still-active "
+                        "lanes between chunks so converged entities stop "
+                        "paying for the slowest lane's iteration count "
+                        "(0 = one dispatch to max_iterations; costs one "
+                        "small device fetch per chunk)")
     p.add_argument("--random-effect-blocks-dir", default=None,
                    help="build random-effect entity blocks through the "
                         "STREAMED builder with np.memmap destinations "
@@ -401,7 +408,9 @@ class GameTrainingDriver:
                 coords[cid] = FactoredRandomEffectCoordinate(
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
-                        config=re_cfg, task=self.task),
+                        config=re_cfg, task=self.task,
+                        lane_compaction_chunk=max(
+                            0, int(self.ns.re_lane_compaction_chunk))),
                     latent_problem=GLMOptimizationProblem(
                         config=latent_cfg, task=self.task),
                     latent_dim=mf_cfg.num_factors,
@@ -433,7 +442,9 @@ class GameTrainingDriver:
                 coords[cid] = RandomEffectCoordinate(
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
-                        config=opt_cfg, task=self.task))
+                        config=opt_cfg, task=self.task,
+                        lane_compaction_chunk=max(
+                            0, int(self.ns.re_lane_compaction_chunk))))
             else:
                 raise ValueError(
                     f"coordinate {cid!r} in updating sequence has no data "
@@ -685,6 +696,12 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
         unsupported.append(
             "--recovery-policy (divergence recovery is wired into the "
             "single-process coordinate-descent loop only)")
+    if ns.re_lane_compaction_chunk > 0:  # <= 0 is "off" on every path
+        unsupported.append(
+            "--re-lane-compaction-chunk (lane compaction gathers active "
+            "lanes with per-chunk host round-trips; the multi-host solve "
+            "keeps its entity axis mesh-sharded and runs the "
+            "single-dispatch path)")
     if unsupported:
         raise ValueError(
             "multi-host mode (--num-processes > 1) does not support: "

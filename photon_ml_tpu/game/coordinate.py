@@ -44,7 +44,7 @@ from photon_ml_tpu.game.models import (
     FixedEffectModel,
     RandomEffectModelInProjectedSpace,
 )
-from photon_ml_tpu.parallel.mesh import host_array
+from photon_ml_tpu.parallel.mesh import ensure_addressable
 from photon_ml_tpu.game.random_effect import (
     RandomEffectOptimizationProblem,
     score_random_effect,
@@ -69,6 +69,15 @@ class FixedEffectTracker:
 
     result: OptimizationResult
 
+    def materialize(self) -> "FixedEffectTracker":
+        """Force a deferred result's device-resident history host-side
+        (one batched fetch) — the CD loop drains trackers at sweep
+        boundaries so device buffers don't accumulate across the run."""
+        force = getattr(self.result, "_force", None)
+        if force is not None:
+            force()
+        return self
+
     def summary(self) -> str:
         return (f"fixed effect: {self.result.convergence_reason.name}, "
                 f"{self.result.iterations} iterations")
@@ -79,17 +88,47 @@ class RandomEffectTracker:
     """optimization/game/RandomEffectOptimizationTracker analog: iteration
     counts + per-entity convergence-reason counts across the vmapped
     solves (countsByConvergence — the operator's only view into thousands
-    of per-entity fits)."""
+    of per-entity fits).
 
-    iterations: np.ndarray  # [E]
+    LAZY: construction accepts device arrays and performs no host fetch —
+    the CD hot loop creates one of these per update without blocking. The
+    per-entity arrays materialize with a SINGLE ``jax.device_get`` of the
+    whole tuple on first use (``summary()``/``counts_by_convergence()``,
+    i.e. log or metrics time), where they are also sliced to ``num_real``
+    entities (the single-block solver returns entity-axis pad lanes)."""
+
+    iterations: np.ndarray  # [E] (device array until materialized)
     final_values: np.ndarray  # [E]
     convergence_codes: Optional[np.ndarray] = None  # [E] int8
+    # lazy slice bound: real entity count (None = already compact)
+    num_real: Optional[int] = None
+
+    def materialize(self) -> "RandomEffectTracker":
+        """Fetch the per-entity arrays host-side (one explicit
+        ``jax.device_get`` of the tuple, multi-host safe) — idempotent."""
+        if not isinstance(self.iterations, np.ndarray):
+            from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
+
+            it, v, c = jax.device_get(tuple(
+                None if a is None else ensure_addressable(a)
+                for a in (self.iterations, self.final_values,
+                          self.convergence_codes)))
+            record_host_fetch()
+            nr = self.num_real
+            if nr is not None:
+                it, v = it[:nr], v[:nr]
+                c = None if c is None else c[:nr]
+            self.iterations, self.final_values = np.asarray(it), np.asarray(v)
+            self.convergence_codes = None if c is None else np.asarray(c)
+            self.num_real = None
+        return self
 
     def counts_by_convergence(self) -> dict[str, int]:
         """reason name -> entity count
         (RandomEffectOptimizationTracker.countsByConvergence)."""
         from photon_ml_tpu.game.random_effect import CONVERGENCE_CODE_NAMES
 
+        self.materialize()
         if self.convergence_codes is None:
             return {}
         codes, counts = np.unique(self.convergence_codes,
@@ -98,7 +137,7 @@ class RandomEffectTracker:
                 for c, n in zip(codes, counts)}
 
     def summary(self) -> str:
-        it = self.iterations
+        it = self.materialize().iterations
         base = (f"random effect: {len(it)} entities, iterations "
                 f"min/mean/max = {it.min()}/{it.mean():.1f}/{it.max()}")
         counts = self.counts_by_convergence()
@@ -111,6 +150,12 @@ class RandomEffectTracker:
 @dataclasses.dataclass
 class FactoredRandomEffectTracker:
     inner: list[tuple[RandomEffectTracker, FixedEffectTracker]]
+
+    def materialize(self) -> "FactoredRandomEffectTracker":
+        for re_tracker, fe_tracker in self.inner:
+            re_tracker.materialize()
+            fe_tracker.materialize()
+        return self
 
     def summary(self) -> str:
         return (f"factored random effect: {len(self.inner)} inner iterations")
@@ -143,7 +188,10 @@ class FixedEffectCoordinate:
     def update(self, coefs: Optional[Array], extra_scores: Array
                ) -> tuple[Array, Tracker]:
         """Re-optimize on the offset-adjusted batch
-        (FixedEffectCoordinate.updateModel :137-148 + runWithSampling)."""
+        (FixedEffectCoordinate.updateModel :137-148 + runWithSampling).
+        Device-resident: ``run_lazy`` keeps the solve history on device, so
+        the returned coefficients/tracker carry no blocking host read — the
+        CD fused epilogue owns the update's single device→host fetch."""
         batch = self.dataset.with_offsets(extra_scores)
         rate = self.problem.config.down_sampling_rate
         if rate < 1.0:
@@ -152,7 +200,7 @@ class FixedEffectCoordinate:
                 batch, rate, key,
                 is_classification=self.problem.task in _CLASSIFICATION_TASKS)
         self._update_count += 1
-        _, result = self.problem.run(batch, initial=coefs)
+        result = self.problem.run_lazy(batch, initial=coefs)
         return result.coefficients, FixedEffectTracker(result)
 
     def score(self, coefs: Array) -> Array:
@@ -165,6 +213,10 @@ class FixedEffectCoordinate:
 
     def regularization_value(self, coefs: Array) -> float:
         return self.problem.regularization_value(coefs)
+
+    def regularization_value_device(self, coefs: Array):
+        """Penalty as a device scalar (no sync) for the CD epilogue."""
+        return self.problem.regularization_value_device(coefs)
 
     def publish(self, coefs: Array) -> FixedEffectModel:
         means = self.problem.normalization.transform_model_coefficients(coefs)
@@ -200,14 +252,17 @@ class RandomEffectCoordinate:
     def update(self, coefs: Optional[Array], extra_scores: Array
                ) -> tuple[Array, Tracker]:
         offsets = self.dataset.offsets_with(extra_scores)
+        # ``donate=True``: the per-update offset block is rebuilt from the
+        # CD score vector every update, so the solver may reuse its device
+        # buffer in place (no-op on CPU; ``coefs`` — the CD loop's live
+        # last-good state — is never donated, see _dispatch_fit)
         new_coefs, iters, values, codes = self.problem.run(
-            self.dataset, offsets, initial=coefs)
-        # report only real entities: the single-block path returns
-        # entity-axis PAD lanes too (the bucketed path is already compact)
-        nr = len(self.dataset.entity_codes)
-        tracker = RandomEffectTracker(host_array(iters)[:nr],
-                                      host_array(values)[:nr],
-                                      host_array(codes)[:nr])
+            self.dataset, offsets, initial=coefs, donate=True)
+        # lazy tracker: arrays stay on device until log/metrics time; the
+        # num_real bound trims the single-block path's entity-axis PAD
+        # lanes at materialization (the bucketed path is already compact)
+        tracker = RandomEffectTracker(
+            iters, values, codes, num_real=len(self.dataset.entity_codes))
         return new_coefs, tracker
 
     def score(self, coefs: Array) -> Array:
@@ -215,6 +270,10 @@ class RandomEffectCoordinate:
 
     def regularization_value(self, coefs: Array) -> float:
         return self.problem.regularization_value(coefs)
+
+    def regularization_value_device(self, coefs: Array):
+        """Penalty as a device scalar (no sync) for the CD epilogue."""
+        return self.problem.regularization_value_device(coefs)
 
     def publish(self, coefs: Array) -> RandomEffectModelInProjectedSpace:
         return RandomEffectModelInProjectedSpace(
@@ -300,12 +359,12 @@ class FactoredRandomEffectCoordinate:
                                preferred_element_type=jnp.float32)
             lat_ds = dataclasses.replace(ds, X=X_lat, projectors=None,
                                          random_projector=None)
-            coefs, iters, values, codes = self.problem.run(lat_ds, offsets,
-                                                           initial=coefs)
-            nr = len(ds.entity_codes)
-            re_tracker = RandomEffectTracker(host_array(iters)[:nr],
-                                             host_array(values)[:nr],
-                                             host_array(codes)[:nr])
+            # donate=False: ``offsets`` is reused across inner iterations
+            # and by the Kronecker refit below — its buffer must survive
+            coefs, iters, values, codes = self.problem.run(
+                lat_ds, offsets, initial=coefs, donate=False)
+            re_tracker = RandomEffectTracker(
+                iters, values, codes, num_real=len(ds.entity_codes))
             # (2) projection-matrix fit on Kronecker features c_e ⊗ x.
             e, n, d = ds.X.shape
             k = self.latent_dim
@@ -339,6 +398,13 @@ class FactoredRandomEffectCoordinate:
         coefs, B = state
         return (self.problem.regularization_value(coefs)
                 + self.latent_problem.regularization_value(B.reshape(-1)))
+
+    def regularization_value_device(self, state: tuple[Array, Array]):
+        """Penalty as a device scalar (no sync) for the CD epilogue."""
+        coefs, B = state
+        return (self.problem.regularization_value_device(coefs)
+                + self.latent_problem.regularization_value_device(
+                    B.reshape(-1)))
 
     def publish(self, state: tuple[Array, Array]) -> FactoredRandomEffectModel:
         coefs, B = state

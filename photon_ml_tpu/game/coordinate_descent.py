@@ -12,17 +12,26 @@ validation evaluator (:245-255).
 
 The reference's per-step RDD joins/unpersists become array adds and gathers;
 all score vectors are sample-major ``[N]`` device arrays.
+
+Hot-loop sync discipline: one coordinate update costs exactly ONE device
+round-trip. The update, its score, the changed coordinate's regularization
+scalar, and the fused epilogue (:func:`make_update_epilogue`) dispatch
+asynchronously; the single blocking read is a ``jax.device_get`` of the
+epilogue's small scalar pytree. Everything sample-sized — the canonical
+score total included — stays device-resident between updates, and the
+per-coordinate trackers/optimizer histories materialize lazily at
+log/metrics/checkpoint time. ``tests/test_sync_discipline.py`` enforces
+this under ``jax.transfer_guard("disallow")``.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import math
+import functools
 import time
 from typing import Callable, Optional
 
-import numpy as np
-
+import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.game.coordinate import Coordinate, Tracker
@@ -37,12 +46,93 @@ from photon_ml_tpu.utils.events import (
     RecoveryEvent,
 )
 from photon_ml_tpu.utils.faults import InjectedFault, fault_point
+from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
 
 Array = jnp.ndarray
 
 
 class CoordinateDivergenceError(RuntimeError):
     """A coordinate update produced a non-finite state or objective."""
+
+
+# Hot-loop sync telemetry for bench.py / the transfer-guard test: the
+# one-round-trip contract says every non-validation coordinate update
+# performs EXACTLY ONE blocking device→host fetch (the fused epilogue's
+# small scalar pytree). ``update_dispatch_secs`` is host time spent
+# dispatching the update + epilogue (async), ``epilogue_wait_secs`` the
+# blocking wait inside the single fetch.
+HOT_LOOP_STATS = {"updates": 0, "epilogue_fetches": 0,
+                  "update_dispatch_secs": 0.0, "epilogue_wait_secs": 0.0}
+
+
+def reset_hot_loop_stats() -> None:
+    HOT_LOOP_STATS.update({"updates": 0, "epilogue_fetches": 0,
+                           "update_dispatch_secs": 0.0,
+                           "epilogue_wait_secs": 0.0})
+
+
+def _canonical_sum(score_list, num_samples: int):
+    """Σ scores in updating-sequence order from zero — the ONE summation
+    order used everywhere (init, resume, and INSIDE the fused epilogue), so
+    a resumed run reproduces the uninterrupted run's floats exactly."""
+    t = jnp.zeros(num_samples)
+    for s in score_list:
+        t = t + s
+    return t
+
+
+@functools.lru_cache(maxsize=32)
+def _canonical_total_jit(num_samples: int):
+    """Jitted canonical summation, cached per sample count so repeated
+    runs (and the warm bench pass) reuse the executable."""
+    return jax.jit(lambda score_list: _canonical_sum(score_list,
+                                                     num_samples))
+
+
+@functools.lru_cache(maxsize=32)
+def make_update_epilogue(task: TaskType, num_samples: int):
+    """Build the fused, jitted update epilogue (cached per task/sample
+    count: repeated runs share one compiled executable per shape).
+
+    One compiled call computes everything the host needs after a candidate
+    coordinate update, replacing what used to be O(K) blocking syncs per
+    update (a ``float()`` per coordinate's regularization term, a
+    ``bool()`` per state leaf for the finiteness guard, a ``float()`` for
+    the objective) with a single device program whose small outputs are
+    fetched as ONE pytree:
+
+    - the canonical ids-order score total (kept ON DEVICE — it feeds the
+      next update's partial-score offsets without a round-trip); summation
+      order is preserved inside the fused op so crash/resume stays
+      bit-exact,
+    - the training loss Σᵢ wᵢ·l(totalᵢ + offsetᵢ, yᵢ) (:199-205),
+    - Σ regularization from the per-coordinate reg-scalar cache (updated
+      only for the changed coordinate, summed in ids order),
+    - the global objective (training loss + Σ reg),
+    - one all-leaves finiteness flag over the candidate state + objective.
+
+    ``score_list``/``reg_list`` arrive in updating-sequence order with the
+    changed coordinate's entries already substituted.
+    """
+    loss = get_loss(TASK_LOSS_NAME[task])
+
+    @jax.jit
+    def epilogue(score_list, reg_list, state_leaves, labels, weights,
+                 offsets):
+        total = _canonical_sum(score_list, num_samples)
+        l, _ = loss.loss_and_d1(total + offsets, labels)
+        train_loss = jnp.sum(weights * l)
+        reg_total = 0.0
+        for r in reg_list:  # ids order (python floats stay op-free)
+            reg_total = reg_total + r
+        objective = train_loss + reg_total
+        state_finite = jnp.asarray(True)
+        for leaf in state_leaves:
+            state_finite = state_finite & jnp.all(jnp.isfinite(leaf))
+        finite = state_finite & jnp.isfinite(objective)
+        return total, objective, train_loss, reg_total, finite, state_finite
+
+    return epilogue
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,13 +242,6 @@ class CoordinateDescentResult:
     quarantined: list[str] = dataclasses.field(default_factory=list)
 
 
-def _to_np_states(d: dict) -> dict:
-    return {cid: (tuple(np.asarray(s) for s in d[cid])
-                  if isinstance(d[cid], tuple)
-                  else np.asarray(d[cid]))
-            for cid in d}
-
-
 def _to_jnp_states(d: dict) -> dict:
     return {cid: (tuple(jnp.asarray(s) for s in v)
                   if isinstance(v, tuple) else jnp.asarray(v))
@@ -217,7 +300,12 @@ def run_coordinate_descent(
     maintained incrementally, so a resumed run sees float-identical
     partial scores to the uninterrupted one.
     """
-    log = logger or (lambda s: None)
+    def log(fn: Callable[[], str]):
+        # Lazy formatting: log lines materialize lazy trackers (a device
+        # fetch), so a run without a logger must never even BUILD them.
+        if logger is not None:
+            logger(fn())
+
     emit = events.send_event if events is not None else (lambda e: None)
     ids = list(coordinates)
     n = {cid: coordinates[cid].num_samples for cid in ids}
@@ -225,7 +313,11 @@ def run_coordinate_descent(
     assert all(v == num_samples for v in n.values()), \
         "all coordinates must cover the same sample axis"
 
-    loss_eval = training_loss_evaluator(task, labels, weights, offsets)
+    epilogue = make_update_epilogue(task, num_samples)
+    # The canonical total is computed by the SAME jitted summation the
+    # epilogue runs, so the init/resume total is bit-identical to the
+    # fused op's (XLA executes the identical add sequence).
+    canonical_total_fn = _canonical_total_jit(num_samples)
 
     consecutive_failures = 0
     coordinate_failures: dict[str, int] = {}
@@ -263,12 +355,10 @@ def run_coordinate_descent(
 
     def canonical_total(score_map):
         """Σ scores in ids order from zero — the ONE summation order used
-        everywhere, so a resume that rebuilds the total from restored
-        scores reproduces the uninterrupted run's floats exactly."""
-        t = jnp.zeros(num_samples)
-        for c in ids:
-            t = t + score_map[c]
-        return t
+        everywhere (shared with the fused epilogue), so a resume that
+        rebuilds the total from restored scores reproduces the
+        uninterrupted run's floats exactly."""
+        return canonical_total_fn(tuple(score_map[c] for c in ids))
 
     if restored_scores is not None:
         # Mid-sweep resume: scores come back verbatim from the snapshot
@@ -287,6 +377,19 @@ def run_coordinate_descent(
                   for cid in ids}
     total = canonical_total(scores)
 
+    # Device-resident per-coordinate regularization scalar cache: the fused
+    # epilogue sums these in ids order; only the CHANGED coordinate's entry
+    # is recomputed per update (the old path re-evaluated all K penalties
+    # with a blocking float() each — O(K²) syncs per sweep). Deterministic
+    # on resume: recomputed from the restored states by the same ops.
+    def _reg_device(cid, state):
+        coord = coordinates[cid]
+        fn = getattr(coord, "regularization_value_device",
+                     coord.regularization_value)
+        return fn(state)
+
+    reg_cache = {cid: _reg_device(cid, states[cid]) for cid in ids}
+
     history: list[CoordinateDescentState] = []
     best_model = None
     best_metric = None
@@ -298,8 +401,16 @@ def run_coordinate_descent(
 
     def attempt_update(cid, ci, it, attempt):
         """One (possibly damped) coordinate update from last-good state;
-        raises CoordinateDivergenceError on a non-finite result."""
+        raises CoordinateDivergenceError on a non-finite result.
+
+        ONE device round-trip: the update, its score, the changed
+        coordinate's regularization scalar, and the fused epilogue are all
+        dispatched asynchronously; the only blocking device→host read is
+        the single ``jax.device_get`` of the epilogue's small scalar
+        pytree (objective, training loss, reg total, finiteness flags).
+        The canonical score total stays on device for the next update."""
         coord = coordinates[cid]
+        t0 = time.perf_counter()
         partial = total - scores[cid]  # Σ other coordinates (:143-151)
         cand, tracker = coord.update(states[cid], partial)
         cand = fault_point("cd.update", tag=f"{it}.{ci}", arrays=cand)
@@ -307,18 +418,29 @@ def run_coordinate_descent(
             cand = _damp_toward(states[cid], cand,
                                 recovery.damping ** attempt)
         new_score = coord.score(cand)
-        new_total = partial + new_score
-        reg = sum(coordinates[c].regularization_value(states[c])
-                  for c in ids if c != cid)
-        reg += coord.regularization_value(cand)
-        objective = loss_eval(new_total) + reg  # (:199-205)
-        if recovery is not None and (
-                not math.isfinite(objective) or not _state_is_finite(cand)):
+        new_reg = _reg_device(cid, cand)
+        (new_total, objective_d, train_loss_d, _reg_total_d, finite_d,
+         state_finite_d) = epilogue(
+            tuple(new_score if c == cid else scores[c] for c in ids),
+            tuple(new_reg if c == cid else reg_cache[c] for c in ids),
+            tuple(jnp.asarray(leaf) for leaf in _state_leaves(cand)),
+            labels, weights, offsets)  # (:199-205)
+        HOT_LOOP_STATS["update_dispatch_secs"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        objective, train_loss, finite, state_finite = jax.device_get(
+            (objective_d, train_loss_d, finite_d, state_finite_d))
+        record_host_fetch()
+        HOT_LOOP_STATS["epilogue_wait_secs"] += time.perf_counter() - t0
+        HOT_LOOP_STATS["epilogue_fetches"] += 1
+        HOT_LOOP_STATS["updates"] += 1
+        objective = float(objective)
+        if recovery is not None and not bool(finite):
             raise CoordinateDivergenceError(
                 f"iter {it} coordinate {cid}: non-finite "
-                f"{'objective' if not math.isfinite(objective) else 'state'}"
+                f"{'state' if not bool(state_finite) else 'objective'}"
                 f" (attempt {attempt})")
-        return cand, tracker, new_score, objective
+        return (cand, tracker, new_score, new_reg, new_total, objective,
+                float(train_loss))
 
     last_saved_step = None
 
@@ -333,17 +455,26 @@ def run_coordinate_descent(
         step = sweep * len(ids) + next_ci
         if step == last_saved_step:
             return
+        # THE fetch point: the whole snapshot (per-coordinate states AND
+        # scores, still device-resident from the hot loop) comes back in
+        # one explicit jax.device_get of the payload pytree instead of a
+        # per-leaf np.asarray chain.
+        payload = jax.device_get({
+            "states": states,
+            "scores": {cid: scores[cid] for cid in ids},
+            "best_states": best_states,
+        })
+        record_host_fetch()
         checkpoint_manager.save(step, {
             "sweep": sweep,
             "coordinate_index": next_ci,
             # legacy field: completed sweeps (pre-mid-sweep readers)
             "iteration": sweep,
-            "states": _to_np_states(states),
-            "scores": {cid: np.asarray(scores[cid]) for cid in ids},
+            "states": payload["states"],
+            "scores": payload["scores"],
             "best_metric": (None if best_metric is None
                             else float(best_metric)),
-            "best_states": (None if best_states is None
-                            else _to_np_states(best_states)),
+            "best_states": payload["best_states"],
             "update_counts": {
                 cid: int(getattr(coordinates[cid], "_update_count"))
                 for cid in ids
@@ -356,6 +487,7 @@ def run_coordinate_descent(
 
     for it in range(start_iteration, num_iterations):
         fault_point("cd.sweep", tag=str(it))
+        sweep_history_start = len(history)
         for ci, cid in enumerate(ids):
             if it == start_iteration and ci < start_coordinate:
                 continue  # mid-sweep resume: these updates already ran
@@ -368,8 +500,9 @@ def run_coordinate_descent(
             quarantine_now = False
             while True:
                 try:
-                    (cand, tracker, new_score,
-                     objective) = attempt_update(cid, ci, it, attempt)
+                    (cand, tracker, new_score, new_reg, new_total,
+                     objective, _train_loss) = attempt_update(
+                        cid, ci, it, attempt)
                     break
                 except (InjectedFault, CoordinateDivergenceError,
                         FloatingPointError) as e:
@@ -381,7 +514,7 @@ def run_coordinate_descent(
                     emit(FaultEvent(point=getattr(e, "point", "cd.update"),
                                     coordinate_id=cid,
                                     iteration=it, message=str(e)))
-                    log(f"iter {it} coordinate {cid}: FAULT "
+                    log(lambda: f"iter {it} coordinate {cid}: FAULT "
                         f"(attempt {attempt}): {e}")
                     attempt += 1
                     if attempt <= recovery.max_retries:
@@ -420,7 +553,7 @@ def run_coordinate_descent(
                     failures=coordinate_failures[cid],
                     message=(f"{coordinate_failures[cid]} exhausted "
                              f"update(s); frozen at last-good state")))
-                log(f"iter {it} coordinate {cid}: QUARANTINED after "
+                log(lambda: f"iter {it} coordinate {cid}: QUARANTINED after "
                     f"{coordinate_failures[cid]} exhausted update(s) — "
                     f"frozen at last-good state, descent continues "
                     f"({dt:.2f}s)")
@@ -439,7 +572,7 @@ def run_coordinate_descent(
                     consecutive_failures += 1
                 emit(RecoveryEvent(action="skipped", coordinate_id=cid,
                                    iteration=it, attempts=attempt))
-                log(f"iter {it} coordinate {cid}: SKIPPED after "
+                log(lambda: f"iter {it} coordinate {cid}: SKIPPED after "
                     f"{attempt} failed attempt(s) — keeping last-good "
                     f"state ({dt:.2f}s)")
                 if (not budgeted_skip and consecutive_failures
@@ -456,14 +589,17 @@ def run_coordinate_descent(
             if attempt > 0:
                 emit(RecoveryEvent(action="recovered", coordinate_id=cid,
                                    iteration=it, attempts=attempt))
-                log(f"iter {it} coordinate {cid}: recovered after "
+                log(lambda: f"iter {it} coordinate {cid}: recovered after "
                     f"{attempt} retry(ies)")
             consecutive_failures = 0
             states[cid] = cand
             scores[cid] = new_score
-            # canonical, never incrementally drifted: resume parity
-            total = canonical_total(scores)
-            log(f"iter {it} coordinate {cid}: objective={objective:.6f} "
+            reg_cache[cid] = new_reg
+            # canonical (ids order from zero), computed INSIDE the fused
+            # epilogue — never incrementally drifted: resume parity
+            total = new_total
+            log(lambda: f"iter {it} coordinate {cid}: "
+                f"objective={objective:.6f} "
                 f"({dt:.2f}s) — {tracker.summary()}")
 
             metrics = None
@@ -471,7 +607,8 @@ def run_coordinate_descent(
                 model = publish_game_model(coordinates, states)
                 val_scores = model.score(validation_data)
                 metrics = validation_evaluator(val_scores)
-                log(f"iter {it} coordinate {cid}: validation {metrics}")
+                log(lambda: f"iter {it} coordinate {cid}: "
+                    f"validation {metrics}")
                 if validation_metric is not None:
                     m = metrics[validation_metric]
                     better = (best_metric is None
@@ -490,6 +627,17 @@ def run_coordinate_descent(
                     and (it * len(ids) + ci + 1)
                     % checkpoint_every_coordinates == 0):
                 save_snapshot(it, ci + 1)
+
+        # Sweep boundary: drain this sweep's lazy trackers (one batched
+        # explicit fetch each, amortized over the whole sweep) so their
+        # device-resident per-entity arrays and solver histories don't
+        # accumulate in HBM across a long run. The per-update hot path
+        # stays at exactly one fetch; this drain is the off-hot-path
+        # counterpart, like the checkpoint below.
+        for h in history[sweep_history_start:]:
+            mat = getattr(h.tracker, "materialize", None)
+            if mat is not None:
+                mat()
 
         if checkpoint_manager is not None:
             save_snapshot(it, len(ids))

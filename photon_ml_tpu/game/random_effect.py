@@ -24,8 +24,11 @@ path costing only wasted FLOPs, never wrong results.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +37,11 @@ from photon_ml_tpu.data.batch import DenseBatch
 from photon_ml_tpu.game.dataset import RandomEffectDataset
 from photon_ml_tpu.ops.aggregators import GLMObjective
 from photon_ml_tpu.ops.losses import get_loss
-from photon_ml_tpu.optimize.common import solver_x0
+from photon_ml_tpu.optimize.common import (
+    LaneCompactionState,
+    padded_lane_count,
+    solver_x0,
+)
 from photon_ml_tpu.optimize.config import (
     GLMOptimizationConfiguration,
     OptimizerType,
@@ -71,8 +78,20 @@ def _hvp(w, v, payload):
     return obj.hessian_vector(w, v, batch)
 
 
-@partial(jax.jit, static_argnames=("solver", "max_iter", "tolerance"))
-def _fit_blocks(
+# Per-solve telemetry for bench.py's dispatch-vs-compute attribution:
+# ``solve_secs`` is time blocked on chunk dispatch + the one unconverged-mask
+# fetch per chunk, ``compact_secs`` is active-lane gather/re-pack time,
+# ``lane_counts`` the still-active lane count entering each compacted chunk.
+SOLVE_STATS = {"dispatches": 0, "chunks": 0, "solve_secs": 0.0,
+               "compact_secs": 0.0, "lane_counts": []}
+
+
+def reset_solve_stats() -> None:
+    SOLVE_STATS.update({"dispatches": 0, "chunks": 0, "solve_secs": 0.0,
+                        "compact_secs": 0.0, "lane_counts": []})
+
+
+def _fit_blocks_impl(
     X: Array,
     labels: Array,
     offsets: Array,
@@ -83,10 +102,20 @@ def _fit_blocks(
     solver: str,
     max_iter: int,
     tolerance: float,
+    boundary_convergence: bool = False,
 ):
     """vmapped solve over entity blocks; returns (coefs [E,D], iters [E],
     final loss values [E], convergence codes [E] int8 — see
-    CONVERGENCE_CODE_NAMES). ``solver`` is "lbfgs"/"owlqn"/"tron"."""
+    CONVERGENCE_CODE_NAMES). ``solver`` is "lbfgs"/"owlqn"/"tron".
+
+    ``boundary_convergence`` is set by the lane-compaction driver on
+    NON-final chunks: a lane that satisfies a convergence criterion on
+    exactly its last budgeted iteration then reports that criterion
+    instead of MaxIterations, so it leaves the active set with its true
+    reason rather than being re-dispatched from its optimum (where the
+    warm restart would report a spurious ObjectiveNotImproving). The
+    default preserves the host-ordering classification
+    (Optimizer.scala:156-170): max-iterations wins."""
 
     def solve_one(Xe, ye, oe, we, x0):
         batch = DenseBatch(X=Xe, labels=ye, offsets=oe, weights=we)
@@ -116,15 +145,115 @@ def _fit_blocks(
             jnp.abs(final_value - hist.values[jnp.maximum(k - 1, 0)])
             <= tolerance * jnp.abs(hist.values[0]))
         gv = hist.grad_norms[k] <= tolerance * hist.grad_norms[0]
-        code = jnp.where(
-            k >= max_iter, CONV_MAX_ITERATIONS,
-            jnp.where(~progressed, CONV_NOT_PROGRESSED,
-                      jnp.where(fv, CONV_FUNCTION_VALUES,
-                                jnp.where(gv, CONV_GRADIENT,
-                                          CONV_FUNCTION_VALUES))))
+        converged = jnp.where(~progressed, CONV_NOT_PROGRESSED,
+                              jnp.where(fv, CONV_FUNCTION_VALUES,
+                                        jnp.where(gv, CONV_GRADIENT,
+                                                  CONV_FUNCTION_VALUES)))
+        if boundary_convergence:
+            # chunk boundary: an exhausted budget only means MaxIterations
+            # when no criterion fired on the final iteration
+            exhausted = jnp.where(
+                ~progressed, CONV_NOT_PROGRESSED,
+                jnp.where(fv, CONV_FUNCTION_VALUES,
+                          jnp.where(gv, CONV_GRADIENT,
+                                    CONV_MAX_ITERATIONS)))
+        else:
+            exhausted = CONV_MAX_ITERATIONS
+        code = jnp.where(k >= max_iter, exhausted, converged)
         return x, k, final_value, code.astype(jnp.int8)
 
     return jax.vmap(solve_one)(X, labels, offsets, weights, initial)
+
+
+_STATIC = ("solver", "max_iter", "tolerance", "boundary_convergence")
+_fit_blocks = partial(jax.jit, static_argnames=_STATIC)(_fit_blocks_impl)
+# Donating variants, only engaged off-CPU (the CPU runtime can't alias and
+# would warn per call) and only for callers that own the buffers:
+# - offsets (arg 2) is rebuilt per update from the CD score vector, so the
+#   coordinate-update path may always hand its buffer to XLA as scratch;
+# - initial/x0 (arg 4) is donated ONLY by the compacted re-dispatch path,
+#   whose x0 is a gather this module just created. The plain path's x0 can
+#   BE the caller's live array (solver_x0 returns a matching-dtype warm
+#   start unchanged — i.e. coordinate descent's states[cid] last-good
+#   state, which retries/quarantine/checkpointing must still read), so
+#   donating it there would delete state out from under the CD loop.
+_fit_blocks_donate_offsets = partial(
+    jax.jit, static_argnames=_STATIC, donate_argnums=(2,),
+)(_fit_blocks_impl)
+_fit_blocks_donate_offsets_x0 = partial(
+    jax.jit, static_argnames=_STATIC, donate_argnums=(2, 4),
+)(_fit_blocks_impl)
+
+
+def _dispatch_fit(X, labels, offsets, weights, initial, obj, l1, solver,
+                  max_iter, tolerance, donate: bool,
+                  donate_x0: bool = False,
+                  boundary_convergence: bool = False):
+    SOLVE_STATS["dispatches"] += 1
+    fn = _fit_blocks
+    if donate and jax.default_backend() != "cpu":
+        fn = (_fit_blocks_donate_offsets_x0 if donate_x0
+              else _fit_blocks_donate_offsets)
+    return fn(X, labels, offsets, weights, initial, obj, l1, solver,
+              max_iter, tolerance, boundary_convergence)
+
+
+def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
+                          solver, max_iter, tolerance, chunk: int,
+                          donate: bool):
+    """Chunked solve with active-lane compaction (Snap ML-style: don't pay
+    straggler cost for converged subproblems).
+
+    Runs the batched solver ``chunk`` iterations at a time; after each
+    chunk the lanes that converged keep their results and only the
+    still-active lanes are gathered into a dense (power-of-two padded)
+    block and re-dispatched. A bucket where 90% of entities converge in 5
+    iterations then costs ~10% of the lanes for the straggler tail instead
+    of running every lane to the slowest lane's count. Each chunk costs
+    one small device→host fetch (the unconverged mask); chunk-boundary
+    warm restarts re-anchor the solvers' relative tolerances, so
+    coefficients match the single-dispatch solve within tolerance rather
+    than bitwise (see LaneCompactionState)."""
+    state = LaneCompactionState.initial(x0, x0.dtype)
+    idx: Optional[np.ndarray] = None
+    cur = (X, labels, offsets, weights, x0)
+    spent = 0
+    while True:
+        budget = min(chunk, max_iter - spent)
+        t0 = time.perf_counter()
+        # chunk 1 runs the caller's buffers (which later compactions
+        # re-gather from: never donate them); compacted chunks run
+        # gathered copies this loop owns outright, x0 included. Non-final
+        # chunks classify boundary convergence so a lane converging on its
+        # last budgeted iteration leaves with its true reason instead of
+        # a re-dispatch from its optimum.
+        donate_chunk = donate and idx is not None
+        c, it, v, k = _dispatch_fit(*cur, obj, l1, solver, budget,
+                                    tolerance, donate=donate_chunk,
+                                    donate_x0=donate_chunk,
+                                    boundary_convergence=(
+                                        spent + budget < max_iter))
+        still = state.absorb(idx, c, it, v, k, CONV_MAX_ITERATIONS)
+        SOLVE_STATS["solve_secs"] += time.perf_counter() - t0
+        SOLVE_STATS["chunks"] += 1
+        spent += budget
+        if spent >= max_iter or len(still) == 0:
+            break
+        t0 = time.perf_counter()
+        idx = still
+        pad = padded_lane_count(len(still))
+        idx_padded = np.concatenate(
+            [still, np.full(pad - len(still), still[0], np.int32)])
+        g = jax.device_put(idx_padded)
+        cur = (jnp.take(X, g, axis=0), jnp.take(labels, g, axis=0),
+               jnp.take(offsets, g, axis=0), jnp.take(weights, g, axis=0),
+               jnp.take(state.coefs, g, axis=0))
+        SOLVE_STATS["compact_secs"] += time.perf_counter() - t0
+        # bounded telemetry: long training runs append per compaction and
+        # only bench/tests ever reset, so keep a rolling window
+        SOLVE_STATS["lane_counts"] = (
+            SOLVE_STATS["lane_counts"][-63:] + [int(len(still))])
+    return state.results()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,6 +268,11 @@ class RandomEffectOptimizationProblem:
 
     config: GLMOptimizationConfiguration
     task: TaskType
+    # > 0 engages chunked solving with active-lane compaction: the batched
+    # solver runs ``lane_compaction_chunk`` iterations at a time and only
+    # still-unconverged lanes re-dispatch (see _fit_blocks_compacted).
+    # 0 keeps the single-dispatch all-lanes-to-max-lane-count behavior.
+    lane_compaction_chunk: int = 0
 
     def objective(self) -> GLMObjective:
         cfg = self.config
@@ -149,11 +283,27 @@ class RandomEffectOptimizationProblem:
             has_hessian=self.task != TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
         )
 
+    def _fit(self, X, labels, offsets, weights, x0, obj, l1_arr,
+             solver: str, donate: bool):
+        """One entity block through the solver — compacted in iteration
+        chunks when ``lane_compaction_chunk`` engages, one dispatch
+        otherwise."""
+        cfg = self.config
+        chunk = self.lane_compaction_chunk
+        if 0 < chunk < cfg.max_iterations and int(X.shape[0]) > 1:
+            return _fit_blocks_compacted(
+                X, labels, offsets, weights, x0, obj, l1_arr, solver,
+                cfg.max_iterations, float(cfg.tolerance), chunk, donate)
+        return _dispatch_fit(
+            X, labels, offsets, weights, x0, obj, l1_arr, solver,
+            cfg.max_iterations, float(cfg.tolerance), donate)
+
     def run(
         self,
         dataset: RandomEffectDataset,
         offsets: Array,
         initial: Optional[Array] = None,
+        donate: bool = False,
     ) -> tuple[Array, Array, Array, Array]:
         """Fit all entities; returns (coefficients [E, D_red], iterations [E],
         final losses [E], convergence codes [E] — CONVERGENCE_CODE_NAMES).
@@ -181,7 +331,8 @@ class RandomEffectOptimizationProblem:
             solver = "lbfgs"
 
         if dataset.buckets is not None:
-            return self._run_bucketed(dataset, offsets, initial, solver, l1)
+            return self._run_bucketed(dataset, offsets, initial, solver, l1,
+                                      donate)
 
         e, _, d = dataset.X.shape
         acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
@@ -190,16 +341,25 @@ class RandomEffectOptimizationProblem:
         # wider offset vector (e.g. f64 scores) must not poison the
         # jitted solver's carry dtypes
         offsets = jnp.asarray(offsets, acc)
-        return _fit_blocks(
+        return self._fit(
             dataset.X, dataset.labels, offsets, dataset.weights, x0,
-            self.objective(), jnp.full(d, l1, x0.dtype),
-            solver, cfg.max_iterations, float(cfg.tolerance))
+            self.objective(), jnp.full(d, l1, x0.dtype), solver,
+            donate and offsets is not dataset.base_offsets)
 
     def _run_bucketed(self, dataset, offsets, initial, solver: str,
-                      l1: float):
+                      l1: float, donate: bool = False):
         """Per-bucket vmapped solves assembled into one compact global
         block ``[num_entities, reduced_dim]`` (entity order is bucket-major;
-        pad lanes never leave the bucket).
+        pad lanes never leave the bucket). With compaction off (the
+        default) all buckets are DISPATCHED before any result is
+        assembled and no blocking read happens here at all (the trackers
+        fetch lazily, the CD epilogue fetches once); the compact global
+        block is built with one concatenate per output instead of a
+        per-bucket ``.at[].set`` copy chain over a presized zero block.
+        With ``lane_compaction_chunk`` set, each bucket's chunked solve
+        blocks on its small per-chunk unconverged-mask fetches before the
+        next bucket dispatches — compaction trades that serialization for
+        shedding converged lanes.
 
         Compile-cost note: each distinct bucket shape (E_b, N_b, D_b)
         compiles its own ``_fit_blocks`` trace, so the first sweep pays one
@@ -208,46 +368,63 @@ class RandomEffectOptimizationProblem:
         in-process jit cache plus the persistent XLA compile cache
         (utils/compile_cache.py) absorb every later sweep; keep bucket
         counts small (3-4) so the one-time cost stays bounded."""
-        cfg = self.config
-        e_tot, d_red = dataset.num_entities, dataset.reduced_dim
+        d_red = dataset.reduced_dim
         acc = jnp.promote_types(dataset.buckets[0].X.dtype, jnp.float32)
         obj = self.objective()
-        coefs = jnp.zeros((e_tot, d_red), acc)
-        iters = jnp.zeros(e_tot, jnp.int32)
-        values = jnp.zeros(e_tot, acc)
-        codes = jnp.zeros(e_tot, jnp.int8)
+        # solver state policy: blocks are f32, solver state >= f32
+        # (optimize/common.solver_x0); the warm-start conversion is hoisted
+        # out of the bucket loop (it used to re-convert per bucket/sweep)
+        initial_acc = None if initial is None else jnp.asarray(initial, acc)
+        outs = []
         for bucket, off_b in zip(dataset.buckets, offsets):
             e_b, _, d_b = bucket.X.shape
             nr, start = bucket.num_real, bucket.entity_start
-            # solver state policy: blocks are f32, solver state >= f32
-            # (optimize/common.solver_x0); offsets join at the same dtype
             off_b = jnp.asarray(off_b, acc)
-            x0_b = jnp.zeros((e_b, d_b), acc)
-            if initial is not None:
-                x0_b = x0_b.at[:nr].set(
-                    jnp.asarray(initial, acc)[start:start + nr, :d_b])
-            c_b, it_b, v_b, k_b = _fit_blocks(
+            if initial_acc is None:
+                x0_b = jnp.zeros((e_b, d_b), acc)
+            else:
+                # pad rows/columns in one op instead of zeros + .at[].set
+                x0_b = jnp.pad(initial_acc[start:start + nr, :d_b],
+                               ((0, e_b - nr), (0, 0)))
+            outs.append(self._fit(
                 bucket.X, bucket.labels, off_b, bucket.weights, x0_b,
-                obj, jnp.full(d_b, l1, acc),
-                solver, cfg.max_iterations, float(cfg.tolerance))
-            coefs = coefs.at[start:start + nr, :d_b].set(c_b[:nr])
-            iters = iters.at[start:start + nr].set(it_b[:nr])
-            values = values.at[start:start + nr].set(v_b[:nr])
-            codes = codes.at[start:start + nr].set(k_b[:nr])
+                obj, jnp.full(d_b, l1, acc), solver, donate))
+        # bucket-major concatenation IS the global entity order; pad each
+        # bucket's D_b out to the global reduced_dim
+        coefs = jnp.concatenate([
+            jnp.pad(c[:b.num_real],
+                    ((0, 0), (0, d_red - int(c.shape[1])))).astype(acc)
+            for b, (c, _, _, _) in zip(dataset.buckets, outs)])
+        iters = jnp.concatenate([
+            it[:b.num_real]
+            for b, (_, it, _, _) in zip(dataset.buckets, outs)])
+        values = jnp.concatenate([
+            v[:b.num_real].astype(acc)
+            for b, (_, _, v, _) in zip(dataset.buckets, outs)])
+        codes = jnp.concatenate([
+            k[:b.num_real]
+            for b, (_, _, _, k) in zip(dataset.buckets, outs)])
         return coefs, iters, values, codes
 
-    def regularization_value(self, coefs: Array) -> float:
-        """Σ over entities of the per-entity penalty
-        (RandomEffectOptimizationProblem.getRegularizationTermValue)."""
+    def regularization_value_device(self, coefs: Array):
+        """Σ over entities of the per-entity penalty as a device scalar
+        (no host sync — feeds the CD fused epilogue's per-coordinate reg
+        cache). Python ``0.0`` when the config has no penalty."""
         cfg = self.config
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         l2 = cfg.regularization_context.l2_weight(cfg.regularization_weight)
         val = 0.0
         if l1 > 0:
-            val += l1 * float(jnp.sum(jnp.abs(coefs)))
+            val = val + l1 * jnp.sum(jnp.abs(coefs))
         if l2 > 0:
-            val += 0.5 * l2 * float(jnp.sum(coefs * coefs))
+            val = val + 0.5 * l2 * jnp.sum(coefs * coefs)
         return val
+
+    def regularization_value(self, coefs: Array) -> float:
+        """Σ over entities of the per-entity penalty
+        (RandomEffectOptimizationProblem.getRegularizationTermValue)."""
+        val = self.regularization_value_device(coefs)
+        return val if isinstance(val, float) else float(val)
 
 
 @partial(jax.jit, static_argnames=("num_samples",))

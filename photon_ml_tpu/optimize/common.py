@@ -144,6 +144,155 @@ class OptimizationResult:
         )
 
 
+class DeferredOptimizationResult:
+    """:class:`OptimizationResult` facade whose history stays device-resident.
+
+    ``coefficients`` is available immediately as a device array (the CD hot
+    loop threads it straight into the next jitted op with no sync); every
+    scalar field (value/grad_norm/iterations/convergence_reason/...)
+    materializes lazily, with ONE explicit ``jax.device_get`` of the whole
+    history pytree on first touch. This is what makes the fixed-effect
+    coordinate update free of blocking device→host reads: the eager
+    ``OptimizationResult.from_history`` paid an ``int()`` + two
+    ``np.asarray`` syncs per solve before the epilogue even ran.
+    """
+
+    def __init__(self, coefficients: Array, history: RunHistory,
+                 progressed, max_iter: int, tolerance: float):
+        self.coefficients = coefficients
+        self._history = history
+        self._progressed = progressed
+        self._max_iter = max_iter
+        self._tolerance = tolerance
+        self._result: Optional[OptimizationResult] = None
+
+    def _force(self) -> OptimizationResult:
+        if self._result is None:
+            import jax
+
+            from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
+
+            history, progressed = jax.device_get(
+                (self._history, self._progressed))
+            record_host_fetch()
+            self._result = OptimizationResult.from_history(
+                self.coefficients, history,
+                self._max_iter, self._tolerance, bool(progressed))
+            self._history = self._progressed = None
+        return self._result
+
+    @property
+    def value(self) -> float:
+        return self._force().value
+
+    @property
+    def grad_norm(self) -> float:
+        return self._force().grad_norm
+
+    @property
+    def iterations(self) -> int:
+        return self._force().iterations
+
+    @property
+    def convergence_reason(self) -> ConvergenceReason:
+        return self._force().convergence_reason
+
+    @property
+    def values(self) -> np.ndarray:
+        return self._force().values
+
+    @property
+    def grad_norms(self) -> np.ndarray:
+        return self._force().grad_norms
+
+    @property
+    def iterates(self) -> Optional[np.ndarray]:
+        return self._force().iterates
+
+
+@dataclasses.dataclass
+class LaneCompactionState:
+    """Chunk-resumable state for a batched (vmapped) solve over lanes.
+
+    The batched solver runs every lane to the SLOWEST lane's iteration
+    count; when per-lane convergence is heterogeneous (90% of entities done
+    in 5 iterations, a few stragglers needing 50) that is almost all wasted
+    FLOPs. The compacted driver instead solves in iteration chunks: after
+    each chunk the still-active lanes are gathered into a dense block and
+    only those re-dispatch. This object owns the global result buffers
+    (device-resident) and the host-side active-lane bookkeeping between
+    chunks; ``absorb`` folds one chunk's output back in and reports which
+    lanes remain.
+
+    Warm restarts re-anchor the solvers' relative convergence thresholds
+    (|Δf| ≤ tol·|f₀|, ‖g‖ ≤ tol·‖g₀‖) at each chunk's start point, so
+    iteration trajectories are not bit-identical to the single-dispatch
+    solve — coefficients agree within solver tolerance (the parity test's
+    contract), and any run is deterministic for fixed inputs and chunking.
+    """
+
+    coefs: Array  # [E, D] device
+    iterations: Array  # [E] int32 device (accumulated across chunks)
+    values: Array  # [E] device (last chunk's final value per lane)
+    codes: Array  # [E] int8 device (last chunk's convergence code)
+    active: np.ndarray  # host int32 global lane ids still unconverged
+
+    @staticmethod
+    def initial(x0: Array, value_dtype) -> "LaneCompactionState":
+        e = int(x0.shape[0])
+        return LaneCompactionState(
+            coefs=x0,
+            iterations=jnp.zeros(e, jnp.int32),
+            values=jnp.zeros(e, value_dtype),
+            codes=jnp.zeros(e, jnp.int8),
+            active=np.arange(e, dtype=np.int32),
+        )
+
+    def absorb(self, idx, c: Array, it: Array, v: Array, k: Array,
+               max_iterations_code: int) -> np.ndarray:
+        """Fold one chunk's output (lane-compacted when ``idx`` is not
+        None) into the global buffers; returns the global ids of lanes the
+        chunk did NOT converge (they hit the chunk's iteration budget).
+        The unconverged mask is the ONE blocking device→host fetch of the
+        chunk — everything else stays on device."""
+        import jax
+
+        from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
+
+        if idx is None:  # first chunk: all lanes ran, in global order
+            self.coefs, self.values, self.codes = c, v, k
+            self.iterations = it
+            unconverged = np.asarray(
+                jax.device_get(k == max_iterations_code))
+            record_host_fetch()
+            return self.active[unconverged]
+        n_real = len(idx)
+        idx_dev = jax.device_put(idx)
+        self.coefs = self.coefs.at[idx_dev].set(c[:n_real])
+        self.iterations = self.iterations.at[idx_dev].add(it[:n_real])
+        self.values = self.values.at[idx_dev].set(v[:n_real])
+        self.codes = self.codes.at[idx_dev].set(k[:n_real])
+        unconverged = np.asarray(
+            jax.device_get(k[:n_real] == max_iterations_code))
+        record_host_fetch()
+        return idx[unconverged]
+
+    def results(self) -> tuple[Array, Array, Array, Array]:
+        return self.coefs, self.iterations, self.values, self.codes
+
+
+def padded_lane_count(n: int, floor: int = 8) -> int:
+    """Round an active-lane count up to a power of two (≥ ``floor``) so
+    re-dispatched chunk shapes repeat and the jit cache absorbs them —
+    without padding, every distinct straggler count would compile a fresh
+    solver executable."""
+    n = max(int(n), 1)
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
 def _convergence_reason(
     k: int,
     values: np.ndarray,

@@ -36,6 +36,7 @@ from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.optimize.common import (
     BoxConstraints,
+    DeferredOptimizationResult,
     OptimizationResult,
     solver_x0,
 )
@@ -197,16 +198,48 @@ class GLMOptimizationProblem:
                 self.task)
         return model, result
 
-    def regularization_value(self, coef_normalized: Array) -> float:
-        """lambda-weighted penalty of a (normalized-space) coefficient vector,
-        used by coordinate descent's global objective
-        (GeneralizedLinearOptimizationProblem.getRegularizationTermValue)."""
+    def run_lazy(self, batch: Batch, initial: Optional[Array] = None):
+        """Like :meth:`run` but device-resident: returns only a result whose
+        ``coefficients`` is an on-device array and whose history/scalars
+        materialize lazily (:class:`DeferredOptimizationResult`) — no
+        blocking device→host read happens here. The CD hot loop uses this
+        so a fixed-effect update contributes zero syncs outside the fused
+        epilogue fetch. The multi-device shard_map path keeps its eager
+        result (its collectives already fence)."""
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, get_default_mesh
+        from photon_ml_tpu.utils.faults import fault_point
+
+        mesh = get_default_mesh()
+        if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+            _, result = self.run(batch, initial=initial)
+            return result
+        dim = batch.num_features
+        x0 = solver_x0(batch.acc_dtype, dim, initial)
+        obj = self.objective()
+        x, history, progressed = self.solve(obj, batch, x0)
+        x = fault_point("optimizer.gradient", arrays=x)
+        cfg = self.config
+        return DeferredOptimizationResult(
+            x, history, progressed, cfg.max_iterations, cfg.tolerance)
+
+    def regularization_value_device(self, coef_normalized: Array):
+        """lambda-weighted penalty as a device scalar (no host sync) —
+        the CD fused epilogue keeps a per-coordinate cache of these and
+        sums them on device. Returns the Python float ``0.0`` when the
+        config has no penalty, so unregularized configs stay op-free."""
         cfg = self.config
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         l2 = cfg.regularization_context.l2_weight(cfg.regularization_weight)
         val = 0.0
         if l1 > 0:
-            val += l1 * float(jnp.sum(jnp.abs(coef_normalized)))
+            val = val + l1 * jnp.sum(jnp.abs(coef_normalized))
         if l2 > 0:
-            val += 0.5 * l2 * float(jnp.dot(coef_normalized, coef_normalized))
+            val = val + 0.5 * l2 * jnp.dot(coef_normalized, coef_normalized)
         return val
+
+    def regularization_value(self, coef_normalized: Array) -> float:
+        """lambda-weighted penalty of a (normalized-space) coefficient vector,
+        used by coordinate descent's global objective
+        (GeneralizedLinearOptimizationProblem.getRegularizationTermValue)."""
+        val = self.regularization_value_device(coef_normalized)
+        return val if isinstance(val, float) else float(val)

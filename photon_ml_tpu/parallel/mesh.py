@@ -139,14 +139,23 @@ def _replicator(mesh: Mesh):
     return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
 
 
-def host_array(x) -> np.ndarray:
-    """``np.asarray`` that also handles NON-fully-addressable global
-    arrays (multi-controller runs): such an array is first replicated over
-    its own mesh, after which every process holds the full value. The
-    host-side trackers (per-entity iteration/convergence counts) use this
-    so the same coordinate code runs single-chip, multi-chip, and
-    multi-host. The replicating jit is cached per mesh so repeated calls
-    don't re-trace."""
+def ensure_addressable(x):
+    """Make a device array fully addressable from this process (replicating
+    NON-fully-addressable global arrays over their own mesh) WITHOUT
+    fetching it to host. Callers that batch several arrays into one
+    ``jax.device_get`` (the lazy trackers' single-fetch materialization)
+    route each through here first so the same code runs single-chip,
+    multi-chip, and multi-host. The replicating jit is cached per mesh so
+    repeated calls don't re-trace."""
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         x = _replicator(x.sharding.mesh)(x)
-    return np.asarray(x)
+    return x
+
+
+def host_array(x) -> np.ndarray:
+    """``np.asarray`` that also handles NON-fully-addressable global
+    arrays (multi-controller runs) via :func:`ensure_addressable`. The
+    host-side trackers (per-entity iteration/convergence counts) use this
+    so the same coordinate code runs single-chip, multi-chip, and
+    multi-host."""
+    return np.asarray(ensure_addressable(x))

@@ -21,6 +21,15 @@ fires on the step about to be read.
 
 API mirrors an orbax CheckpointManager (save/restore/latest_step/all_steps)
 without taking the dependency for plain-array states.
+
+Sync discipline: this module is framework-free (numpy only) and
+``save()`` host-serializes whatever leaves it is given — a device-array
+leaf would be fetched implicitly, one blocking transfer per leaf. Hot-path
+callers therefore pre-fetch the WHOLE snapshot with one explicit
+``jax.device_get`` of the payload pytree before calling ``save()`` (see
+``run_coordinate_descent.save_snapshot``): the checkpoint is the single
+designated fetch point for device state, and the one-round-trip hot-loop
+contract (game/coordinate_descent.py) stays intact between snapshots.
 """
 
 from __future__ import annotations

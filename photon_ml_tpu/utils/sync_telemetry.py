@@ -1,0 +1,33 @@
+"""Process-wide counter of explicit device→host fetch sites.
+
+Every INTENTIONAL blocking device→host read in the training path — the CD
+fused-epilogue fetch, a lazy tracker/optimizer-history materialization,
+the lane-compaction unconverged-mask fetch, a checkpoint snapshot's
+payload fetch — calls :func:`record_host_fetch` next to its
+``jax.device_get``. bench.py divides the count over a warm run by the
+number of coordinate updates to report ``host_syncs_per_update``: 1.0
+means the one-round-trip contract held, and a lazy-materialization
+regression (e.g. a tracker forced inside the hot loop) shows up as > 1.0
+in the very next BENCH record.
+
+This counts the *instrumented* sites only. A raw ``float()``/
+``np.asarray`` sneaked into the hot loop is invisible here by
+construction — catching those is the transfer-guard test's job
+(tests/test_sync_discipline.py).
+"""
+
+from __future__ import annotations
+
+HOST_FETCHES = {"count": 0}
+
+
+def record_host_fetch(n: int = 1) -> None:
+    HOST_FETCHES["count"] += n
+
+
+def reset_host_fetches() -> None:
+    HOST_FETCHES["count"] = 0
+
+
+def host_fetch_count() -> int:
+    return HOST_FETCHES["count"]
