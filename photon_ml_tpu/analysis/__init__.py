@@ -1,0 +1,35 @@
+"""photonlint: AST-based invariant checking for the TPU training stack.
+
+The runtime can only spot-check this package's hard invariants where a
+test happens to tread — the transfer-guard test enforces the
+one-fetch-per-update contract on the paths it executes, bit-exact resume
+dies silently if nondeterminism leaks into a jitted region, and the
+README ``PHOTON_FAULTS`` table drifts from the actual ``fault_point()``
+sites without anything noticing. These are *structural* properties of
+the source (DrJAX frames the whole stack as program transformations), so
+this subpackage checks them statically over the entire tree:
+
+- **W1xx sync discipline** — blocking device→host conversions
+  (``float``/``int``/``bool``/``.item()``/``np.asarray``/
+  ``jax.device_get``) applied to jax-array-producing expressions outside
+  the instrumented fetch sites (``utils/sync_telemetry.py`` discipline).
+- **W2xx jit purity / retrace hazards** — impure calls (time, random,
+  I/O, logging) and Python branching on traced values inside
+  ``jax.jit``/``pjit``-ed functions and package-local functions
+  reachable from them.
+- **W3xx donation safety** — an argument passed at a ``donate_argnums``
+  call site must not be read again afterwards in the same function.
+- **W4xx fault-point drift** — ``fault_point("name")`` sites and the
+  README ``PHOTON_FAULTS`` table must agree in both directions.
+- **W5xx checkpoint-schema drift** — snapshot fields written at
+  ``CheckpointManager.save`` sites must match the fields read back on
+  the restore/resume paths.
+
+Entry points: :func:`photon_ml_tpu.analysis.runner.lint` (library) and
+``tools/photonlint.py`` (CLI). Per-line suppressions use
+``# photonlint: allow-<rule>(reason)`` and a committed baseline file
+grandfathers known findings (see README "Static analysis").
+"""
+
+from photon_ml_tpu.analysis.core import Finding, LintReport  # noqa: F401
+from photon_ml_tpu.analysis.runner import lint  # noqa: F401

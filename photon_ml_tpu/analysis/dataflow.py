@@ -1,0 +1,435 @@
+"""Lightweight jax-value dataflow over one module's AST.
+
+A tiny abstract interpreter tracks, per scope and in program order,
+which names (may) hold jax arrays. The abstraction has four tags:
+
+- ``JAX``    — a jax array, or a pytree/container of them (both sync on
+  a host conversion, so the lint treats them alike);
+- ``HOST``   — definitely host data (numpy / result of
+  ``jax.device_get``) — conversions on these are free;
+- ``JAXFN``  — a traced callable (``jax.jit(f)``, ``jax.vmap(f)``, a
+  known jax-returning package function passed through ``partial``):
+  *calling* it yields ``JAX``;
+- ``JITWRAP``— a jit decorator factory (``partial(jax.jit, ...)``):
+  calling it yields ``JAXFN``.
+
+Unknown stays ``None`` and every rule treats unknown as clean — the
+tracker is deliberately biased toward precision over recall (a finding
+should mean something; the dynamic transfer-guard test remains the
+recall backstop for what the dataflow cannot see).
+
+Branches merge with may-semantics (``JAX`` wins), loops run their body
+twice to pick up loop-carried values, and nested ``def``/``lambda``
+bodies are analyzed at their definition point with a copy of the
+enclosing environment as closure — call-time environments may differ,
+which is an accepted approximation for lint purposes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
+
+JAX = "jax"
+HOST = "host"
+JAXFN = "jaxfn"
+JITWRAP = "jitwrap"
+# Literal tuples/lists keep per-element tags — ("tuple", (tag, ...)) —
+# so unpacking `a, b = (host_thing, jax_thing)` doesn't smear JAX onto
+# both targets. Any other JAX-containing container collapses to JAX.
+
+
+def is_jax(tag) -> bool:
+    """True when a tag means 'jax array or a container holding one'."""
+    if tag == JAX:
+        return True
+    if isinstance(tag, tuple) and tag and tag[0] == "tuple":
+        return any(is_jax(t) for t in tag[1])
+    return False
+
+
+def _elt_tags(tag):
+    """Per-element tags when unpacking ``tag``, or None when unknown
+    arity (plain JAX unpacks to JAX elements)."""
+    if isinstance(tag, tuple) and tag and tag[0] == "tuple":
+        return tag[1]
+    return None
+
+# External call targets that produce jax values.
+JAX_VALUE_PREFIXES = (
+    "jax.numpy.", "jax.nn.", "jax.lax.", "jax.ops.", "jax.random.",
+    "jax.scipy.", "jax.tree.",
+)
+JAX_VALUE_EXACT = {"jax.device_put", "jax.numpy", "jax.make_array_from_callback"}
+# Calls that produce traced callables.
+JAXFN_MAKERS = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "jax.vmap",
+    "jax.pmap", "jax.grad", "jax.value_and_grad", "jax.jacfwd",
+    "jax.jacrev", "jax.hessian", "jax.checkpoint", "jax.remat",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+}
+HOST_PRODUCERS = {"jax.device_get"}
+# jax-array attributes that are themselves jax-valued; anything else
+# (.shape, .dtype, .ndim, ...) drops the tag.
+JAX_ATTRS = {"T", "mT", "at", "real", "imag"}
+# jax-array methods whose result is host data, not another array.
+HOST_METHODS = {"item", "tolist"}
+
+
+@dataclasses.dataclass
+class Dataflow:
+    """Result of interpreting one module: a tag for every expression
+    node (keyed by ``id(node)``) and the return-value tags of every
+    function body encountered."""
+
+    tags: dict[int, Optional[str]]
+    fn_returns: dict[int, list[Optional[str]]]  # id(fdef) -> return tags
+
+    def tag(self, node: ast.AST) -> Optional[str]:
+        return self.tags.get(id(node))
+
+
+def analyze_module(mod: ModuleInfo, index: PackageIndex,
+                   jit_param_tags: Optional[dict[int, dict[str, str]]]
+                   = None) -> Dataflow:
+    """Interpret a whole module: top-level statements in order, then
+    every ``def`` (at its definition point, with the enclosing env as
+    closure). ``jit_param_tags`` maps ``id(FunctionDef)`` to initial
+    parameter tags (the runner marks non-static params of jitted
+    functions as ``JAX``)."""
+    interp = _Interp(mod, index, jit_param_tags or {})
+    interp.run_block(mod.tree.body, env={})
+    return Dataflow(tags=interp.tags, fn_returns=interp.fn_returns)
+
+
+class _Interp:
+    def __init__(self, mod: ModuleInfo, index: PackageIndex,
+                 jit_param_tags: dict[int, dict[str, str]]):
+        self.mod = mod
+        self.index = index
+        self.jit_param_tags = jit_param_tags
+        self.tags: dict[int, Optional[str]] = {}
+        self.fn_returns: dict[int, list[Optional[str]]] = {}
+        self._ret_stack: list[list[Optional[str]]] = []
+
+    # -- statements --------------------------------------------------------
+
+    def run_block(self, body, env: dict) -> dict:
+        for stmt in body:
+            env = self.stmt(stmt, env)
+        return env
+
+    def stmt(self, s: ast.stmt, env: dict) -> dict:
+        if isinstance(s, ast.Assign):
+            t = self.expr(s.value, env)
+            for tgt in s.targets:
+                self.bind(tgt, t, env)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.bind(s.target, self.expr(s.value, env), env)
+        elif isinstance(s, ast.AugAssign):
+            t = self.expr(s.value, env)
+            if isinstance(s.target, ast.Name):
+                cur = env.get(s.target.id)
+                env[s.target.id] = JAX if (is_jax(t) or is_jax(cur)) \
+                    else cur
+        elif isinstance(s, ast.Return):
+            t = self.expr(s.value, env) if s.value is not None else None
+            if self._ret_stack:
+                self._ret_stack[-1].append(t)
+        elif isinstance(s, ast.Expr):
+            self.expr(s.value, env)
+        elif isinstance(s, ast.If):
+            self.expr(s.test, env)
+            env_a = self.run_block(s.body, dict(env))
+            env_b = self.run_block(s.orelse, dict(env))
+            env = _merge(env_a, env_b)
+        elif isinstance(s, ast.For):
+            it = self.expr(s.iter, env)
+            self.bind(s.target, JAX if is_jax(it) else None, env)
+            for _ in range(2):  # pick up loop-carried tags
+                env = _merge(env, self.run_block(s.body, dict(env)))
+            env = self.run_block(s.orelse, env)
+        elif isinstance(s, ast.While):
+            self.expr(s.test, env)
+            for _ in range(2):
+                env = _merge(env, self.run_block(s.body, dict(env)))
+            env = self.run_block(s.orelse, env)
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                t = self.expr(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, t, env)
+            env = self.run_block(s.body, env)
+        elif isinstance(s, ast.Try):
+            env = self.run_block(s.body, env)
+            base = dict(env)
+            for h in s.handlers:
+                env = _merge(env, self.run_block(h.body, dict(base)))
+            env = self.run_block(s.orelse, env)
+            env = self.run_block(s.finalbody, env)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._enter_function(s, env)
+            env[s.name] = self._def_tag(s)
+        elif isinstance(s, ast.ClassDef):
+            for sub in s.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    self._enter_function(sub, dict(env))
+            env[s.name] = None
+        elif isinstance(s, (ast.Raise, ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.expr(child, env)
+        # Import/Global/Pass/Break/Continue: nothing to track
+        return env
+
+    def _def_tag(self, fdef) -> Optional[str]:
+        from photon_ml_tpu.analysis.package import jit_wrapping_call
+        for dec in fdef.decorator_list:
+            d = self.mod.resolve(dec)
+            if d in JAXFN_MAKERS or jit_wrapping_call(self.mod, dec) \
+                    is not None:
+                return JAXFN
+        dotted = f"{self.mod.module_name}.{fdef.name}"
+        return JAXFN if dotted in self.index.jax_fns else None
+
+    def _enter_function(self, fdef, closure_env: dict) -> None:
+        env = dict(closure_env)
+        a = fdef.args
+        params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if a.kwarg:
+            params.append(a.kwarg.arg)
+        for p in params:
+            env.pop(p, None)
+        for p, tag in self.jit_param_tags.get(id(fdef), {}).items():
+            env[p] = tag
+        for d in fdef.args.defaults + fdef.args.kw_defaults:
+            if d is not None:
+                self.expr(d, closure_env)
+        self._ret_stack.append([])
+        self.run_block(fdef.body, env)
+        self.fn_returns[id(fdef)] = self._ret_stack.pop()
+
+    def bind(self, target, tag, env: dict) -> None:
+        if isinstance(target, ast.Name):
+            if tag is None:
+                env.pop(target.id, None)
+            else:
+                env[target.id] = tag
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = _elt_tags(tag)
+            if elts is not None and len(elts) == len(target.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in target.elts):
+                for elt, t in zip(target.elts, elts):
+                    self.bind(elt, t, env)
+            else:
+                # unpacking a jax pytree/array yields jax elements
+                for elt in target.elts:
+                    self.bind(elt, JAX if is_jax(tag) else None, env)
+        elif isinstance(target, ast.Starred):
+            self.bind(target.value, tag, env)
+        # attribute/subscript stores: no tracking
+
+    # -- expressions -------------------------------------------------------
+
+    def expr(self, e: Optional[ast.expr], env: dict) -> Optional[str]:
+        if e is None:
+            return None
+        tag = self._expr_inner(e, env)
+        self.tags[id(e)] = tag
+        return tag
+
+    def _expr_inner(self, e: ast.expr, env: dict) -> Optional[str]:
+        if isinstance(e, ast.Name):
+            return env.get(e.id)
+        if isinstance(e, ast.Call):
+            return self._call(e, env)
+        if isinstance(e, ast.Attribute):
+            base = self.expr(e.value, env)
+            if is_jax(base) and e.attr in JAX_ATTRS:
+                return JAX
+            return None
+        if isinstance(e, ast.BinOp):
+            tags = (self.expr(e.left, env), self.expr(e.right, env))
+            return JAX if any(is_jax(t) for t in tags) else None
+        if isinstance(e, ast.UnaryOp):
+            return self.expr(e.operand, env)
+        if isinstance(e, ast.Compare):
+            tags = [self.expr(e.left, env)]
+            tags.extend(self.expr(c, env) for c in e.comparators)
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in e.ops):
+                return None  # identity/membership checks are host bools
+            return JAX if any(is_jax(t) for t in tags) else None
+        if isinstance(e, ast.BoolOp):
+            tags = [self.expr(v, env) for v in e.values]
+            return JAX if any(is_jax(t) for t in tags) else None
+        if isinstance(e, ast.IfExp):
+            self.expr(e.test, env)
+            tags = (self.expr(e.body, env), self.expr(e.orelse, env))
+            return JAX if any(is_jax(t) for t in tags) else None
+        if isinstance(e, ast.Subscript):
+            t = self.expr(e.value, env)
+            self.expr(e.slice, env)
+            elts = _elt_tags(t)
+            if elts is not None and isinstance(e.slice, ast.Constant) \
+                    and isinstance(e.slice.value, int) \
+                    and -len(elts) <= e.slice.value < len(elts):
+                return elts[e.slice.value]
+            return JAX if is_jax(t) else None
+        if isinstance(e, (ast.Tuple, ast.List)) and not any(
+                isinstance(v, ast.Starred) for v in e.elts):
+            tags = tuple(self.expr(v, env) for v in e.elts)
+            return ("tuple", tags) if any(t is not None for t in tags) \
+                else None
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            tags = [self.expr(v, env) for v in e.elts]
+            return JAX if any(is_jax(t) for t in tags) else None
+        if isinstance(e, ast.Dict):
+            tags = set()
+            for k in e.keys:
+                if k is not None:
+                    self.expr(k, env)
+            tags.update(self.expr(v, env) for v in e.values)
+            return JAX if any(is_jax(t) for t in tags) else None
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            return self._comprehension(e, env)
+        if isinstance(e, ast.Starred):
+            return self.expr(e.value, env)
+        if isinstance(e, ast.JoinedStr):
+            for v in e.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.expr(v.value, env)
+            return None
+        if isinstance(e, ast.FormattedValue):
+            self.expr(e.value, env)
+            return None
+        if isinstance(e, ast.Lambda):
+            inner = dict(env)
+            a = e.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                inner.pop(p.arg, None)
+            self.expr(e.body, inner)
+            return None
+        if isinstance(e, ast.NamedExpr):
+            t = self.expr(e.value, env)
+            self.bind(e.target, t, env)
+            return t
+        if isinstance(e, (ast.Await, ast.YieldFrom)):
+            return self.expr(e.value, env)
+        if isinstance(e, ast.Yield):
+            if e.value is not None:
+                self.expr(e.value, env)
+            return None
+        return None  # Constant, Slice handled via Subscript, etc.
+
+    def _comprehension(self, e, env: dict) -> Optional[str]:
+        inner = dict(env)
+        elt_jax = False
+        for gen in e.generators:
+            it = self.expr(gen.iter, inner)
+            self.bind(gen.target, JAX if is_jax(it) else None, inner)
+            for cond in gen.ifs:
+                self.expr(cond, inner)
+        if isinstance(e, ast.DictComp):
+            self.expr(e.key, inner)
+            elt_jax = is_jax(self.expr(e.value, inner))
+        else:
+            elt_jax = is_jax(self.expr(e.elt, inner))
+        return JAX if elt_jax else None
+
+    def _call(self, e: ast.Call, env: dict) -> Optional[str]:
+        func_tag = self.expr(e.func, env)
+        arg_tags = [self.expr(a, env) for a in e.args]
+        for kw in e.keywords:
+            self.expr(kw.value, env)
+        d = self.mod.resolve(e.func)
+        if d is not None:
+            if d in HOST_PRODUCERS:
+                return HOST
+            if d in JAX_VALUE_EXACT or any(
+                    d.startswith(p) for p in JAX_VALUE_PREFIXES):
+                return JAX
+            if d in JAXFN_MAKERS:
+                return JAXFN
+            if d in self.index.jax_fns:
+                return JAX
+            if d == "functools.partial" and e.args:
+                inner = self.mod.resolve(e.args[0])
+                if inner in JIT_WRAP_TARGETS:
+                    return JITWRAP
+                if inner in JAXFN_MAKERS:
+                    return JITWRAP
+                if inner is not None and (
+                        inner in self.index.jax_fns or any(
+                            inner.startswith(p)
+                            for p in JAX_VALUE_PREFIXES)):
+                    return JAXFN
+                if arg_tags and arg_tags[0] in (JAXFN,):
+                    return JAXFN
+                return None
+            if d.startswith("numpy."):
+                return HOST
+            if d in ("float", "int", "bool", "str", "len"):
+                return HOST
+            if d in ("tuple", "list", "dict", "set", "sorted", "zip"):
+                return JAX if any(is_jax(t) for t in arg_tags) else None
+        # method call on a jax value: x.sum() is jax, x.item() is host
+        if isinstance(e.func, ast.Attribute):
+            base = self.tags.get(id(e.func.value))
+            if is_jax(base):
+                return HOST if e.func.attr in HOST_METHODS else JAX
+        if func_tag == JAXFN:
+            return JAX
+        if func_tag == JITWRAP:
+            return JAXFN
+        return None
+
+
+JIT_WRAP_TARGETS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+def _merge(a: dict, b: dict) -> dict:
+    """May-union of two branch environments: JAX dominates, a name bound
+    in either branch stays bound."""
+    out = dict(a)
+    for k, v in b.items():
+        cur = out.get(k)
+        if cur == v or cur is None:
+            out[k] = v
+        elif is_jax(v) or is_jax(cur):
+            out[k] = JAX
+    return out
+
+
+def infer_jax_functions(index: PackageIndex, max_rounds: int = 3) -> None:
+    """Fixpoint: a top-level package function whose (any) return value
+    tags JAX is itself jax-returning — so ``float(metrics.peak_f1(...))``
+    is visible as a sync even though ``peak_f1`` lives in another
+    module. Converges in a round or two on this package; bounded for
+    safety."""
+    for _ in range(max_rounds):
+        grew = False
+        for mod in index.modules:
+            flow = analyze_module(mod, index)
+            for name, node in mod.toplevel_defs.items():
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                dotted = f"{mod.module_name}.{name}"
+                if dotted in index.jax_fns:
+                    continue
+                if any(is_jax(t)
+                       for t in flow.fn_returns.get(id(node), [])):
+                    index.jax_fns.add(dotted)
+                    grew = True
+        if not grew:
+            return

@@ -1,0 +1,315 @@
+"""Module loading and whole-package name resolution for photonlint.
+
+Everything here is syntactic: modules are parsed with the stdlib ``ast``
+(never imported — linting must not execute package code or require jax),
+and names are resolved through each module's import aliases. Resolution
+returns *dotted* names (``jax.numpy.where``,
+``photon_ml_tpu.evaluation.metrics.peak_f1``) that the rule modules
+classify; a name that cannot be resolved resolves to ``None`` and every
+downstream consumer treats unknown as "not mine" (lint stays precise
+rather than noisy).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding, parse_suppressions
+
+PACKAGE_PREFIX = "photon_ml_tpu."
+
+# jax.jit-alikes whose call wraps a function for tracing.
+JIT_WRAPPERS = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.experimental.pjit", "pjit",
+}
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed source file plus its resolution tables."""
+
+    path: Path
+    relpath: str  # posix, relative to the lint root
+    module_name: str  # dotted guess from relpath ("tools.photonlint")
+    source: str
+    lines: list[str]
+    tree: ast.Module
+    imports: dict[str, str]  # local alias -> dotted target
+    toplevel_defs: dict[str, ast.AST]  # name -> FunctionDef/ClassDef
+    constants: dict[str, ast.expr]  # name -> module-level literal expr
+    suppressions: dict[int, list[tuple[str, str]]]
+    malformed: list[Finding]
+
+    @classmethod
+    def load(cls, path: Path, root: Path) -> "ModuleInfo":
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+        relpath = path.relative_to(root).as_posix()
+        module_name = relpath[:-3].replace("/", ".") \
+            if relpath.endswith(".py") else relpath.replace("/", ".")
+        if module_name.endswith(".__init__"):
+            module_name = module_name[: -len(".__init__")]
+        imports = _collect_imports(tree, module_name)
+        toplevel_defs = {}
+        constants = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                toplevel_defs[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                constants[node.targets[0].id] = node.value
+        lines = source.splitlines()
+        suppressions, malformed = parse_suppressions(lines, relpath)
+        return cls(path=path, relpath=relpath, module_name=module_name,
+                   source=source, lines=lines, tree=tree, imports=imports,
+                   toplevel_defs=toplevel_defs, constants=constants,
+                   suppressions=suppressions, malformed=malformed)
+
+    # -- name resolution ---------------------------------------------------
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name for a Name/Attribute chain, through import aliases.
+
+        A module-local top-level def resolves to
+        ``<module_name>.<name>`` so cross-module call edges line up with
+        the other side's definition index.
+        """
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.imports.get(node.id)
+        if base is None:
+            if node.id in self.toplevel_defs or node.id in self.constants:
+                base = f"{self.module_name}.{node.id}"
+            else:
+                return None
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def literal(self, node: ast.AST):
+        """Best-effort literal value: direct literal or a one-hop
+        module-level constant (``static_argnames=_STATIC``). Returns
+        ``None`` when unresolvable."""
+        if isinstance(node, ast.Name) and node.id in self.constants:
+            node = self.constants[node.id]
+        try:
+            return ast.literal_eval(node)
+        except (ValueError, TypeError, SyntaxError):
+            return None
+
+
+def _collect_imports(tree: ast.Module, module_name: str) -> dict[str, str]:
+    imports: dict[str, str] = {}
+    pkg_parts = module_name.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                imports[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: anchor to this package
+                anchor = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(anchor + ([node.module] if node.module
+                                          else []))
+            else:
+                base = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                imports[local] = f"{base}.{alias.name}" if base \
+                    else alias.name
+    return imports
+
+
+# -- jit bindings ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JitBinding:
+    """One traced entry point: the impl function plus its jit options."""
+
+    impl: str  # dotted name of the traced python function
+    fdef: Optional[ast.AST]  # its FunctionDef when module-local
+    mod: ModuleInfo
+    static_names: Optional[set[str]]  # None = could not resolve statics
+    donate_idx: set[int]
+    bound_name: Optional[str]  # module-level name the wrapper is bound to
+
+
+def _jit_options(mod: ModuleInfo, call: ast.Call,
+                 fdef: Optional[ast.AST]) -> tuple[Optional[set[str]],
+                                                   set[int]]:
+    """Extract (static param names, donated arg indices) from a
+    jax.jit/pjit call's keywords. Unresolvable statics → None (the
+    dataflow then treats NO param as a tracer, biasing away from false
+    positives)."""
+    static_names: set[str] = set()
+    donate_idx: set[int] = set()
+    unknown = False
+    params = []
+    if fdef is not None:
+        a = fdef.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums",
+                      "donate_argnums", "donate_argnames"):
+            value = mod.literal(kw.value)
+            if value is None:
+                unknown = True
+                continue
+            if isinstance(value, (str, int)):
+                value = (value,)
+            if kw.arg == "static_argnames":
+                static_names.update(value)
+            elif kw.arg == "static_argnums":
+                if params:
+                    static_names.update(
+                        params[i] for i in value if i < len(params))
+                else:
+                    unknown = True
+            elif kw.arg == "donate_argnums":
+                donate_idx.update(int(i) for i in value)
+            elif kw.arg == "donate_argnames":
+                if params:
+                    donate_idx.update(
+                        params.index(n) for n in value if n in params)
+    return (None if unknown else static_names), donate_idx
+
+
+def jit_wrapping_call(mod: ModuleInfo, node: ast.AST) -> Optional[ast.Call]:
+    """Return the jax.jit/pjit Call carrying the options when ``node``
+    is a jit-wrapping expression, else None. Recognized shapes::
+
+        jax.jit                       (bare decorator)
+        jax.jit(f, ...) / pjit(f)     (direct wrap)
+        partial(jax.jit, ...)         (decorator factory)
+        partial(jax.jit, ...)(f)      (module-level binding)
+    """
+    if isinstance(node, ast.Call):
+        d = mod.resolve(node.func)
+        if d in JIT_WRAPPERS:
+            return node
+        if d == "functools.partial" and node.args:
+            inner = mod.resolve(node.args[0])
+            if inner in JIT_WRAPPERS:
+                return node
+        # partial(jax.jit, ...)(impl): options live on the inner call
+        if isinstance(node.func, ast.Call):
+            return jit_wrapping_call(mod, node.func)
+    return None
+
+
+def find_jit_bindings(mod: ModuleInfo) -> list[JitBinding]:
+    """All traced entry points defined in one module: decorated defs and
+    module-level ``name = jax.jit(...)/partial(jax.jit, ...)(impl)``."""
+    out: list[JitBinding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                is_bare = mod.resolve(dec) in JIT_WRAPPERS
+                call = None if is_bare else jit_wrapping_call(mod, dec)
+                if is_bare or call is not None:
+                    static, donate = (set(), set()) if is_bare else \
+                        _jit_options(mod, call, node)
+                    out.append(JitBinding(
+                        impl=f"{mod.module_name}.{node.name}",
+                        fdef=node, mod=mod, static_names=static,
+                        donate_idx=donate, bound_name=node.name))
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        wrap = jit_wrapping_call(mod, call)
+        if wrap is None:
+            continue
+        # the traced impl is the wrapped callable: jax.jit(IMPL, ...) or
+        # partial(jax.jit, ...)(IMPL)
+        impl_node = None
+        if call is wrap and call.args:  # jax.jit(impl, ...)
+            d = mod.resolve(call.args[0])
+            if d != "functools.partial":
+                impl_node = call.args[0]
+        elif call.args:  # partial(jax.jit, ...)(impl)
+            impl_node = call.args[0]
+        impl = mod.resolve(impl_node) if impl_node is not None else None
+        if impl is None:
+            continue
+        fdef = None
+        local = impl.rsplit(".", 1)[-1]
+        if impl == f"{mod.module_name}.{local}":
+            cand = mod.toplevel_defs.get(local)
+            if isinstance(cand, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fdef = cand
+        static, donate = _jit_options(mod, wrap, fdef)
+        out.append(JitBinding(
+            impl=impl, fdef=fdef, mod=mod, static_names=static,
+            donate_idx=donate, bound_name=node.targets[0].id))
+    return out
+
+
+# -- package index ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PackageIndex:
+    """Cross-module facts the rules share."""
+
+    modules: list[ModuleInfo]
+    functions: dict[str, tuple[ModuleInfo, ast.AST]]  # top-level defs
+    jit_bindings: list[JitBinding]
+    jax_fns: set[str]  # dotted names known to return jax values
+    call_graph: dict[str, set[str]]  # dotted fn -> called package fns
+
+    def jit_reachable(self) -> dict[str, str]:
+        """Package functions reachable from any jit entry point, mapped
+        to the dotted name of (one of) the jit root(s) that reaches
+        them. Roots map to themselves."""
+        reached: dict[str, str] = {}
+        stack = [(b.impl, b.impl) for b in self.jit_bindings
+                 if b.impl in self.functions]
+        while stack:
+            fn, root = stack.pop()
+            if fn in reached:
+                continue
+            reached[fn] = root
+            for callee in self.call_graph.get(fn, ()):
+                if callee not in reached and callee in self.functions:
+                    stack.append((callee, root))
+        return reached
+
+
+def build_index(modules: list[ModuleInfo]) -> PackageIndex:
+    functions: dict[str, tuple[ModuleInfo, ast.AST]] = {}
+    for mod in modules:
+        for name, node in mod.toplevel_defs.items():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                functions[f"{mod.module_name}.{name}"] = (mod, node)
+    jit_bindings = [b for mod in modules for b in find_jit_bindings(mod)]
+    jax_fns = {b.impl for b in jit_bindings}
+    jax_fns.update(b.mod.module_name + "." + b.bound_name
+                   for b in jit_bindings if b.bound_name)
+    call_graph: dict[str, set[str]] = {}
+    for dotted, (mod, fdef) in functions.items():
+        callees = set()
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Call):
+                d = mod.resolve(node.func)
+                if d is not None and d in functions:
+                    callees.add(d)
+        call_graph[dotted] = callees
+    return PackageIndex(modules=modules, functions=functions,
+                        jit_bindings=jit_bindings, jax_fns=jax_fns,
+                        call_graph=call_graph)

@@ -1,0 +1,172 @@
+"""W5xx — checkpoint-schema drift.
+
+A snapshot is a dict contract between the save sites
+(``game/coordinate_descent.save_snapshot``, the multi-host
+``save_snapshot``) and every restore/resume path that indexes into what
+``CheckpointManager.restore`` returns. The dict is schemaless by design
+(checkpoint.py stays framework-free), so nothing at runtime catches a
+writer renaming a field until a resume quietly ``.get(...)``-defaults it
+away — the silent flavor of the bug class PR 2's bit-exact drill exists
+for.
+
+- **W501** a key read on a restore path that NO save site writes;
+- **W502** a key written by a save site that NO restore path reads.
+
+Writers: dict literals passed (directly or through one local name) to
+``<ckpt-ish>.save(step, state)`` calls — receivers whose name matches
+``ckpt``/``checkpoint``. Readers: string subscripts and ``.get`` calls
+on snapshot variables — names bound from ``<ckpt-ish>.restore()`` or
+``loads_state(...)``, plus the conventional names ``snap`` /
+``resume_snapshot`` / ``snapshot``. Both directions compare against the
+union across the package, so the single-process and multi-host schemas
+coexist without cross-flagging.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow
+from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
+
+_CKPT_RECV_RE = re.compile(r"ckpt|checkpoint", re.IGNORECASE)
+_SNAP_NAMES = {"snap", "snapshot", "resume_snapshot"}
+
+
+def _receiver_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _dict_keys(d: ast.Dict) -> Optional[set[str]]:
+    keys = set()
+    for k in d.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+        elif k is None:
+            return None  # **spread: key set unknowable, skip this writer
+    return keys
+
+
+def _resolve_dict_arg(fdef_or_mod_body, arg: ast.expr,
+                      before_line: int) -> Optional[ast.Dict]:
+    """A dict literal argument, or the nearest preceding single-target
+    assignment of one to the given name."""
+    if isinstance(arg, ast.Dict):
+        return arg
+    if not isinstance(arg, ast.Name):
+        return None
+    best: Optional[tuple[int, ast.Dict]] = None
+    for n in ast.walk(fdef_or_mod_body):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and n.targets[0].id == arg.id \
+                and isinstance(n.value, ast.Dict) \
+                and n.lineno < before_line:
+            if best is None or n.lineno > best[0]:
+                best = (n.lineno, n.value)
+    return best[1] if best else None
+
+
+def _snapshot_vars(mod: ModuleInfo) -> set[str]:
+    """Names that hold a restored snapshot somewhere in the module."""
+    out = set(_SNAP_NAMES)
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Call):
+            call = n.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "restore":
+                recv = _receiver_name(call.func.value)
+                if recv and _CKPT_RECV_RE.search(recv):
+                    out.add(n.targets[0].id)
+            else:
+                d = mod.resolve(call.func)
+                if d is not None and d.endswith("loads_state"):
+                    out.add(n.targets[0].id)
+    return out
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    # ---- writers ---------------------------------------------------------
+    from photon_ml_tpu.analysis.rules_sync import build_scope_map
+
+    written: dict[str, list[tuple[ModuleInfo, ast.Call]]] = {}
+    any_writer = False
+    for mod in modules:
+        scope_of = build_scope_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "save"
+                    and len(node.args) >= 2):
+                continue
+            recv = _receiver_name(node.func.value)
+            if not recv or not _CKPT_RECV_RE.search(recv):
+                continue
+            scope = scope_of.get(id(node)) or mod.tree
+            d = _resolve_dict_arg(scope, node.args[1], node.lineno)
+            if d is None:
+                continue
+            keys = _dict_keys(d)
+            if keys is None:
+                continue
+            any_writer = True
+            for k in keys:
+                written.setdefault(k, []).append((mod, node))
+    # ---- readers ---------------------------------------------------------
+    read: dict[str, list[tuple[ModuleInfo, ast.AST]]] = {}
+    any_reader = False
+    for mod in modules:
+        snap_vars = _snapshot_vars(mod)
+        for node in ast.walk(mod.tree):
+            key = None
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in snap_vars \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                key = node.slice.value
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in snap_vars \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                key = node.args[0].value
+            if key is not None:
+                any_reader = True
+                read.setdefault(key, []).append((mod, node))
+    # ---- reconcile -------------------------------------------------------
+    findings: list[Finding] = []
+    if any_writer:
+        for key, sites in sorted(read.items()):
+            if key in written:
+                continue
+            for mod, node in sites:
+                findings.append(Finding(
+                    "W501", mod.relpath, node.lineno, node.col_offset,
+                    f"snapshot key '{key}' is read on a restore path "
+                    f"but no checkpoint save site writes it — resume "
+                    f"will silently default/KeyError"))
+    if any_reader:
+        for key, sites in sorted(written.items()):
+            if key in read:
+                continue
+            for mod, node in sites:
+                findings.append(Finding(
+                    "W502", mod.relpath, node.lineno, node.col_offset,
+                    f"snapshot key '{key}' is written at this save "
+                    f"site but never read by any restore path — dead "
+                    f"schema field or a renamed reader"))
+    return findings

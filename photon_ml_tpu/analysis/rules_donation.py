@@ -1,0 +1,171 @@
+"""W3xx — donation safety.
+
+``donate_argnums`` hands a buffer to XLA as scratch: after the call the
+python-side array is deleted, and any later read returns garbage or
+raises — the aliasing bug ``game/random_effect.py`` dodges by hand (its
+plain-path warm start can BE coordinate descent's live last-good state,
+so only the compacted re-dispatch path donates x0).
+
+**W301** fires when a name passed at a donated position of a donating
+call is read again later in the same function without an intervening
+rebind. Donating callables are found syntactically: module-level or
+local bindings of ``jax.jit(..., donate_argnums=...)`` /
+``partial(jax.jit, donate_argnums=...)(impl)``, one level of plain-name
+aliasing (``fn = _donating_variant``), and inline
+``jax.jit(f, donate_argnums=...)(x)`` calls. Reads that loop back
+around a ``for``/``while`` body are out of scope (documented
+limitation) — the dynamic tests own that case.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow
+from photon_ml_tpu.analysis.package import (
+    ModuleInfo, PackageIndex, jit_wrapping_call, _jit_options,
+)
+from photon_ml_tpu.analysis.rules_sync import build_scope_map
+
+
+def _donating_names_module(mod: ModuleInfo,
+                           index: PackageIndex) -> dict[str, set[int]]:
+    """Module-level names bound to donating jit wrappers."""
+    out: dict[str, set[int]] = {}
+    for b in index.jit_bindings:
+        if b.mod is mod and b.bound_name and b.donate_idx:
+            out.setdefault(b.bound_name, set()).update(b.donate_idx)
+    return out
+
+
+def _donation_of_call(mod: ModuleInfo, call: ast.Call,
+                      donating: dict[str, set[int]]) -> set[int]:
+    """Donated positional indices for one call expression (empty when
+    the callee is not known to donate)."""
+    if isinstance(call.func, ast.Name) and call.func.id in donating:
+        return donating[call.func.id]
+    # inline: jax.jit(f, donate_argnums=(0,))(x)
+    if isinstance(call.func, ast.Call):
+        wrap = jit_wrapping_call(mod, call.func)
+        if wrap is not None:
+            _, donate = _jit_options(mod, wrap, None)
+            return donate
+    return set()
+
+
+def _collect_local_donating(mod: ModuleInfo, fdef,
+                            module_donating: dict[str, set[int]]
+                            ) -> dict[str, set[int]]:
+    """Donating names visible in one function: module-level bindings,
+    local jit bindings, and one hop of plain-name aliasing (covers the
+    ``fn = _fit_blocks; if fast: fn = _fit_blocks_donate`` pattern —
+    may-analysis, so a conditionally-donating alias counts)."""
+    donating = dict(module_donating)
+    assigns = [n for n in ast.walk(fdef) if isinstance(n, ast.Assign)]
+    for n in assigns:
+        if len(n.targets) != 1 or not isinstance(n.targets[0], ast.Name):
+            continue
+        target = n.targets[0].id
+        if isinstance(n.value, ast.Call):
+            wrap = jit_wrapping_call(mod, n.value)
+            if wrap is not None:
+                _, donate = _jit_options(mod, wrap, None)
+                if donate:
+                    donating.setdefault(target, set()).update(donate)
+    for n in assigns:  # alias hop, after direct bindings are known
+        if len(n.targets) != 1 or not isinstance(n.targets[0], ast.Name):
+            continue
+        if isinstance(n.value, ast.Name) and n.value.id in donating:
+            donating.setdefault(n.targets[0].id, set()).update(
+                donating[n.value.id])
+    return donating
+
+
+def _stmt_of(fdef, node) -> Optional[ast.stmt]:
+    """Innermost statement of ``fdef`` whose subtree contains ``node``."""
+    best = None
+    for s in ast.walk(fdef):
+        if isinstance(s, ast.stmt) and any(c is node for c in ast.walk(s)):
+            best = s  # walk order visits outer statements first
+    return best
+
+
+def _later_read(fdef, name: str, call: ast.Call) -> Optional[ast.Name]:
+    """First Load of ``name`` after the donating ``call`` completes that
+    is not preceded by a rebinding of the same name (a rebind kills the
+    hazard: the variable no longer aliases the donated buffer).
+
+    Positions are (lineno, col) so a read on the call's OWN line —
+    ``return donating(x) + x`` — still counts, and the idiomatic
+    self-rebind ``x = donating(x)`` does not: the assignment targets of
+    the statement containing the call re-bind the name the moment the
+    call returns."""
+    after = (call.end_lineno or call.lineno,
+             call.end_col_offset or call.col_offset)
+    stmt = _stmt_of(fdef, call)
+    rebind = None
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for tgt in targets:
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name) and n.id == name:
+                    rebind = after  # rebound as soon as the call returns
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Name) and n.id == name \
+                and isinstance(n.ctx, (ast.Store, ast.Del)) \
+                and (n.lineno, n.col_offset) > after:
+            pos = (n.lineno, n.col_offset)
+            if rebind is None or pos < rebind:
+                rebind = pos
+    best: Optional[ast.Name] = None
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Name) and n.id == name \
+                and isinstance(n.ctx, ast.Load) \
+                and (n.lineno, n.col_offset) > after:
+            if rebind is not None and (n.lineno, n.col_offset) > rebind:
+                continue
+            if best is None or (n.lineno, n.col_offset) < (best.lineno,
+                                                           best.col_offset):
+                best = n
+    return best
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        module_donating = _donating_names_module(mod, index)
+        scope_of = build_scope_map(mod.tree)
+        fdefs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fdef in fdefs:
+            donating = _collect_local_donating(mod, fdef, module_donating)
+            if not donating:
+                continue
+            for call in ast.walk(fdef):
+                if not isinstance(call, ast.Call):
+                    continue
+                # only calls whose innermost function scope is THIS fdef
+                # (nested defs get their own pass)
+                if scope_of.get(id(call)) is not fdef:
+                    continue
+                donate_idx = _donation_of_call(mod, call, donating)
+                for i in sorted(donate_idx):
+                    if i >= len(call.args):
+                        continue
+                    arg = call.args[i]
+                    if not isinstance(arg, ast.Name):
+                        continue  # *args / expressions: not tracked
+                    read = _later_read(fdef, arg.id, call)
+                    if read is not None:
+                        findings.append(Finding(
+                            "W301", mod.relpath, call.lineno,
+                            call.col_offset,
+                            f"'{arg.id}' is donated to XLA at argument "
+                            f"{i} here but read again at line "
+                            f"{read.lineno} — donated buffers are "
+                            f"deleted; copy first or drop the read"))
+    return findings

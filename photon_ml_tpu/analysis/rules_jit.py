@@ -1,0 +1,79 @@
+"""W2xx — jit purity / retrace hazards.
+
+Anything executed while tracing a ``jax.jit``/``pjit`` region runs at
+*trace* time, not run time: a ``time.time()`` or ``np.random`` call
+bakes one trace-time value into the compiled program (the exact class of
+bug that breaks PR 2's bit-exact resume), and a Python ``if``/``while``
+on a traced value either raises at runtime or — worse — silently
+retraces per distinct shape/value. Scope is the static call closure:
+functions directly wrapped in jit plus package-local functions reachable
+from them through the call graph.
+
+- **W201** impure call (``time.*``, ``random.*``, ``np.random.*``,
+  ``logging.*``, ``print``/``open``/``input``) inside jit-traced code;
+- **W202** ``if``/``while`` whose condition is a traced value. For
+  directly-jitted functions, non-static parameters count as traced
+  (``static_argnums``/``static_argnames`` are resolved from the jit
+  call site — including one module-level constant hop); for reachable
+  helpers only locally-derived jax values count, which biases toward
+  precision over recall.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow, is_jax
+from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
+
+_IMPURE_PREFIXES = ("time.", "random.", "numpy.random.", "logging.")
+_IMPURE_EXACT = {"print", "open", "input", "breakpoint",
+                 "numpy.random"}
+# escape hatch for calls that LOOK impure but are jit-legal (none known
+# yet; populate before reaching for a suppression in shared helpers)
+_PURE_EXCEPTIONS: set[str] = set()
+
+
+def _short_root(root: str) -> str:
+    return root.split(".")[-1]
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    reachable = index.jit_reachable()
+    seen_fdefs: set[int] = set()
+    for fn, root in sorted(reachable.items()):
+        mod, fdef = index.functions[fn]
+        if id(fdef) in seen_fdefs:
+            continue
+        seen_fdefs.add(id(fdef))
+        flow = flows[mod.relpath]
+        via = "" if fn == root else \
+            f" (reachable from jitted {_short_root(root)})"
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Call):
+                d = mod.resolve(node.func)
+                if d is None and isinstance(node.func, ast.Name):
+                    d = node.func.id  # true builtins resolve to None
+                if d is None:
+                    continue
+                if (d in _IMPURE_EXACT or d.startswith(_IMPURE_PREFIXES)) \
+                        and d not in _PURE_EXCEPTIONS:
+                    findings.append(Finding(
+                        "W201", mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"impure call {d}() inside jit-traced code"
+                        f"{via} — its value is frozen at trace time "
+                        f"and breaks bit-exact resume"))
+            elif isinstance(node, (ast.If, ast.While)):
+                if is_jax(flow.tag(node.test)):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(Finding(
+                        "W202", mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"Python `{kind}` on a traced value inside "
+                        f"jit-traced code{via} — use jnp.where/"
+                        f"lax.cond, or mark the argument static"))
+    return findings

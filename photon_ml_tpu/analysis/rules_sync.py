@@ -1,0 +1,128 @@
+"""W1xx — sync discipline: blocking device→host conversions.
+
+The hot-loop contract (one blocking fetch per coordinate update, every
+intentional fetch instrumented through
+``utils/sync_telemetry.record_host_fetch``) is enforced dynamically only
+on the paths the transfer-guard test executes. These rules check the
+whole package statically:
+
+- **W101** ``float()``/``int()``/``bool()`` on a jax-valued expression;
+- **W102** ``.item()`` on a jax-valued expression;
+- **W103** ``np.asarray()``/``np.array()`` on a jax-valued expression;
+- **W104** ``jax.device_get`` in a function whose scope chain never
+  calls ``record_host_fetch`` — an *uninstrumented* fetch that
+  ``host_syncs_per_update`` telemetry cannot see.
+
+``utils/sync_telemetry.py`` itself is exempt: it IS the instrument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow, is_jax
+from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
+
+_EXEMPT_SUFFIX = "utils/sync_telemetry.py"
+_RECORD_FETCH = "record_host_fetch"
+_CONVERTERS = {"float", "int", "bool"}
+_NP_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+
+def build_scope_map(tree: ast.Module) -> dict[int, Optional[ast.AST]]:
+    """Map ``id(node)`` → innermost enclosing function def (None at
+    module level), and each function def → its own parent scope."""
+    scope_of: dict[int, Optional[ast.AST]] = {}
+
+    def visit(node: ast.AST, scope: Optional[ast.AST]) -> None:
+        scope_of[id(node)] = scope
+        child_scope = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+            else scope
+        for child in ast.iter_child_nodes(node):
+            visit(child, child_scope)
+
+    visit(tree, None)
+    return scope_of
+
+
+def _instrumented_scopes(mod: ModuleInfo,
+                         scope_of: dict[int, Optional[ast.AST]]
+                         ) -> set[Optional[int]]:
+    """Scopes (id of function def, or None for module level) containing
+    a direct ``record_host_fetch()`` call."""
+    out: set[Optional[int]] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            d = mod.resolve(node.func)
+            if d is not None and (d.endswith("." + _RECORD_FETCH)
+                                  or d == _RECORD_FETCH):
+                scope = scope_of.get(id(node))
+                out.add(None if scope is None else id(scope))
+    return out
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if mod.relpath.endswith(_EXEMPT_SUFFIX):
+            continue
+        flow = flows[mod.relpath]
+        scope_of = build_scope_map(mod.tree)
+        instrumented = _instrumented_scopes(mod, scope_of)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.resolve(node.func)
+            # W101: float()/int()/bool() — only the true builtins (a
+            # local or imported redefinition resolves to a dotted name)
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _CONVERTERS and d is None \
+                    and node.args \
+                    and is_jax(flow.tag(node.args[0])):
+                findings.append(Finding(
+                    "W101", mod.relpath, node.lineno, node.col_offset,
+                    f"{node.func.id}() on a jax-array value forces a "
+                    f"blocking device→host sync — batch it into one "
+                    f"instrumented jax.device_get"))
+            # W102: .item()
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args \
+                    and is_jax(flow.tag(node.func.value)):
+                findings.append(Finding(
+                    "W102", mod.relpath, node.lineno, node.col_offset,
+                    ".item() on a jax-array value forces a blocking "
+                    "device→host sync — batch it into one instrumented "
+                    "jax.device_get"))
+            # W103: np.asarray(jax_value)
+            elif d in _NP_CONVERTERS and node.args \
+                    and is_jax(flow.tag(node.args[0])):
+                findings.append(Finding(
+                    "W103", mod.relpath, node.lineno, node.col_offset,
+                    f"{d.replace('numpy.', 'np.')}() on a jax-array "
+                    f"value forces a blocking device→host sync — fetch "
+                    f"through an instrumented jax.device_get instead"))
+            # W104: un-instrumented jax.device_get
+            elif d == "jax.device_get":
+                scope = scope_of.get(id(node))
+                chain_ok = False
+                while True:
+                    key = None if scope is None else id(scope)
+                    if key in instrumented:
+                        chain_ok = True
+                        break
+                    if scope is None:
+                        break
+                    scope = scope_of.get(id(scope))
+                if not chain_ok:
+                    findings.append(Finding(
+                        "W104", mod.relpath, node.lineno,
+                        node.col_offset,
+                        "jax.device_get without record_host_fetch in "
+                        "the enclosing function — this blocking fetch "
+                        "is invisible to host_syncs_per_update "
+                        "telemetry (utils/sync_telemetry.py)"))
+    return findings

@@ -1,0 +1,147 @@
+"""photonlint runner: load → index → dataflow → rules → filter → report.
+
+Library entry point is :func:`lint`; ``tools/photonlint.py`` is the CLI
+wrapper. The run is pure (no package code is imported or executed) and
+deterministic: findings sort by (path, line, col, rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Iterable, Optional
+
+from photon_ml_tpu.analysis import (
+    core, dataflow, rules_checkpoint, rules_donation, rules_faults,
+    rules_jit, rules_sync,
+)
+from photon_ml_tpu.analysis.core import Finding, LintReport
+from photon_ml_tpu.analysis.package import (
+    ModuleInfo, PackageIndex, build_index,
+)
+
+RULE_MODULES = {
+    "W1": rules_sync,
+    "W2": rules_jit,
+    "W3": rules_donation,
+    "W4": rules_faults,
+    "W5": rules_checkpoint,
+}
+
+
+@dataclasses.dataclass
+class LintContext:
+    root: Path
+    readme_path: Optional[Path]
+    readme_lines: Optional[list[str]]
+    readme_relpath: Optional[str]
+
+
+def _collect_files(root: Path, paths: Iterable[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            files.extend(sorted(
+                f for f in path.rglob("*.py")
+                if "__pycache__" not in f.parts))
+        elif path.suffix == ".py":
+            files.append(path)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return files
+
+
+def collect_findings(
+    root: Path,
+    paths: Optional[Iterable[str]] = None,
+    readme: Optional[Path] = None,
+    families: Optional[set[str]] = None,
+) -> tuple[list[Finding], list[ModuleInfo], PackageIndex]:
+    """Run the rule families and return raw findings (before suppression
+    and baseline filtering)."""
+    root = Path(root)
+    files = _collect_files(root, paths or ["photon_ml_tpu"])
+    modules = [ModuleInfo.load(f, root) for f in files]
+    index = build_index(modules)
+    dataflow.infer_jax_functions(index)
+
+    # Jit params become tracers: mark non-static params JAX per binding
+    # whose statics resolved (unknown statics → no tags → no W202 FPs).
+    tags_by_mod: dict[str, dict[int, dict[str, str]]] = {}
+    for b in index.jit_bindings:
+        if b.fdef is None or b.static_names is None:
+            continue
+        a = b.fdef.args
+        params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        tags = {p: dataflow.JAX for p in params
+                if p not in b.static_names}
+        tags_by_mod.setdefault(b.mod.relpath, {})[id(b.fdef)] = tags
+    flows = {
+        mod.relpath: dataflow.analyze_module(
+            mod, index, tags_by_mod.get(mod.relpath))
+        for mod in modules
+    }
+
+    if readme is not None and Path(readme).exists():
+        readme_path = Path(readme)
+        readme_lines = readme_path.read_text().splitlines()
+        try:
+            readme_relpath = readme_path.relative_to(root).as_posix()
+        except ValueError:
+            readme_relpath = readme_path.name
+    else:
+        readme_path = readme_lines = readme_relpath = None
+    ctx = LintContext(root=root, readme_path=readme_path,
+                      readme_lines=readme_lines,
+                      readme_relpath=readme_relpath)
+
+    findings: list[Finding] = []
+    enabled = families or set(RULE_MODULES)
+    for family, rule_mod in sorted(RULE_MODULES.items()):
+        if family in enabled:
+            findings.extend(rule_mod.check(modules, index, flows, ctx))
+    if families is None or "W0" in families:
+        for mod in modules:
+            findings.extend(mod.malformed)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, modules, index
+
+
+def lint(
+    root,
+    paths: Optional[Iterable[str]] = None,
+    readme=None,
+    baseline=None,
+    families: Optional[set[str]] = None,
+) -> LintReport:
+    """Full lint pass: rules, then per-line suppressions, then baseline.
+
+    ``baseline`` is a path (entries grandfather existing findings) or
+    None to report everything as new.
+    """
+    findings, modules, _ = collect_findings(
+        Path(root), paths, readme, families)
+    by_file = {m.relpath: m.suppressions for m in modules}
+    kept, suppressed = core.apply_suppressions(findings, by_file)
+    entries = core.load_baseline(baseline)
+    new, baselined, stale = core.apply_baseline(kept, entries)
+    return LintReport(new=new, baselined=baselined,
+                      suppressed=suppressed, stale_baseline=stale,
+                      files_checked=len(modules))
+
+
+def write_baseline(
+    root,
+    path,
+    paths: Optional[Iterable[str]] = None,
+    readme=None,
+    families: Optional[set[str]] = None,
+) -> int:
+    """Grandfather every current (non-suppressed) finding into
+    ``path``; returns the number of baseline entries written."""
+    findings, modules, _ = collect_findings(
+        Path(root), paths, readme, families)
+    by_file = {m.relpath: m.suppressions for m in modules}
+    kept, _ = core.apply_suppressions(findings, by_file)
+    return core.write_baseline(path, kept)

@@ -18,7 +18,11 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from photon_ml_tpu.evaluation.evaluators import EvaluatorSpec, evaluate
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluatorSpec,
+    evaluate_many,
+    resolve_entity_ids,
+)
 from photon_ml_tpu.io.data_format import (
     NameAndTermFeatureSets,
     load_game_dataset_avro,
@@ -191,15 +195,16 @@ class GameScoringDriver:
         if self.evaluators and np.isfinite(data.responses).all():
             labels = jnp.asarray(data.responses)
             weights = jnp.asarray(data.weights)
+            ids_by_type, num_by_type = resolve_entity_ids(
+                self.evaluators, data.id_columns, data.id_vocabs)
+            # all metrics share one instrumented device→host fetch
+            values = evaluate_many(
+                self.evaluators, jnp.asarray(scores), labels, weights,
+                entity_ids_by_type=ids_by_type,
+                num_entities_by_type=num_by_type)
             for spec in self.evaluators:
-                entity_ids = num_entities = None
-                if spec.id_type:
-                    entity_ids = jnp.asarray(data.id_columns[spec.id_type])
-                    num_entities = len(data.id_vocabs[spec.id_type])
-                value = evaluate(spec, jnp.asarray(scores), labels, weights,
-                                 entity_ids=entity_ids,
-                                 num_entities=num_entities)
-                self.logger.info(f"evaluation {spec.name}: {value:.6f}")
+                self.logger.info(
+                    f"evaluation {spec.name}: {values[spec.name]:.6f}")
         return scores
 
 

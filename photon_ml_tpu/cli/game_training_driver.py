@@ -42,7 +42,11 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from photon_ml_tpu.evaluation.evaluators import EvaluatorSpec, evaluate
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluatorSpec,
+    evaluate_many,
+    resolve_entity_ids,
+)
 from photon_ml_tpu.game.coordinate import (
     FactoredRandomEffectCoordinate,
     FixedEffectCoordinate,
@@ -458,18 +462,17 @@ class GameTrainingDriver:
         labels = jnp.asarray(vd.responses)
         weights = jnp.asarray(vd.weights)
 
+        # Entity-id columns resolved once; every validation pass then
+        # computes ALL metrics with a single instrumented fetch
+        # (evaluate_many), not one hidden sync per metric.
+        ids_by_type, num_by_type = resolve_entity_ids(
+            self.evaluators, vd.id_columns, vd.id_vocabs)
+
         def evaluator(scores):
-            out = {}
-            for spec in self.evaluators:
-                entity_ids = None
-                num_entities = None
-                if spec.id_type:
-                    entity_ids = jnp.asarray(vd.id_columns[spec.id_type])
-                    num_entities = len(vd.id_vocabs[spec.id_type])
-                out[spec.name] = evaluate(
-                    spec, scores, labels, weights,
-                    entity_ids=entity_ids, num_entities=num_entities)
-            return out
+            return evaluate_many(
+                self.evaluators, scores, labels, weights,
+                entity_ids_by_type=ids_by_type,
+                num_entities_by_type=num_by_type)
 
         return evaluator, self.evaluators[0]
 

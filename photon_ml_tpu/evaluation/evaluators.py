@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation import metrics
 from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
 
 Array = jnp.ndarray
 
@@ -96,6 +97,43 @@ class EvaluatorSpec:
         return a < b
 
 
+def _device_metric(
+    spec: EvaluatorSpec,
+    scores: Array,
+    labels: Array,
+    weights: Array | None,
+    entity_ids: Array | None,
+    num_entities: int | None,
+) -> Array:
+    """One metric as a device scalar — dispatched asynchronously, never
+    fetched here (the caller batches fetches; see ``evaluate_many``)."""
+    t = spec.evaluator_type
+    if t == EvaluatorType.AUC:
+        return metrics.area_under_roc_curve(labels, scores, weights)
+    if t == EvaluatorType.RMSE:
+        return metrics.root_mean_squared_error(labels, scores, weights)
+    if t in (EvaluatorType.LOGISTIC_LOSS, EvaluatorType.POISSON_LOSS,
+             EvaluatorType.SQUARED_LOSS, EvaluatorType.SMOOTHED_HINGE_LOSS):
+        loss = get_loss({
+            EvaluatorType.LOGISTIC_LOSS: "logistic",
+            EvaluatorType.POISSON_LOSS: "poisson",
+            EvaluatorType.SQUARED_LOSS: "squared",
+            EvaluatorType.SMOOTHED_HINGE_LOSS: "smoothed_hinge",
+        }[t])
+        return metrics.mean_loss(loss, labels, scores, weights)
+    if t == EvaluatorType.SHARDED_AUC:
+        if entity_ids is None or num_entities is None:
+            raise ValueError("sharded AUC needs entity_ids + num_entities")
+        return sharded_auc(labels, scores, entity_ids, num_entities,
+                           weights)
+    if t == EvaluatorType.SHARDED_PRECISION_AT_K:
+        if entity_ids is None or num_entities is None:
+            raise ValueError("precision@k needs entity_ids + num_entities")
+        return sharded_precision_at_k(labels, scores, entity_ids,
+                                      num_entities, spec.k)
+    raise ValueError(f"unhandled evaluator {spec}")
+
+
 def evaluate(
     spec: EvaluatorSpec,
     scores: Array,
@@ -108,33 +146,67 @@ def evaluate(
 
     For sharded evaluators, ``entity_ids`` must be dense ids in
     ``[0, num_entities)`` aligned with scores (the id-type resolution from
-    GameDatum happens in the data layer).
+    GameDatum happens in the data layer). Costs exactly one instrumented
+    device→host fetch; evaluating several metrics should go through
+    :func:`evaluate_many`, which shares a single fetch across all of
+    them.
     """
-    t = spec.evaluator_type
-    if t == EvaluatorType.AUC:
-        return float(metrics.area_under_roc_curve(labels, scores, weights))
-    if t == EvaluatorType.RMSE:
-        return float(metrics.root_mean_squared_error(labels, scores, weights))
-    if t in (EvaluatorType.LOGISTIC_LOSS, EvaluatorType.POISSON_LOSS,
-             EvaluatorType.SQUARED_LOSS, EvaluatorType.SMOOTHED_HINGE_LOSS):
-        loss = get_loss({
-            EvaluatorType.LOGISTIC_LOSS: "logistic",
-            EvaluatorType.POISSON_LOSS: "poisson",
-            EvaluatorType.SQUARED_LOSS: "squared",
-            EvaluatorType.SMOOTHED_HINGE_LOSS: "smoothed_hinge",
-        }[t])
-        return float(metrics.mean_loss(loss, labels, scores, weights))
-    if t == EvaluatorType.SHARDED_AUC:
-        if entity_ids is None or num_entities is None:
-            raise ValueError("sharded AUC needs entity_ids + num_entities")
-        return float(sharded_auc(labels, scores, entity_ids, num_entities,
-                                 weights))
-    if t == EvaluatorType.SHARDED_PRECISION_AT_K:
-        if entity_ids is None or num_entities is None:
-            raise ValueError("precision@k needs entity_ids + num_entities")
-        return float(sharded_precision_at_k(labels, scores, entity_ids,
-                                            num_entities, spec.k))
-    raise ValueError(f"unhandled evaluator {spec}")
+    value = jax.device_get(_device_metric(
+        spec, scores, labels, weights, entity_ids, num_entities))
+    record_host_fetch()
+    return float(value)
+
+
+def resolve_entity_ids(
+    specs: list[EvaluatorSpec],
+    id_columns,
+    id_vocabs,
+) -> tuple[dict[str, Array], dict[str, int]]:
+    """Resolve each sharded spec's id type once into the dense-id column
+    and vocab size :func:`evaluate_many` expects (shared by the training
+    and scoring drivers so the resolution cannot drift between them)."""
+    ids_by_type: dict[str, Array] = {}
+    num_by_type: dict[str, int] = {}
+    for spec in specs:
+        if spec.id_type and spec.id_type not in ids_by_type:
+            ids_by_type[spec.id_type] = jnp.asarray(
+                id_columns[spec.id_type])
+            num_by_type[spec.id_type] = len(id_vocabs[spec.id_type])
+    return ids_by_type, num_by_type
+
+
+def evaluate_many(
+    specs: list[EvaluatorSpec],
+    scores: Array,
+    labels: Array,
+    weights: Array | None = None,
+    entity_ids_by_type: dict[str, Array] | None = None,
+    num_entities_by_type: dict[str, int] | None = None,
+) -> dict[str, float]:
+    """All requested metrics with ONE blocking device→host fetch.
+
+    Every metric kernel is dispatched first (device scalars only), then
+    the whole tuple comes back in a single ``jax.device_get`` routed
+    through ``utils/sync_telemetry`` — so validation metrics show up in
+    ``host_syncs_per_update`` telemetry instead of costing one hidden
+    round-trip per metric. Sharded specs resolve their entity ids from
+    the ``*_by_type`` mappings (keyed by the spec's ``id_type``).
+    """
+    device_vals = []
+    for spec in specs:
+        eid = nent = None
+        if spec.id_type is not None:
+            eid = (entity_ids_by_type or {}).get(spec.id_type)
+            nent = (num_entities_by_type or {}).get(spec.id_type)
+            if eid is None or nent is None:
+                raise ValueError(
+                    f"evaluator {spec.name!r} needs entity ids for id "
+                    f"type {spec.id_type!r}")
+        device_vals.append(_device_metric(
+            spec, scores, labels, weights, eid, nent))
+    fetched = jax.device_get(tuple(device_vals))
+    record_host_fetch()
+    return {spec.name: float(v) for spec, v in zip(specs, fetched)}
 
 
 @partial(jax.jit, static_argnums=(3,))

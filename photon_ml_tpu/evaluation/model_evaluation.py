@@ -27,6 +27,7 @@ from photon_ml_tpu.evaluation import metrics
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optimize.config import TaskType
+from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
 
 # Metric name constants (Evaluation.scala:32-39).
 MEAN_ABSOLUTE_ERROR = "MEAN_ABSOLUTE_ERROR"
@@ -128,7 +129,9 @@ def evaluate_model_grid(models: Sequence[GeneralizedLinearModel],
                 f"dimensions: model 0 has shape {tuple(dim)} but model {i} "
                 f"has {tuple(m.coefficients.means.shape)}")
     W = jnp.stack([m.coefficients.means for m in models])
+    # the whole [num_metrics, L] grid comes back in this one fetch
     packed = jax.device_get(_evaluate_grid_kernel(task, W, batch))
+    record_host_fetch()
     names = _metric_names(task)
     return [{name: float(packed[j, i]) for j, name in enumerate(names)}
             for i in range(len(models))]

@@ -191,9 +191,13 @@ def _state_leaves(state):
 
 def _state_is_finite(state) -> bool:
     # device-side reduction: one scalar comes back per leaf instead of a
-    # full state copy (per-entity matrices can be millions of rows)
-    return all(bool(jnp.all(jnp.isfinite(jnp.asarray(leaf))))
-               for leaf in _state_leaves(state))
+    # full state copy (per-entity matrices can be millions of rows);
+    # all leaves' flags return in a single instrumented fetch
+    flags = jax.device_get(tuple(
+        jnp.all(jnp.isfinite(jnp.asarray(leaf)))
+        for leaf in _state_leaves(state)))
+    record_host_fetch()
+    return all(bool(f) for f in flags)
 
 
 def _damp_toward(good, candidate, factor: float):
@@ -213,7 +217,9 @@ def training_loss_evaluator(task: TaskType, labels: Array, weights: Array,
 
     def evaluate(scores: Array) -> float:
         l, _ = loss.loss_and_d1(scores + offsets, labels)
-        return float(jnp.sum(weights * l))
+        value = jax.device_get(jnp.sum(weights * l))
+        record_host_fetch()
+        return float(value)
 
     return evaluate
 

@@ -28,6 +28,7 @@ import numpy as np
 from photon_ml_tpu.data.batch import Batch
 from photon_ml_tpu.ops.losses import sigmoid
 from photon_ml_tpu.optimize.config import TaskType
+from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
 
 Array = jnp.ndarray
 
@@ -107,8 +108,12 @@ class GeneralizedLinearModel:
     # -- validation ----------------------------------------------------------
 
     def validate_coefficients(self) -> bool:
-        """NaN/Inf scan (GeneralizedLinearModel.validateCoefficients :80)."""
-        return bool(jnp.all(jnp.isfinite(self.coefficients.means)))
+        """NaN/Inf scan (GeneralizedLinearModel.validateCoefficients :80).
+        One instrumented fetch of the device-side reduction scalar."""
+        flag = jax.device_get(jnp.all(jnp.isfinite(
+            self.coefficients.means)))
+        record_host_fetch()
+        return bool(flag)
 
     # -- helpers -------------------------------------------------------------
 

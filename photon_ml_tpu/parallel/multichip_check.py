@@ -208,7 +208,10 @@ def run_game_step(
     def mf_score(rf, cf, r, c):
         return jnp.sum(rf[r] * cf[c], axis=-1)
 
+    from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
+
     mf_scores = np.asarray(jax.device_get(mf_score(rf, cf, r_codes, c_codes)))
+    record_host_fetch()
     # parity with the model's host-side scoring path
     data.encode_ids("itemId", items)
     np.testing.assert_allclose(

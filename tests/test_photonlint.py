@@ -1,0 +1,571 @@
+"""photonlint tier-1 gate + rule-family unit tests.
+
+Three layers:
+
+1. Fixture snippets: every rule family has a positive case (fires), a
+   negative case (stays quiet), and a suppressed case (fires but a
+   ``# photonlint: allow-...`` directive absorbs it), plus baseline
+   round-trip and malformed-directive coverage.
+2. The package gate: ``photon_ml_tpu/`` must produce ZERO non-baselined
+   findings against the committed baseline (failure prints the findings
+   as a readable diff, not a bare assert).
+3. Canaries: a copy of the real package is seeded with one known
+   violation per family and the lint run MUST go red for each — proving
+   the gate cannot silently rot.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from photon_ml_tpu.analysis import core, runner
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+BASELINE = REPO_ROOT / "tools" / "photonlint_baseline.json"
+README = REPO_ROOT / "README.md"
+
+
+def run_fixture(tmp_path, files, readme=None, families=None,
+                baseline=None):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    for name, src in files.items():
+        path = pkg / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    readme_path = None
+    if readme is not None:
+        readme_path = tmp_path / "README.md"
+        readme_path.write_text(readme)
+    return runner.lint(tmp_path, paths=["pkg"], readme=readme_path,
+                       baseline=baseline, families=families)
+
+
+def rules_of(report):
+    return sorted({f.rule for f in report.new})
+
+
+# -- W1xx sync discipline --------------------------------------------------
+
+W1_POSITIVE = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def objective():
+    x = jnp.zeros((4,))
+    loss = float(jnp.sum(x))        # W101
+    flag = bool(jnp.all(x > 0))     # W101
+    one = jnp.max(x).item()         # W102
+    host = np.asarray(x)            # W103
+    rest = jax.device_get(x)        # W104 (no record_host_fetch)
+    return loss, flag, one, host, rest
+"""
+
+W1_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
+
+def objective():
+    x = jnp.zeros((4,))
+    fetched = jax.device_get((jnp.sum(x), jnp.all(x > 0)))
+    record_host_fetch()
+    loss, flag = fetched
+    host = np.asarray([1.0, 2.0])   # numpy input: free
+    return float(loss), bool(flag), host
+"""
+
+W1_SUPPRESSED = """
+import jax.numpy as jnp
+
+def objective():
+    x = jnp.zeros((4,))
+    # photonlint: allow-W101(fixture: intentional scalar sync)
+    return float(jnp.sum(x))
+"""
+
+
+def test_w1_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W1_POSITIVE},
+                         families={"W1"})
+    assert rules_of(report) == ["W101", "W102", "W103", "W104"]
+    assert sum(f.rule == "W101" for f in report.new) == 2
+
+
+def test_w1_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W1_NEGATIVE},
+                         families={"W1"})
+    assert report.new == []
+
+
+def test_w1_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W1_SUPPRESSED},
+                         families={"W1"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W101"]
+
+
+# -- W2xx jit purity -------------------------------------------------------
+
+W2_POSITIVE = """
+import time
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(x):
+    stamp = time.time()             # W201
+    if x > 0:                       # W202 (x is a tracer)
+        return x * stamp
+    return -x
+
+def helper(y):
+    print("tracing", y)             # W201 via call graph
+    return y * 2.0
+
+@jax.jit
+def outer(y):
+    return helper(y)
+"""
+
+W2_NEGATIVE = """
+from functools import partial
+import jax
+import jax.numpy as jnp
+
+@partial(jax.jit, static_argnames=("flip",))
+def kernel(x, flip):
+    if flip:                        # static arg: fine
+        return -x
+    if x is None:                   # identity check: fine
+        return jnp.zeros(())
+    return jnp.where(x > 0, x, -x)  # data-dependence the jit way
+
+def helper(y):
+    print("not traced")             # not reachable from any jit
+    return y
+"""
+
+W2_SUPPRESSED = """
+import time
+import jax
+
+@jax.jit
+def kernel(x):
+    # photonlint: allow-W201(fixture: trace-time stamp is intended)
+    return x * time.time()
+"""
+
+
+def test_w2_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W2_POSITIVE},
+                         families={"W2"})
+    assert rules_of(report) == ["W201", "W202"]
+    w201 = [f for f in report.new if f.rule == "W201"]
+    assert any("reachable from" in f.message for f in w201), \
+        "call-graph reachability must attribute helper() to its jit root"
+
+
+def test_w2_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W2_NEGATIVE},
+                         families={"W2"})
+    assert report.new == []
+
+
+def test_w2_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W2_SUPPRESSED},
+                         families={"W2"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W201"]
+
+
+# -- W3xx donation safety --------------------------------------------------
+
+W3_POSITIVE = """
+import jax
+import jax.numpy as jnp
+
+def step(x):
+    return x + 1
+
+_step_donating = jax.jit(step, donate_argnums=(0,))
+
+def run(buf):
+    out = _step_donating(buf)
+    return out + buf                # W301: buf was donated
+"""
+
+W3_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+
+def step(x):
+    return x + 1
+
+_step_donating = jax.jit(step, donate_argnums=(0,))
+
+def run(buf):
+    out = _step_donating(buf)       # last read of buf: fine
+    buf = jnp.zeros_like(out)       # rebind kills the hazard
+    return out + buf
+"""
+
+W3_SUPPRESSED = """
+import jax
+
+def run(buf):
+    fn = jax.jit(lambda b: b + 1, donate_argnums=(0,))
+    # photonlint: allow-W301(fixture: CPU backend never aliases)
+    out = fn(buf)
+    return out + buf
+"""
+
+
+def test_w3_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W3_POSITIVE},
+                         families={"W3"})
+    assert rules_of(report) == ["W301"]
+
+
+def test_w3_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W3_NEGATIVE},
+                         families={"W3"})
+    assert report.new == []
+
+
+def test_w3_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W3_SUPPRESSED},
+                         families={"W3"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W301"]
+
+
+# -- W4xx fault-point drift ------------------------------------------------
+
+FAULT_README = """# fixture
+| point | fires | tag |
+|---|---|---|
+| `cd.update` | after each update | sweep.coord |
+| `ghost.point` | documented but gone | — |
+"""
+
+W4_POSITIVE = """
+from photon_ml_tpu.utils.faults import fault_point
+
+def body():
+    fault_point("cd.update", tag="1.1")
+    fault_point("cd.unlisted")      # W401: not in the table
+    name = "dyn"
+    fault_point(name)               # W403: not a literal
+"""
+
+W4_NEGATIVE = """
+from photon_ml_tpu.utils.faults import fault_point
+
+def body():
+    fault_point("cd.update", tag="1.1")
+"""
+
+W4_SUPPRESSED = """
+from photon_ml_tpu.utils.faults import fault_point
+
+def body():
+    fault_point("cd.update", tag="1.1")
+    # photonlint: allow-W401(fixture: experimental point, not yet documented)
+    fault_point("cd.unlisted")
+"""
+
+
+def test_w4_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W4_POSITIVE},
+                         readme=FAULT_README, families={"W4"})
+    assert rules_of(report) == ["W401", "W402", "W403"]
+    w402 = [f for f in report.new if f.rule == "W402"]
+    assert "ghost.point" in w402[0].message
+    assert w402[0].path == "README.md"
+
+
+def test_w4_negative(tmp_path):
+    readme = FAULT_README.replace(
+        "| `ghost.point` | documented but gone | — |\n", "")
+    report = run_fixture(tmp_path, {"mod.py": W4_NEGATIVE},
+                         readme=readme, families={"W4"})
+    assert report.new == []
+
+
+def test_w4_suppressed(tmp_path):
+    readme = FAULT_README.replace(
+        "| `ghost.point` | documented but gone | — |\n", "")
+    report = run_fixture(tmp_path, {"mod.py": W4_SUPPRESSED},
+                         readme=readme, families={"W4"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W401"]
+
+
+# -- W5xx checkpoint-schema drift ------------------------------------------
+
+W5_POSITIVE = """
+def save(ckpt_mgr, sweep, states):
+    state = {"sweep": sweep, "states": states, "orphan": 1}  # W502
+    ckpt_mgr.save(sweep, state)
+
+def resume(ckpt_mgr):
+    snap = ckpt_mgr.restore()
+    return snap["sweep"], snap["states"], snap.get("phantom")  # W501
+"""
+
+W5_NEGATIVE = """
+def save(ckpt_mgr, sweep, states):
+    ckpt_mgr.save(sweep, {"sweep": sweep, "states": states})
+
+def resume(ckpt_mgr):
+    snap = ckpt_mgr.restore()
+    return snap["sweep"], snap.get("states")
+"""
+
+W5_SUPPRESSED = """
+def save(ckpt_mgr, sweep):
+    ckpt_mgr.save(sweep, {"sweep": sweep})
+
+def resume(ckpt_mgr):
+    snap = ckpt_mgr.restore()
+    # photonlint: allow-W501(fixture: key written by an older release)
+    return snap["legacy_field"], snap["sweep"]
+"""
+
+
+def test_w5_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W5_POSITIVE},
+                         families={"W5"})
+    assert rules_of(report) == ["W501", "W502"]
+    assert any("phantom" in f.message for f in report.new)
+    assert any("orphan" in f.message for f in report.new)
+
+
+def test_w5_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W5_NEGATIVE},
+                         families={"W5"})
+    assert report.new == []
+
+
+def test_w5_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W5_SUPPRESSED},
+                         families={"W5"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W501"]
+
+
+def test_w3_self_rebind_is_clean(tmp_path):
+    """`x = donating(x)` — THE idiomatic donation pattern — must not
+    fire: the name is rebound to the result the moment the call
+    returns."""
+    src = """
+import jax
+
+def step(x):
+    return x + 1
+
+_step = jax.jit(step, donate_argnums=(0,))
+
+def run(x, n):
+    for _ in range(n):
+        x = _step(x)
+    return x
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"W3"})
+    assert report.new == []
+
+
+def test_w3_same_line_read_fires(tmp_path):
+    """A read of the donated buffer on the call's own line is exactly
+    the deleted-buffer bug — line granularity must not hide it."""
+    src = """
+import jax
+
+def step(x):
+    return x + 1
+
+_step = jax.jit(step, donate_argnums=(0,))
+
+def run(buf):
+    return _step(buf) + buf
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"W3"})
+    assert rules_of(report) == ["W301"]
+
+
+# -- suppression grammar / W001 --------------------------------------------
+
+def test_malformed_suppression_is_w001(tmp_path):
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.zeros(())
+    # photonlint: allow-W101()
+    return float(x)
+"""
+    report = run_fixture(tmp_path, {"mod.py": src})
+    rules = rules_of(report)
+    assert "W001" in rules, "empty reason must not silently suppress"
+    assert "W101" in rules, "the malformed directive must not suppress"
+
+
+def test_standalone_suppression_skips_blank_and_comment_lines(tmp_path):
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.zeros(())
+    # photonlint: allow-W101(fixture: guarded through intervening comment)
+    # an explanatory comment between directive and statement
+
+    return float(x)
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"W1"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W101"]
+
+
+def test_family_wildcard_suppression(tmp_path):
+    src = """
+import jax.numpy as jnp
+
+def f():
+    x = jnp.zeros(())
+    # photonlint: allow-W1xx(fixture: whole-family waiver)
+    return float(x)
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"W1"})
+    assert report.new == []
+    assert len(report.suppressed) == 1
+
+
+# -- baseline workflow -----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(W1_POSITIVE)
+    baseline = tmp_path / "baseline.json"
+
+    first = runner.lint(tmp_path, paths=["pkg"], families={"W1"})
+    assert len(first.new) == 5
+
+    n = runner.write_baseline(tmp_path, baseline, paths=["pkg"],
+                              families={"W1"})
+    assert n == len({f.baseline_key for f in first.new})
+
+    second = runner.lint(tmp_path, paths=["pkg"], baseline=baseline,
+                         families={"W1"})
+    assert second.new == [], "baselined findings must not re-fire"
+    assert len(second.baselined) == 5
+
+    # a NEW violation on top of the baseline still goes red
+    (pkg / "mod.py").write_text(
+        W1_POSITIVE + "\n\ndef extra():\n"
+        "    import jax.numpy as jnp\n"
+        "    return int(jnp.ones(()))\n")
+    third = runner.lint(tmp_path, paths=["pkg"], baseline=baseline,
+                        families={"W1"})
+    assert len(third.new) == 1
+    assert third.new[0].rule == "W101"  # int() on jax value
+
+    # fixing everything leaves stale entries, reported not fatal
+    (pkg / "mod.py").write_text(W1_NEGATIVE)
+    fourth = runner.lint(tmp_path, paths=["pkg"], baseline=baseline,
+                         families={"W1"})
+    assert fourth.new == []
+    assert fourth.stale_baseline, "fixed findings should show as stale"
+
+
+# -- the package gate ------------------------------------------------------
+
+def _format_failure(report):
+    lines = ["photonlint found NEW violations (fix them, suppress with "
+             "# photonlint: allow-<rule>(reason), or — for a "
+             "deliberate grandfather — run "
+             "`python tools/photonlint.py --write-baseline`):", ""]
+    lines += [f"  {f.format()}" for f in report.new]
+    return "\n".join(lines)
+
+
+def test_package_has_no_new_findings():
+    report = runner.lint(REPO_ROOT, paths=["photon_ml_tpu"],
+                         readme=README, baseline=BASELINE)
+    assert report.ok, _format_failure(report)
+
+
+def test_cli_json_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "photonlint.py"),
+         "photon_ml_tpu", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["new"] == []
+    assert payload["files_checked"] > 50
+
+
+# -- canaries: every family must still fire on a seeded violation ----------
+
+CANARIES = {
+    "W101": (
+        "\n\ndef _photonlint_canary_sync():\n"
+        "    return float(jnp.sum(jnp.zeros((3,))))\n"),
+    "W201": (
+        "\n\n@jax.jit\n"
+        "def _photonlint_canary_jit(x):\n"
+        "    return x * time.time()\n"),
+    "W301": (
+        "\n\ndef _photonlint_canary_donate(buf):\n"
+        "    fn = jax.jit(lambda b: b + 1, donate_argnums=(0,))\n"
+        "    out = fn(buf)\n"
+        "    return out + buf\n"),
+    "W401": (
+        "\n\ndef _photonlint_canary_fault():\n"
+        "    fault_point(\"canary.unlisted\")\n"),
+    "W501": (
+        "\n\ndef _photonlint_canary_schema(snap):\n"
+        "    return snap[\"photonlint_canary_missing_key\"]\n"),
+}
+
+
+@pytest.fixture(scope="module")
+def seeded_package(tmp_path_factory):
+    """A copy of the real package with one violation per family seeded
+    into game/coordinate_descent.py (which already imports jnp, jax,
+    time and fault_point)."""
+    root = tmp_path_factory.mktemp("canary")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    shutil.copy(README, root / "README.md")
+    target = root / "photon_ml_tpu" / "game" / "coordinate_descent.py"
+    with open(target, "a") as fh:
+        for snippet in CANARIES.values():
+            fh.write(snippet)
+    return root
+
+
+def test_canaries_turn_the_run_red(seeded_package):
+    report = runner.lint(
+        seeded_package, paths=["photon_ml_tpu"],
+        readme=seeded_package / "README.md", baseline=BASELINE)
+    fired = {f.rule for f in report.new}
+    missing = set(CANARIES) - fired
+    assert not missing, (
+        f"rule families failed to fire on seeded violations: "
+        f"{sorted(missing)}; fired={sorted(fired)}")
+    # and every canary is attributed to the seeded file
+    seeded = [f for f in report.new
+              if f.rule in CANARIES]
+    assert all(f.path == "photon_ml_tpu/game/coordinate_descent.py"
+               for f in seeded)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""photonlint CLI — static invariant checks for photon_ml_tpu.
+
+Usage (from the repo root)::
+
+    python tools/photonlint.py                       # lint photon_ml_tpu/
+    python tools/photonlint.py photon_ml_tpu tools   # explicit paths
+    python tools/photonlint.py --format json         # machine output
+    python tools/photonlint.py --write-baseline      # grandfather all
+    python tools/photonlint.py --no-baseline         # raw findings
+    python tools/photonlint.py --rules W1,W4         # family subset
+    python tools/photonlint.py --list-rules
+
+Exit codes: 0 clean (no non-baselined findings), 1 findings, 2 usage or
+internal error. The default baseline is ``tools/photonlint_baseline.json``
+and the default README (for the W4xx fault-table reconciliation) is the
+repo's ``README.md``; both are resolved relative to this script so the
+CLI works from any working directory.
+
+Rule ids, the suppression grammar and the baseline workflow are
+documented in the README "Static analysis" section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from photon_ml_tpu.analysis import runner  # noqa: E402
+from photon_ml_tpu.analysis.core import FAMILIES, RULES  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "photonlint_baseline.json")
+DEFAULT_README = os.path.join(_REPO_ROOT, "README.md")
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="photonlint",
+        description="AST-based invariant checks: sync discipline, jit "
+                    "purity, donation safety, fault-point and "
+                    "checkpoint-schema drift.")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories relative to --root "
+                         "(default: photon_ml_tpu)")
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="lint root; finding paths are relative to it")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (grandfathered findings)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to --baseline and "
+                         "exit 0")
+    ap.add_argument("--readme", default=DEFAULT_README,
+                    help="README whose PHOTON_FAULTS table W4xx checks")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule families to run, e.g. "
+                         "W1,W4 (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    ns = parse_args(sys.argv[1:] if argv is None else argv)
+    if ns.list_rules:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}  {RULES[rule_id]}")
+        return 0
+    families = None
+    if ns.rules:
+        families = {f.strip() for f in ns.rules.split(",") if f.strip()}
+        bad = families - set(FAMILIES)
+        if bad:
+            print(f"photonlint: unknown rule famil(ies) "
+                  f"{sorted(bad)}; known: {list(FAMILIES)}",
+                  file=sys.stderr)
+            return 2
+    paths = ns.paths or None
+    try:
+        if ns.write_baseline:
+            n = runner.write_baseline(
+                ns.root, ns.baseline, paths=paths, readme=ns.readme,
+                families=families)
+            print(f"photonlint: wrote {n} baseline entr(ies) to "
+                  f"{ns.baseline}")
+            return 0
+        report = runner.lint(
+            ns.root, paths=paths, readme=ns.readme,
+            baseline=None if ns.no_baseline else ns.baseline,
+            families=families)
+    except (OSError, ValueError, SyntaxError) as e:
+        print(f"photonlint: error: {e}", file=sys.stderr)
+        return 2
+    if ns.format == "json":
+        print(report.format_json())
+    else:
+        print(report.format_text())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
