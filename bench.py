@@ -432,6 +432,63 @@ def _movielens_data(rng, n, n_users, n_movies, d_global,
     return data
 
 
+def _instrumented_warm_pass(run_fn) -> dict:
+    """The shared glmix/game_full warm-pass probe: one warm (everything
+    compiled) training pass with the hot-loop sync telemetry reset around
+    it, then the SAME pass again with span tracing enabled. One policy,
+    two BENCH records — the probes can't drift apart.
+
+    Returns ``run_fn``'s result plus: ``train_secs_warm``, the hot-loop
+    stats dict, ``host_syncs_per_update`` (all instrumented fetch sites /
+    updates; steady-state contract 2.0 = 1 hot-loop epilogue + 1
+    amortized sweep-boundary drain), ``hot_loop_syncs_per_update``
+    (contract 1.0), the per-site fetch breakdown, the warm pass's retrace
+    delta (steady-state contract 0 — a warm retrace is an
+    instrumentation/compile-cache regression), and the traced pass's
+    ``train_secs_traced`` / ``trace_overhead_pct`` (the smoke test
+    asserts < 2% on a repetition-median basis; this single-shot record
+    tracks the trend)."""
+    from photon_ml_tpu.game import coordinate_descent as cd_mod
+    from photon_ml_tpu.obs import trace as obs_trace
+    from photon_ml_tpu.obs.metrics import REGISTRY as obs_registry
+    from photon_ml_tpu.utils import sync_telemetry
+
+    retraces_start = obs_registry.counter("retraces").total()
+    cd_mod.reset_hot_loop_stats()
+    sync_telemetry.reset_host_fetches()
+    t0 = time.perf_counter()
+    result = run_fn()
+    train_secs_warm = time.perf_counter() - t0
+    # snapshot the warm pass's telemetry BEFORE the traced probe runs the
+    # same pass again (it records fetches/retraces of its own)
+    hot = dict(cd_mod.HOT_LOOP_STATS)
+    host_syncs_per_update = (sync_telemetry.host_fetch_count()
+                             / hot["updates"] if hot["updates"] else None)
+    hot_loop_syncs_per_update = (hot["epilogue_fetches"] / hot["updates"]
+                                 if hot["updates"] else None)
+    host_fetch_sites = sync_telemetry.host_fetches_by_site()
+    retraces = int(obs_registry.counter("retraces").total()
+                   - retraces_start)
+
+    obs_trace.enable()
+    t0 = time.perf_counter()
+    run_fn()
+    train_secs_traced = time.perf_counter() - t0
+    obs_trace.disable()
+    return {
+        "result": result,
+        "train_secs_warm": train_secs_warm,
+        "hot": hot,
+        "host_syncs_per_update": host_syncs_per_update,
+        "hot_loop_syncs_per_update": hot_loop_syncs_per_update,
+        "host_fetch_sites": host_fetch_sites,
+        "retraces": retraces,
+        "train_secs_traced": train_secs_traced,
+        "trace_overhead_pct": (100.0 * (train_secs_traced - train_secs_warm)
+                               / train_secs_warm),
+    }
+
+
 def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
                 active_cap=128, feature_cap=128, num_buckets=4) -> dict:
     """Config 4: fixed + per-user logistic GAME on MovieLens-1M-shaped data,
@@ -526,31 +583,26 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     # sweep-boundary drain; the hot-loop-only metric's contract is 1.0 —
     # a lazy-materialization regression pushes either higher), and the
     # dispatch-vs-fetch-wait wall-clock split.
-    from photon_ml_tpu.game import coordinate_descent as cd_mod
-    from photon_ml_tpu.utils import sync_telemetry
-
-    cd_mod.reset_hot_loop_stats()
-    sync_telemetry.reset_host_fetches()
-    t0 = time.perf_counter()
-    result_warm = run_coordinate_descent(
+    probe = _instrumented_warm_pass(lambda: run_coordinate_descent(
         coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
-        labels=labels_j, weights=weights_j, offsets=offsets_j)
-    train_secs_warm = time.perf_counter() - t0
+        labels=labels_j, weights=weights_j, offsets=offsets_j))
+    result_warm = probe["result"]
+    train_secs_warm = probe["train_secs_warm"]
     sweep_secs_warm = [round(h.seconds, 2) for h in result_warm.states]
-    hot = dict(cd_mod.HOT_LOOP_STATS)
-    # total = hot-loop epilogue fetches (exactly 1/update) + the per-sweep
-    # tracker drains (1/coordinate/sweep = 1 amortized per update), so the
-    # steady-state contract value is 2.0; any lazy-materialization
-    # regression pushes it higher.
-    host_syncs_per_update = (sync_telemetry.host_fetch_count()
-                             / hot["updates"] if hot["updates"] else None)
-    hot_loop_syncs_per_update = (hot["epilogue_fetches"] / hot["updates"]
-                                 if hot["updates"] else None)
+    hot = probe["hot"]
+    host_syncs_per_update = probe["host_syncs_per_update"]
+    hot_loop_syncs_per_update = probe["hot_loop_syncs_per_update"]
+    host_fetch_sites = probe["host_fetch_sites"]
+    retraces = probe["retraces"]
+    train_secs_traced = probe["train_secs_traced"]
+    trace_overhead_pct = probe["trace_overhead_pct"]
     _progress(f"glmix train cold {train_secs:.1f}s / warm "
               f"{train_secs_warm:.1f}s (compile overhead "
               f"{train_secs - train_secs_warm:.1f}s, "
               f"{host_syncs_per_update} host sync(s)/update incl "
-              f"sweep-boundary drains)")
+              f"sweep-boundary drains, {retraces} retrace(s))")
+    _progress(f"glmix traced warm {train_secs_traced:.1f}s "
+              f"(overhead {trace_overhead_pct:+.1f}%)")
 
     # Steady-state per-stage attribution of one RE update (everything is
     # already compiled at these shapes): offset gather (sample->entity
@@ -626,6 +678,14 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
         # epilogue)
         "host_syncs_per_update": host_syncs_per_update,
         "host_syncs_per_update_hot_loop": hot_loop_syncs_per_update,
+        # per-site breakdown of the warm run's instrumented fetches
+        # (labeled host_fetches counter; values sum to the legacy total)
+        "host_fetch_sites": host_fetch_sites,
+        # compile pressure paid by this bench (epilogue-cache misses +
+        # new bucketed-dispatch shapes) and the cost of tracing the warm
+        # pass (span instrumentation regression guard)
+        "retraces": retraces,
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
         "hot_loop_wallclock_split_secs": {
             "update_dispatch": round(hot["update_dispatch_secs"], 3),
             "epilogue_wait": round(hot["epilogue_wait_secs"], 3),
@@ -725,23 +785,22 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
         coords, num_iterations=1, task=task,
         labels=labels_j, weights=weights_j, offsets=offsets_j)
     train_secs = time.perf_counter() - t0
-    # compile vs steady-state attribution (same policy as bench_glmix),
-    # with the warm pass carrying the hot-loop sync telemetry
-    from photon_ml_tpu.game import coordinate_descent as cd_mod
-    from photon_ml_tpu.utils import sync_telemetry
-
-    cd_mod.reset_hot_loop_stats()
-    sync_telemetry.reset_host_fetches()
-    t0 = time.perf_counter()
-    run_coordinate_descent(coords, num_iterations=1, task=task,
-                           labels=labels_j, weights=weights_j,
-                           offsets=offsets_j)
-    train_secs_warm = time.perf_counter() - t0
-    hot = dict(cd_mod.HOT_LOOP_STATS)
-    host_syncs_per_update = (sync_telemetry.host_fetch_count()
-                             / hot["updates"] if hot["updates"] else None)
-    hot_loop_syncs_per_update = (hot["epilogue_fetches"] / hot["updates"]
-                                 if hot["updates"] else None)
+    # compile vs steady-state attribution: the shared warm-pass probe
+    # carries the hot-loop sync telemetry and the tracing-overhead run
+    probe = _instrumented_warm_pass(
+        lambda: run_coordinate_descent(coords, num_iterations=1, task=task,
+                                       labels=labels_j, weights=weights_j,
+                                       offsets=offsets_j))
+    train_secs_warm = probe["train_secs_warm"]
+    hot = probe["hot"]
+    host_syncs_per_update = probe["host_syncs_per_update"]
+    hot_loop_syncs_per_update = probe["hot_loop_syncs_per_update"]
+    host_fetch_sites = probe["host_fetch_sites"]
+    retraces = probe["retraces"]
+    train_secs_traced = probe["train_secs_traced"]
+    trace_overhead_pct = probe["trace_overhead_pct"]
+    _progress(f"game-full traced warm {train_secs_traced:.1f}s "
+              f"(overhead {trace_overhead_pct:+.1f}%)")
 
     # MF scoring pass: replicated factor tables, one jitted gather+dot
     # (MatrixFactorizationModel.scala:50,141's RDD join as a device gather).
@@ -774,6 +833,9 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
         "compile_overhead_secs": round(train_secs - train_secs_warm, 2),
         "host_syncs_per_update": host_syncs_per_update,
         "host_syncs_per_update_hot_loop": hot_loop_syncs_per_update,
+        "host_fetch_sites": host_fetch_sites,
+        "retraces": retraces,
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
         "hot_loop_wallclock_split_secs": {
             "update_dispatch": round(hot["update_dispatch_secs"], 3),
             "epilogue_wait": round(hot["epilogue_wait_secs"], 3),

@@ -76,6 +76,16 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     # service needed.
     p.add_argument("--num-processes", type=int, default=1)
     p.add_argument("--process-id", type=int, default=0)
+    # Observability (photon_ml_tpu/obs): same contract as the training
+    # driver's --trace-dir — trace.json + spans.jsonl + metrics.jsonl +
+    # run_manifest.json, per-process suffixed under --num-processes > 1.
+    p.add_argument("--trace-dir",
+                   help="enable span tracing/metrics for this run and "
+                        "write trace.json (Chrome trace events), "
+                        "spans.jsonl, metrics.jsonl and "
+                        "run_manifest.json here")
+    p.add_argument("--trace-heartbeat-seconds", type=float, default=10.0)
+    p.add_argument("--trace-stall-seconds", type=float, default=120.0)
     return p.parse_args(argv)
 
 
@@ -212,12 +222,19 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     enable_persistent_compile_cache()
     ns = parse_args(argv if argv is not None else sys.argv[1:])
     driver = GameScoringDriver(ns)
+    from photon_ml_tpu.obs.run import start_observed_run_from_flags
+
+    obs_run = start_observed_run_from_flags(
+        ns, process_index=ns.process_id, num_processes=ns.num_processes,
+        warn=driver.logger.warn)
     try:
         driver.run()
     except Exception as e:
         driver.logger.error(f"GAME scoring failed: {e}")
         raise
     finally:
+        if obs_run is not None:
+            obs_run.finish()
         driver.logger.close()
 
 

@@ -276,6 +276,24 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    help="seconds without a peer heartbeat before the "
                         "cluster declares that process dead and errors "
                         "pending collectives")
+    # Observability (photon_ml_tpu/obs): span tracing + metrics + run
+    # manifest + stall heartbeat, all scoped to this run.
+    p.add_argument("--trace-dir",
+                   help="enable span tracing/metrics for this run and "
+                        "write trace.json (Chrome trace events, "
+                        "Perfetto-loadable), spans.jsonl, metrics.jsonl "
+                        "(live heartbeat + final counters) and "
+                        "run_manifest.json here; multi-host processes "
+                        "write trace.<process_index>.json etc.")
+    p.add_argument("--trace-heartbeat-seconds", type=float, default=10.0,
+                   help="with --trace-dir: append a progress record to "
+                        "metrics.jsonl every N seconds (<= 0 disables "
+                        "the heartbeat thread)")
+    p.add_argument("--trace-stall-seconds", type=float, default=120.0,
+                   help="with --trace-dir: flag the run STALLED when no "
+                        "span closes within this window (logged, counted "
+                        "on the 'stalls' metric, marked in the heartbeat "
+                        "records)")
     return p.parse_args(argv)
 
 
@@ -533,6 +551,11 @@ class GameTrainingDriver:
             events = EventEmitter()
             events.register_listener(
                 lambda e: self.logger.warn(f"recovery event: {e}"))
+            # fault/recovery/quarantine counts land in metrics.jsonl via
+            # the event-bus → metrics bridge
+            from photon_ml_tpu.obs.bridge import MetricsEventListener
+
+            events.register_listener(MetricsEventListener())
         for gi, (f_cfgs, r_cfgs, fac_cfgs) in enumerate(combos):
             desc = (f"grid[{gi}]: fixed={ {k: v.render() for k, v in f_cfgs.items()} } "
                     f"random={ {k: v.render() for k, v in r_cfgs.items()} }")
@@ -740,6 +763,16 @@ def _run_multihost(ns: argparse.Namespace) -> None:
     driver = GameTrainingDriver(ns, logger=PhotonLogger(
         os.path.join(ns.output_dir,
                      f"game-training.p{ns.process_id}.log"), echo=False))
+    # per-process observability: each gang member writes its own
+    # trace.<process_index>.json / metrics.<process_index>.jsonl; a
+    # supervisor-relaunched worker preserves the crashed incarnation's
+    # heartbeat/span evidence instead of truncating it
+    from photon_ml_tpu.obs.run import start_observed_run_from_flags
+
+    obs_run = start_observed_run_from_flags(
+        ns, process_index=ns.process_id, num_processes=ns.num_processes,
+        warn=driver.logger.warn,
+        preserve_existing=bool(os.environ.get(_SUPERVISED_ENV)))
     try:
         driver.prepare_feature_maps()
         fixed_ids = [c for c in driver.updating_sequence
@@ -836,6 +869,8 @@ def _run_multihost(ns: argparse.Namespace) -> None:
         driver.logger.error(f"multi-host GAME training failed: {e}")
         raise
     finally:
+        if obs_run is not None:
+            obs_run.finish()
         driver.logger.close()
 
 
@@ -901,12 +936,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             return _run_supervised(ns, argv)
         return _run_multihost(ns)
     driver = GameTrainingDriver(ns)
+    from photon_ml_tpu.obs.run import start_observed_run_from_flags
+
+    obs_run = start_observed_run_from_flags(ns, warn=driver.logger.warn)
     try:
         driver.run()
     except Exception as e:
         driver.logger.error(f"GAME training failed: {e}")
         raise
     finally:
+        if obs_run is not None:
+            obs_run.finish()
         driver.logger.close()
 
 

@@ -153,7 +153,7 @@ def evaluate(
     """
     value = jax.device_get(_device_metric(
         spec, scores, labels, weights, entity_ids, num_entities))
-    record_host_fetch()
+    record_host_fetch(site="eval.metric")
     return float(value)
 
 
@@ -205,7 +205,7 @@ def evaluate_many(
         device_vals.append(_device_metric(
             spec, scores, labels, weights, eid, nent))
     fetched = jax.device_get(tuple(device_vals))
-    record_host_fetch()
+    record_host_fetch(site="eval.metrics")
     return {spec.name: float(v) for spec, v in zip(specs, fetched)}
 
 

@@ -131,7 +131,7 @@ def evaluate_model_grid(models: Sequence[GeneralizedLinearModel],
     W = jnp.stack([m.coefficients.means for m in models])
     # the whole [num_metrics, L] grid comes back in this one fetch
     packed = jax.device_get(_evaluate_grid_kernel(task, W, batch))
-    record_host_fetch()
+    record_host_fetch(site="eval.grid")
     names = _metric_names(task)
     return [{name: float(packed[j, i]) for j, name in enumerate(names)}
             for i in range(len(models))]

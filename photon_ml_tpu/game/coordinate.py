@@ -113,7 +113,7 @@ class RandomEffectTracker:
                 None if a is None else ensure_addressable(a)
                 for a in (self.iterations, self.final_values,
                           self.convergence_codes)))
-            record_host_fetch()
+            record_host_fetch(site="tracker.materialize")
             nr = self.num_real
             if nr is not None:
                 it, v = it[:nr], v[:nr]

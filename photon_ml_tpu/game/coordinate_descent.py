@@ -39,6 +39,8 @@ from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optimize.config import TASK_LOSS_NAME, TaskType
+from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs.metrics import REGISTRY
 from photon_ml_tpu.utils.events import (
     CoordinateQuarantinedEvent,
     EventEmitter,
@@ -114,6 +116,10 @@ def make_update_epilogue(task: TaskType, num_samples: int):
     ``score_list``/``reg_list`` arrive in updating-sequence order with the
     changed coordinate's entries already substituted.
     """
+    # this body runs only on an lru_cache MISS — i.e. a new (task, N)
+    # shape is about to pay an XLA compile; the counter makes retrace
+    # regressions visible in metrics.jsonl and the bench record
+    REGISTRY.counter("retraces").inc(site="cd.epilogue")
     loss = get_loss(TASK_LOSS_NAME[task])
 
     @jax.jit
@@ -196,7 +202,7 @@ def _state_is_finite(state) -> bool:
     flags = jax.device_get(tuple(
         jnp.all(jnp.isfinite(jnp.asarray(leaf)))
         for leaf in _state_leaves(state)))
-    record_host_fetch()
+    record_host_fetch(site="cd.state_finite")
     return all(bool(f) for f in flags)
 
 
@@ -218,7 +224,7 @@ def training_loss_evaluator(task: TaskType, labels: Array, weights: Array,
     def evaluate(scores: Array) -> float:
         l, _ = loss.loss_and_d1(scores + offsets, labels)
         value = jax.device_get(jnp.sum(weights * l))
-        record_host_fetch()
+        record_host_fetch(site="cd.training_loss")
         return float(value)
 
     return evaluate
@@ -433,9 +439,10 @@ def run_coordinate_descent(
             labels, weights, offsets)  # (:199-205)
         HOT_LOOP_STATS["update_dispatch_secs"] += time.perf_counter() - t0
         t0 = time.perf_counter()
-        objective, train_loss, finite, state_finite = jax.device_get(
-            (objective_d, train_loss_d, finite_d, state_finite_d))
-        record_host_fetch()
+        with trace.span("cd.epilogue_fetch", coordinate=cid, sweep=it):
+            objective, train_loss, finite, state_finite = jax.device_get(
+                (objective_d, train_loss_d, finite_d, state_finite_d))
+        record_host_fetch(site="cd.epilogue")
         HOT_LOOP_STATS["epilogue_wait_secs"] += time.perf_counter() - t0
         HOT_LOOP_STATS["epilogue_fetches"] += 1
         HOT_LOOP_STATS["updates"] += 1
@@ -470,7 +477,7 @@ def run_coordinate_descent(
             "scores": {cid: scores[cid] for cid in ids},
             "best_states": best_states,
         })
-        record_host_fetch()
+        record_host_fetch(site="ckpt.snapshot")
         checkpoint_manager.save(step, {
             "sweep": sweep,
             "coordinate_index": next_ci,
@@ -491,162 +498,174 @@ def run_coordinate_descent(
         })
         last_saved_step = step
 
-    for it in range(start_iteration, num_iterations):
-        fault_point("cd.sweep", tag=str(it))
-        sweep_history_start = len(history)
-        for ci, cid in enumerate(ids):
-            if it == start_iteration and ci < start_coordinate:
-                continue  # mid-sweep resume: these updates already ran
-            if cid in quarantined:
-                continue  # frozen at last-good state
-            t0 = time.time()
-            attempt = 0
-            skipped = False
-            budgeted_skip = False
-            quarantine_now = False
-            while True:
-                try:
-                    (cand, tracker, new_score, new_reg, new_total,
-                     objective, _train_loss) = attempt_update(
-                        cid, ci, it, attempt)
-                    break
-                except (InjectedFault, CoordinateDivergenceError,
-                        FloatingPointError) as e:
-                    if recovery is None:
-                        raise
-                    # an InjectedFault knows its origin site (e.g.
-                    # "optimizer.gradient"); label divergence detected
-                    # here as cd.update
-                    emit(FaultEvent(point=getattr(e, "point", "cd.update"),
-                                    coordinate_id=cid,
-                                    iteration=it, message=str(e)))
-                    log(lambda: f"iter {it} coordinate {cid}: FAULT "
-                        f"(attempt {attempt}): {e}")
-                    attempt += 1
-                    if attempt <= recovery.max_retries:
-                        emit(RecoveryEvent(action="retried",
-                                           coordinate_id=cid, iteration=it,
-                                           attempts=attempt))
-                        continue
-                    if recovery.quarantine_after > 0:
-                        # per-coordinate budget: skip degraded until THIS
-                        # coordinate's own budget exhausts, then freeze it
-                        # (the global on_exhausted action never fires for
-                        # budgeted coordinates — that is the point, and
-                        # budgeted skips don't count toward the global
-                        # consecutive-failure abort either)
-                        coordinate_failures[cid] = (
-                            coordinate_failures.get(cid, 0) + 1)
-                        if (coordinate_failures[cid]
-                                >= recovery.quarantine_after):
-                            quarantine_now = True
-                        else:
-                            skipped = True
-                            budgeted_skip = True
-                        break
-                    if recovery.on_exhausted == "skip":
+    def run_update(ci, cid, it):
+        """One guarded coordinate update (retry loop + bookkeeping +
+        optional validation) under its ``cd.update`` span."""
+        nonlocal total, consecutive_failures
+        nonlocal best_metric, best_model, best_states
+        t0 = time.time()
+        attempt = 0
+        skipped = False
+        budgeted_skip = False
+        quarantine_now = False
+        while True:
+            try:
+                (cand, tracker, new_score, new_reg, new_total,
+                 objective, _train_loss) = attempt_update(
+                    cid, ci, it, attempt)
+                break
+            except (InjectedFault, CoordinateDivergenceError,
+                    FloatingPointError) as e:
+                if recovery is None:
+                    raise
+                # an InjectedFault knows its origin site (e.g.
+                # "optimizer.gradient"); label divergence detected
+                # here as cd.update
+                emit(FaultEvent(point=getattr(e, "point", "cd.update"),
+                                coordinate_id=cid,
+                                iteration=it, message=str(e)))
+                log(lambda: f"iter {it} coordinate {cid}: FAULT "
+                    f"(attempt {attempt}): {e}")
+                attempt += 1
+                if attempt <= recovery.max_retries:
+                    emit(RecoveryEvent(action="retried",
+                                       coordinate_id=cid, iteration=it,
+                                       attempts=attempt))
+                    continue
+                if recovery.quarantine_after > 0:
+                    # per-coordinate budget: skip degraded until THIS
+                    # coordinate's own budget exhausts, then freeze it
+                    # (the global on_exhausted action never fires for
+                    # budgeted coordinates — that is the point, and
+                    # budgeted skips don't count toward the global
+                    # consecutive-failure abort either)
+                    coordinate_failures[cid] = (
+                        coordinate_failures.get(cid, 0) + 1)
+                    if (coordinate_failures[cid]
+                            >= recovery.quarantine_after):
+                        quarantine_now = True
+                    else:
                         skipped = True
-                        break
-                    raise RuntimeError(
-                        f"coordinate descent aborted: coordinate {cid} "
-                        f"failed {attempt} attempt(s) at iteration {it} "
-                        f"(RecoveryPolicy on_exhausted='abort')") from e
-            dt = time.time() - t0
-            if quarantine_now:
-                quarantined.add(cid)
-                emit(CoordinateQuarantinedEvent(
-                    coordinate_id=cid, iteration=it,
-                    failures=coordinate_failures[cid],
-                    message=(f"{coordinate_failures[cid]} exhausted "
-                             f"update(s); frozen at last-good state")))
-                log(lambda: f"iter {it} coordinate {cid}: QUARANTINED after "
-                    f"{coordinate_failures[cid]} exhausted update(s) — "
-                    f"frozen at last-good state, descent continues "
-                    f"({dt:.2f}s)")
-                if checkpoint_manager is not None:
-                    save_snapshot(it, ci + 1)
-                continue
-            if skipped:
-                # Keep the last-good state and its score; continue degraded
-                # (the reference's closest analog: a failed Spark stage
-                # retried elsewhere — here the coordinate just sits out).
-                # A BUDGETED skip is bounded by the coordinate's own
-                # quarantine budget, so it must not also burn the global
-                # consecutive-failure budget (it would abort the run
-                # before the quarantine ever triggered).
-                if not budgeted_skip:
-                    consecutive_failures += 1
-                emit(RecoveryEvent(action="skipped", coordinate_id=cid,
+                        budgeted_skip = True
+                    break
+                if recovery.on_exhausted == "skip":
+                    skipped = True
+                    break
+                raise RuntimeError(
+                    f"coordinate descent aborted: coordinate {cid} "
+                    f"failed {attempt} attempt(s) at iteration {it} "
+                    f"(RecoveryPolicy on_exhausted='abort')") from e
+        dt = time.time() - t0
+        if quarantine_now:
+            quarantined.add(cid)
+            emit(CoordinateQuarantinedEvent(
+                coordinate_id=cid, iteration=it,
+                failures=coordinate_failures[cid],
+                message=(f"{coordinate_failures[cid]} exhausted "
+                         f"update(s); frozen at last-good state")))
+            log(lambda: f"iter {it} coordinate {cid}: QUARANTINED after "
+                f"{coordinate_failures[cid]} exhausted update(s) — "
+                f"frozen at last-good state, descent continues "
+                f"({dt:.2f}s)")
+            if checkpoint_manager is not None:
+                save_snapshot(it, ci + 1)
+            return
+        if skipped:
+            # Keep the last-good state and its score; continue degraded
+            # (the reference's closest analog: a failed Spark stage
+            # retried elsewhere — here the coordinate just sits out).
+            # A BUDGETED skip is bounded by the coordinate's own
+            # quarantine budget, so it must not also burn the global
+            # consecutive-failure budget (it would abort the run
+            # before the quarantine ever triggered).
+            if not budgeted_skip:
+                consecutive_failures += 1
+            emit(RecoveryEvent(action="skipped", coordinate_id=cid,
+                               iteration=it, attempts=attempt))
+            log(lambda: f"iter {it} coordinate {cid}: SKIPPED after "
+                f"{attempt} failed attempt(s) — keeping last-good "
+                f"state ({dt:.2f}s)")
+            if (not budgeted_skip and consecutive_failures
+                    >= recovery.max_consecutive_failures):
+                emit(RecoveryEvent(action="aborted", coordinate_id=cid,
                                    iteration=it, attempts=attempt))
-                log(lambda: f"iter {it} coordinate {cid}: SKIPPED after "
-                    f"{attempt} failed attempt(s) — keeping last-good "
-                    f"state ({dt:.2f}s)")
-                if (not budgeted_skip and consecutive_failures
-                        >= recovery.max_consecutive_failures):
-                    emit(RecoveryEvent(action="aborted", coordinate_id=cid,
-                                       iteration=it, attempts=attempt))
-                    raise RuntimeError(
-                        f"coordinate descent aborted: "
-                        f"{consecutive_failures} consecutive coordinate "
-                        f"updates failed (RecoveryPolicy "
-                        f"max_consecutive_failures="
-                        f"{recovery.max_consecutive_failures})")
-                continue
-            if attempt > 0:
-                emit(RecoveryEvent(action="recovered", coordinate_id=cid,
-                                   iteration=it, attempts=attempt))
-                log(lambda: f"iter {it} coordinate {cid}: recovered after "
-                    f"{attempt} retry(ies)")
-            consecutive_failures = 0
-            states[cid] = cand
-            scores[cid] = new_score
-            reg_cache[cid] = new_reg
-            # canonical (ids order from zero), computed INSIDE the fused
-            # epilogue — never incrementally drifted: resume parity
-            total = new_total
-            log(lambda: f"iter {it} coordinate {cid}: "
-                f"objective={objective:.6f} "
-                f"({dt:.2f}s) — {tracker.summary()}")
+                raise RuntimeError(
+                    f"coordinate descent aborted: "
+                    f"{consecutive_failures} consecutive coordinate "
+                    f"updates failed (RecoveryPolicy "
+                    f"max_consecutive_failures="
+                    f"{recovery.max_consecutive_failures})")
+            return
+        if attempt > 0:
+            emit(RecoveryEvent(action="recovered", coordinate_id=cid,
+                               iteration=it, attempts=attempt))
+            log(lambda: f"iter {it} coordinate {cid}: recovered after "
+                f"{attempt} retry(ies)")
+        consecutive_failures = 0
+        states[cid] = cand
+        scores[cid] = new_score
+        reg_cache[cid] = new_reg
+        # canonical (ids order from zero), computed INSIDE the fused
+        # epilogue — never incrementally drifted: resume parity
+        total = new_total
+        log(lambda: f"iter {it} coordinate {cid}: "
+            f"objective={objective:.6f} "
+            f"({dt:.2f}s) — {tracker.summary()}")
 
-            metrics = None
-            if validation_data is not None and validation_evaluator:
+        metrics = None
+        if validation_data is not None and validation_evaluator:
+            with trace.span("cd.validation", coordinate=cid, sweep=it):
                 model = publish_game_model(coordinates, states)
                 val_scores = model.score(validation_data)
                 metrics = validation_evaluator(val_scores)
-                log(lambda: f"iter {it} coordinate {cid}: "
-                    f"validation {metrics}")
-                if validation_metric is not None:
-                    m = metrics[validation_metric]
-                    better = (best_metric is None
-                              or (m > best_metric if higher_is_better
-                                  else m < best_metric))
-                    if better:  # (:245-255)
-                        best_metric, best_model = m, model
-                        best_states = dict(states)
+            log(lambda: f"iter {it} coordinate {cid}: "
+                f"validation {metrics}")
+            if validation_metric is not None:
+                m = metrics[validation_metric]
+                better = (best_metric is None
+                          or (m > best_metric if higher_is_better
+                              else m < best_metric))
+                if better:  # (:245-255)
+                    best_metric, best_model = m, model
+                    best_states = dict(states)
 
-            history.append(CoordinateDescentState(
-                iteration=it, coordinate_id=cid, objective=objective,
-                seconds=dt, tracker=tracker, validation_metrics=metrics))
+        history.append(CoordinateDescentState(
+            iteration=it, coordinate_id=cid, objective=objective,
+            seconds=dt, tracker=tracker, validation_metrics=metrics))
 
-            if (checkpoint_manager is not None
-                    and checkpoint_every_coordinates > 0
-                    and (it * len(ids) + ci + 1)
-                    % checkpoint_every_coordinates == 0):
-                save_snapshot(it, ci + 1)
+        if (checkpoint_manager is not None
+                and checkpoint_every_coordinates > 0
+                and (it * len(ids) + ci + 1)
+                % checkpoint_every_coordinates == 0):
+            save_snapshot(it, ci + 1)
 
-        # Sweep boundary: drain this sweep's lazy trackers (one batched
-        # explicit fetch each, amortized over the whole sweep) so their
-        # device-resident per-entity arrays and solver histories don't
-        # accumulate in HBM across a long run. The per-update hot path
-        # stays at exactly one fetch; this drain is the off-hot-path
-        # counterpart, like the checkpoint below.
-        for h in history[sweep_history_start:]:
-            mat = getattr(h.tracker, "materialize", None)
-            if mat is not None:
-                mat()
+    for it in range(start_iteration, num_iterations):
+        with trace.span("cd.sweep", sweep=it):
+            fault_point("cd.sweep", tag=str(it))
+            sweep_history_start = len(history)
+            for ci, cid in enumerate(ids):
+                if it == start_iteration and ci < start_coordinate:
+                    continue  # mid-sweep resume: these updates already ran
+                if cid in quarantined:
+                    continue  # frozen at last-good state
+                with trace.span("cd.update", coordinate=cid, sweep=it):
+                    run_update(ci, cid, it)
 
-        if checkpoint_manager is not None:
-            save_snapshot(it, len(ids))
+            # Sweep boundary: drain this sweep's lazy trackers (one
+            # batched explicit fetch each, amortized over the whole
+            # sweep) so their device-resident per-entity arrays and
+            # solver histories don't accumulate in HBM across a long
+            # run. The per-update hot path stays at exactly one fetch;
+            # this drain is the off-hot-path counterpart, like the
+            # checkpoint below.
+            with trace.span("cd.tracker_drain", sweep=it):
+                for h in history[sweep_history_start:]:
+                    mat = getattr(h.tracker, "materialize", None)
+                    if mat is not None:
+                        mat()
+
+            if checkpoint_manager is not None:
+                save_snapshot(it, len(ids))
 
     final = publish_game_model(coordinates, states)
     return CoordinateDescentResult(model=final, states=history,

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import DenseBatch
 from photon_ml_tpu.game.dataset import RandomEffectDataset
+from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs.metrics import REGISTRY
 from photon_ml_tpu.ops.aggregators import GLMObjective
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optimize.common import (
@@ -185,6 +187,14 @@ _fit_blocks_donate_offsets_x0 = partial(
 )(_fit_blocks_impl)
 
 
+# (variant, shapes, dtypes, statics) signatures already dispatched: a key
+# not seen before is about to pay an XLA trace+compile (the in-process jit
+# cache misses exactly there), so the ``retraces{site="re.dispatch"}``
+# counter tracks bucketed-dispatch compile pressure — host-side bookkeeping
+# only, no device work.
+_SEEN_DISPATCH_KEYS: set = set()
+
+
 def _dispatch_fit(X, labels, offsets, weights, initial, obj, l1, solver,
                   max_iter, tolerance, donate: bool,
                   donate_x0: bool = False,
@@ -194,6 +204,12 @@ def _dispatch_fit(X, labels, offsets, weights, initial, obj, l1, solver,
     if donate and jax.default_backend() != "cpu":
         fn = (_fit_blocks_donate_offsets_x0 if donate_x0
               else _fit_blocks_donate_offsets)
+    key = (id(fn), tuple(X.shape), str(X.dtype), tuple(initial.shape),
+           str(initial.dtype), solver, max_iter, float(tolerance),
+           boundary_convergence)
+    if key not in _SEEN_DISPATCH_KEYS:
+        _SEEN_DISPATCH_KEYS.add(key)
+        REGISTRY.counter("retraces").inc(site="re.dispatch")
     return fn(X, labels, offsets, weights, initial, obj, l1, solver,
               max_iter, tolerance, boundary_convergence)
 
@@ -218,24 +234,35 @@ def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
     idx: Optional[np.ndarray] = None
     cur = (X, labels, offsets, weights, x0)
     spent = 0
+    chunk_index = 0
     while True:
         budget = min(chunk, max_iter - spent)
+        # span per chunk, labeled with the REAL active-lane count entering
+        # it (not the power-of-two padded dispatch width): the shrinking
+        # sequence IS the iteration histogram the ROADMAP chunk-size
+        # auto-tuner needs, and the ``re_chunk_active_lanes`` histogram
+        # aggregates it across the run
+        active_lanes = int(X.shape[0]) if idx is None else int(len(idx))
         t0 = time.perf_counter()
-        # chunk 1 runs the caller's buffers (which later compactions
-        # re-gather from: never donate them); compacted chunks run
-        # gathered copies this loop owns outright, x0 included. Non-final
-        # chunks classify boundary convergence so a lane converging on its
-        # last budgeted iteration leaves with its true reason instead of
-        # a re-dispatch from its optimum.
-        donate_chunk = donate and idx is not None
-        c, it, v, k = _dispatch_fit(*cur, obj, l1, solver, budget,
-                                    tolerance, donate=donate_chunk,
-                                    donate_x0=donate_chunk,
-                                    boundary_convergence=(
-                                        spent + budget < max_iter))
-        still = state.absorb(idx, c, it, v, k, CONV_MAX_ITERATIONS)
+        with trace.span("re.compact_chunk", chunk=chunk_index,
+                        active_lanes=active_lanes, budget=budget):
+            # chunk 1 runs the caller's buffers (which later compactions
+            # re-gather from: never donate them); compacted chunks run
+            # gathered copies this loop owns outright, x0 included.
+            # Non-final chunks classify boundary convergence so a lane
+            # converging on its last budgeted iteration leaves with its
+            # true reason instead of a re-dispatch from its optimum.
+            donate_chunk = donate and idx is not None
+            c, it, v, k = _dispatch_fit(*cur, obj, l1, solver, budget,
+                                        tolerance, donate=donate_chunk,
+                                        donate_x0=donate_chunk,
+                                        boundary_convergence=(
+                                            spent + budget < max_iter))
+            still = state.absorb(idx, c, it, v, k, CONV_MAX_ITERATIONS)
+        REGISTRY.histogram("re_chunk_active_lanes").observe(active_lanes)
         SOLVE_STATS["solve_secs"] += time.perf_counter() - t0
         SOLVE_STATS["chunks"] += 1
+        chunk_index += 1
         spent += budget
         if spent >= max_iter or len(still) == 0:
             break
@@ -331,8 +358,10 @@ class RandomEffectOptimizationProblem:
             solver = "lbfgs"
 
         if dataset.buckets is not None:
-            return self._run_bucketed(dataset, offsets, initial, solver, l1,
-                                      donate)
+            with trace.span("re.solve", solver=solver, bucketed=True,
+                            entities=int(dataset.num_entities)):
+                return self._run_bucketed(dataset, offsets, initial,
+                                          solver, l1, donate)
 
         e, _, d = dataset.X.shape
         acc = jnp.promote_types(dataset.X.dtype, jnp.float32)
@@ -341,10 +370,12 @@ class RandomEffectOptimizationProblem:
         # wider offset vector (e.g. f64 scores) must not poison the
         # jitted solver's carry dtypes
         offsets = jnp.asarray(offsets, acc)
-        return self._fit(
-            dataset.X, dataset.labels, offsets, dataset.weights, x0,
-            self.objective(), jnp.full(d, l1, x0.dtype), solver,
-            donate and offsets is not dataset.base_offsets)
+        with trace.span("re.solve", solver=solver, bucketed=False,
+                        entities=int(e)):
+            return self._fit(
+                dataset.X, dataset.labels, offsets, dataset.weights, x0,
+                self.objective(), jnp.full(d, l1, x0.dtype), solver,
+                donate and offsets is not dataset.base_offsets)
 
     def _run_bucketed(self, dataset, offsets, initial, solver: str,
                       l1: float, donate: bool = False):

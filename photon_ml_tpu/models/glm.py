@@ -112,7 +112,7 @@ class GeneralizedLinearModel:
         One instrumented fetch of the device-side reduction scalar."""
         flag = jax.device_get(jnp.all(jnp.isfinite(
             self.coefficients.means)))
-        record_host_fetch()
+        record_host_fetch(site="glm.validate")
         return bool(flag)
 
     # -- helpers -------------------------------------------------------------

@@ -1,0 +1,37 @@
+"""Observability layer: span tracing, labeled metrics, run manifests.
+
+One subsystem replacing three disjoint fragments (the bench-only
+wall-clock splits, the single process-global fetch counter, the
+log-only event bus):
+
+- ``obs.trace`` — thread-safe nestable span tracer
+  (``trace.span("cd.update", coordinate=cid)``), exported as Chrome
+  trace-event JSON (Perfetto-loadable) and structured JSONL. Disabled by
+  default; zero jax, zero device syncs.
+- ``obs.metrics`` — counters/gauges/histograms with labels
+  (``REGISTRY``); ``utils/sync_telemetry`` is now a thin shim over the
+  ``host_fetches`` counter, so per-site fetch attribution is free while
+  the legacy ``host_fetch_count()`` total keeps its contract.
+- ``obs.bridge`` — event-bus listener mirroring fault/recovery/
+  quarantine events into counters.
+- ``obs.heartbeat`` — stall-detecting progress records for long runs.
+- ``obs.run`` — the drivers' ``--trace-dir`` integration: run manifest,
+  live heartbeat stream, final trace/metrics flush.
+"""
+
+from photon_ml_tpu.obs import trace  # noqa: F401
+from photon_ml_tpu.obs.bridge import MetricsEventListener  # noqa: F401
+from photon_ml_tpu.obs.heartbeat import Heartbeat  # noqa: F401
+from photon_ml_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from photon_ml_tpu.obs.run import (  # noqa: F401
+    ObservedRun,
+    run_manifest,
+    start_observed_run,
+    start_observed_run_from_flags,
+)

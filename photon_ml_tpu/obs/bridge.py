@@ -1,0 +1,51 @@
+"""Event-bus → metrics bridge: fault/recovery telemetry as counters.
+
+The typed event bus (``utils/events.py``) already announces every fault,
+recovery action, and quarantine; this listener folds those streams into
+the metrics registry so a run's ``metrics.jsonl`` answers "how many
+faults, of what kind, recovered how" without replaying driver logs::
+
+    emitter.register_listener(MetricsEventListener())
+
+Counters written (all on the process registry unless one is injected):
+
+- ``faults{point, coordinate}`` — one per :class:`FaultEvent`
+- ``recoveries{action}`` — retried / recovered / skipped / aborted
+- ``quarantines{coordinate}`` — per-coordinate freeze events
+- ``optimization_logs`` — per-model optimization records (legacy driver)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.utils.events import (
+    CoordinateQuarantinedEvent,
+    Event,
+    FaultEvent,
+    PhotonOptimizationLogEvent,
+    RecoveryEvent,
+)
+
+
+class MetricsEventListener:
+    """EventEmitter listener that mirrors fault-tolerance events into
+    labeled counters (idempotent per event — register it once)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._registry = registry or REGISTRY
+
+    def __call__(self, event: Event) -> None:
+        r = self._registry
+        if isinstance(event, FaultEvent):
+            r.counter("faults").inc(
+                point=event.point, coordinate=event.coordinate_id or "")
+        elif isinstance(event, CoordinateQuarantinedEvent):
+            # before RecoveryEvent: both are terminal records, but a
+            # quarantine is NOT a recovery action
+            r.counter("quarantines").inc(coordinate=event.coordinate_id)
+        elif isinstance(event, RecoveryEvent):
+            r.counter("recoveries").inc(action=event.action)
+        elif isinstance(event, PhotonOptimizationLogEvent):
+            r.counter("optimization_logs").inc()

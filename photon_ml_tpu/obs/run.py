@@ -1,0 +1,231 @@
+"""Run-scoped observability: ``--trace-dir`` integration for the drivers.
+
+:func:`start_observed_run` turns one driver invocation into an observed
+run: it installs the process-global tracer, writes the run manifest
+immediately (a crashed run still leaves provenance behind), starts the
+stall-detecting heartbeat appending live to ``metrics.jsonl`` and
+spilling closed spans live to ``spans.jsonl`` (bounded span buffer;
+a killed run keeps everything spilled so far), and — at
+:meth:`ObservedRun.finish` — rebuilds the Chrome trace from the spill
+and appends the final metrics snapshot::
+
+    <trace-dir>/
+      run_manifest.json   # jax version, backend, devices, flags, git
+      trace.json          # Chrome trace events (Perfetto-loadable)
+      spans.jsonl         # one span per line (jq/pandas-friendly, live)
+      metrics.jsonl       # heartbeat lines (live) + final counter dump
+
+In multi-host runs every process passes its ``process_index`` with
+``num_processes > 1`` and writes ``trace.<i>.json`` /
+``metrics.<i>.jsonl`` / … so a shared trace dir holds the whole gang's
+streams side by side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs.heartbeat import Heartbeat
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+
+def _git_describe(cwd: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--tags"],
+            cwd=cwd, capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout.strip() or None
+
+
+def run_manifest(flags: Optional[dict] = None,
+                 process_index: int = 0,
+                 num_processes: int = 1,
+                 probe_backend: bool = True) -> dict:
+    """Provenance record for one run: versions, backend, devices, the
+    resolved driver flags, and the repo's git-describe (when available).
+
+    ``probe_backend=False`` skips the ``jax.device_count()`` /
+    ``jax.default_backend()`` queries — querying them INITIALIZES the
+    local backend, and a multi-host worker that has not yet called
+    ``jax.distributed.initialize`` must not do that (jax raises
+    "initialize() must be called before any JAX computations" at gang
+    formation). The multi-host ObservedRun writes the manifest with the
+    backend fields deferred and fills them in at finish(), when the gang
+    is long formed."""
+    import jax
+
+    if probe_backend:
+        try:
+            device_count = jax.device_count()
+            backend = jax.default_backend()
+        except RuntimeError:  # backend not initializable (bare host)
+            device_count, backend = 0, "uninitialized"
+    else:
+        device_count, backend = None, "deferred"
+    repo_dir = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return {
+        "kind": "run_manifest",
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "jax_version": jax.__version__,
+        "backend": backend,
+        "device_count": device_count,
+        "process_index": process_index,
+        "num_processes": num_processes,
+        "git_describe": _git_describe(repo_dir),
+        "flags": {} if flags is None else {
+            k: v for k, v in sorted(flags.items())
+            if isinstance(v, (bool, int, float, str, type(None)))},
+    }
+
+
+class ObservedRun:
+    """One driver invocation's tracer + heartbeat + output files.
+
+    Spans spill incrementally: every heartbeat drains the tracer's
+    buffer into ``spans.jsonl``, so a multi-day run's span buffer stays
+    bounded by one heartbeat interval and a killed run keeps everything
+    spilled so far; ``trace.json`` is rebuilt from the spill at
+    :meth:`finish`.
+
+    ``preserve_existing=True`` (a supervisor-relaunched worker) keeps
+    the crashed incarnation's evidence instead of truncating it: the
+    metrics stream is appended to (delimited by a ``run_restart``
+    record — its stalled-heartbeat trail is the postmortem) and prior
+    ``trace.json`` / ``spans.jsonl`` / ``run_manifest.json`` files are
+    rotated to ``<name>.prev`` rather than overwritten.
+    """
+
+    def __init__(self, trace_dir: str,
+                 process_index: int = 0,
+                 num_processes: int = 1,
+                 flags: Optional[dict] = None,
+                 heartbeat_seconds: float = 10.0,
+                 stall_seconds: float = 120.0,
+                 warn: Optional[Callable[[str], None]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 preserve_existing: bool = False):
+        self.trace_dir = trace_dir
+        self._registry = registry or REGISTRY
+        suffix = f".{process_index}" if num_processes > 1 else ""
+        self.trace_path = os.path.join(trace_dir, f"trace{suffix}.json")
+        self.spans_path = os.path.join(trace_dir, f"spans{suffix}.jsonl")
+        self.metrics_path = os.path.join(
+            trace_dir, f"metrics{suffix}.jsonl")
+        self.manifest_path = os.path.join(
+            trace_dir, f"run_manifest{suffix}.json")
+        os.makedirs(trace_dir, exist_ok=True)
+        if preserve_existing:
+            for path in (self.trace_path, self.spans_path,
+                         self.manifest_path):
+                if os.path.exists(path):
+                    os.replace(path, path + ".prev")
+
+        # Multi-host: the worker has NOT called jax.distributed.initialize
+        # yet, and probing the backend here would initialize it locally and
+        # make gang formation raise — defer the backend fields to finish()
+        self._manifest_args = dict(flags=flags,
+                                   process_index=process_index,
+                                   num_processes=num_processes)
+        manifest = run_manifest(probe_backend=(num_processes == 1),
+                                **self._manifest_args)
+        with open(self.manifest_path, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        if preserve_existing and os.path.exists(self.metrics_path):
+            with open(self.metrics_path, "a") as fh:
+                fh.write(json.dumps({
+                    "kind": "run_restart",
+                    "time": time.strftime("%Y-%m-%dT%H:%M:%S")}) + "\n")
+        else:
+            # truncate a prior run's stream: heartbeat + final dump append
+            open(self.metrics_path, "w").close()
+        open(self.spans_path, "w").close()  # this incarnation's spill
+
+        self._spill_lock = threading.Lock()
+        self._pending: list = []  # drained but not yet durably written
+        self.tracer = trace.enable(process_index=process_index)
+        self.heartbeat = Heartbeat(
+            self.tracer, out_path=self.metrics_path,
+            interval_seconds=heartbeat_seconds,
+            stall_seconds=stall_seconds, warn=warn,
+            registry=self._registry, on_beat=self._spill).start()
+        self._finished = False
+
+    def _spill(self) -> None:
+        """Drain the tracer's closed spans into ``spans.jsonl`` (runs on
+        every heartbeat and once more at finish). Drained spans are only
+        discarded once the write succeeds — a transient full disk keeps
+        them pending (capped at the tracer's buffer bound) for the next
+        beat instead of losing the interval."""
+        with self._spill_lock:
+            self._pending.extend(self.tracer.drain())
+            if not self._pending:
+                return
+            cap = self.tracer.max_buffered_spans
+            if len(self._pending) > cap:
+                self.tracer.spans_dropped += len(self._pending) - cap
+                self._pending = self._pending[-cap:]
+            with open(self.spans_path, "a") as fh:
+                for e in self._pending:
+                    fh.write(json.dumps(e) + "\n")
+            self._pending = []
+
+    def finish(self) -> None:
+        """Stop the heartbeat and flush trace + metrics files
+        (idempotent; call from the driver's ``finally``)."""
+        if self._finished:
+            return
+        self._finished = True
+        self.heartbeat.stop()
+        self._spill()
+        if self._manifest_args["num_processes"] > 1:
+            # the gang is formed (or the run is over): the backend can be
+            # probed safely now — rewrite the manifest with the live
+            # backend/device fields the deferred first write skipped
+            with open(self.manifest_path, "w") as fh:
+                json.dump(run_manifest(probe_backend=True,
+                                       **self._manifest_args), fh, indent=1)
+        with open(self.spans_path) as fh:
+            events = [json.loads(line) for line in fh if line.strip()]
+        doc = trace.chrome_document(events, self.tracer.process_index,
+                                    self.tracer.start_unix)
+        with open(self.trace_path, "w") as fh:
+            json.dump(doc, fh)
+        with open(self.metrics_path, "a") as fh:
+            for record in self._registry.snapshot():
+                fh.write(json.dumps(record) + "\n")
+        if trace.get_tracer() is self.tracer:
+            trace.disable()
+
+
+def start_observed_run(trace_dir: str, **kwargs) -> ObservedRun:
+    return ObservedRun(trace_dir, **kwargs)
+
+
+def start_observed_run_from_flags(ns, process_index: int = 0,
+                                  num_processes: int = 1,
+                                  warn=None,
+                                  preserve_existing: bool = False
+                                  ) -> Optional[ObservedRun]:
+    """Install the run-scoped tracer/heartbeat when the parsed driver
+    flags carry ``--trace-dir`` (returns the ObservedRun to finish(), or
+    None) — the one adapter both GAME drivers share."""
+    if not getattr(ns, "trace_dir", None):
+        return None
+    return start_observed_run(
+        ns.trace_dir, process_index=process_index,
+        num_processes=num_processes, flags=vars(ns),
+        heartbeat_seconds=ns.trace_heartbeat_seconds,
+        stall_seconds=ns.trace_stall_seconds, warn=warn,
+        preserve_existing=preserve_existing)

@@ -174,7 +174,7 @@ class DeferredOptimizationResult:
 
             history, progressed = jax.device_get(
                 (self._history, self._progressed))
-            record_host_fetch()
+            record_host_fetch(site="optimizer.history")
             self._result = OptimizationResult.from_history(
                 self.coefficients, history,
                 self._max_iter, self._tolerance, bool(progressed))
@@ -264,7 +264,7 @@ class LaneCompactionState:
             self.iterations = it
             unconverged = np.asarray(
                 jax.device_get(k == max_iterations_code))
-            record_host_fetch()
+            record_host_fetch(site="re.compact_mask")
             return self.active[unconverged]
         n_real = len(idx)
         idx_dev = jax.device_put(idx)
@@ -274,7 +274,7 @@ class LaneCompactionState:
         self.codes = self.codes.at[idx_dev].set(k[:n_real])
         unconverged = np.asarray(
             jax.device_get(k[:n_real] == max_iterations_code))
-        record_host_fetch()
+        record_host_fetch(site="re.compact_mask")
         return idx[unconverged]
 
     def results(self) -> tuple[Array, Array, Array, Array]:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import Batch
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.obs import trace
 from photon_ml_tpu.ops.aggregators import GLMObjective
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.ops.normalization import NormalizationContext
@@ -176,13 +177,17 @@ class GLMOptimizationProblem:
         if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
             from photon_ml_tpu.parallel.distributed import run_glm_shard_map
 
-            model, result = run_glm_shard_map(self, batch, mesh,
-                                              initial=initial)
+            with trace.span("optimizer.solve", backend="shard_map",
+                            optimizer=self.config.optimizer_type.name):
+                model, result = run_glm_shard_map(self, batch, mesh,
+                                                  initial=initial)
         else:
             dim = batch.num_features
             x0 = solver_x0(batch.acc_dtype, dim, initial)
             obj = self.objective()
-            x, history, progressed = self.solve(obj, batch, x0)
+            with trace.span("optimizer.solve", backend="local",
+                            optimizer=self.config.optimizer_type.name):
+                x, history, progressed = self.solve(obj, batch, x0)
             model, result = self.publish(x, history, progressed, obj, batch)
         # Host-level fault site (never inside the jitted solve, where an
         # injection would bake into the compile cache): a nan-mode fault
@@ -216,7 +221,11 @@ class GLMOptimizationProblem:
         dim = batch.num_features
         x0 = solver_x0(batch.acc_dtype, dim, initial)
         obj = self.objective()
-        x, history, progressed = self.solve(obj, batch, x0)
+        # the solve DISPATCHES here (async); the span measures host-side
+        # dispatch time, the deferred result's fetch is a separate site
+        with trace.span("optimizer.solve", backend="lazy",
+                        optimizer=self.config.optimizer_type.name):
+            x, history, progressed = self.solve(obj, batch, x0)
         x = fault_point("optimizer.gradient", arrays=x)
         cfg = self.config
         return DeferredOptimizationResult(

@@ -211,7 +211,7 @@ def run_game_step(
     from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
 
     mf_scores = np.asarray(jax.device_get(mf_score(rf, cf, r_codes, c_codes)))
-    record_host_fetch()
+    record_host_fetch(site="multichip.parity")
     # parity with the model's host-side scoring path
     data.encode_ids("itemId", items)
     np.testing.assert_allclose(

@@ -25,6 +25,8 @@ import argparse
 
 import numpy as np
 
+from photon_ml_tpu.obs import trace
+
 
 def _distributed_initialize(coordinator: str, num_processes: int,
                             process_id: int,
@@ -45,8 +47,13 @@ def _distributed_initialize(coordinator: str, num_processes: int,
                   initialization_timeout=initialization_timeout,
                   heartbeat_timeout_seconds=heartbeat_timeout)
     params = inspect.signature(jax.distributed.initialize).parameters
-    jax.distributed.initialize(
-        **{k: v for k, v in kwargs.items() if k in params})
+    # gang formation AND re-formation trace here: a supervisor-relaunched
+    # worker re-enters this span on its way back into the gang, so the
+    # trace shows how long each (re-)join blocked on the coordinator
+    with trace.span("gang.form", process=process_id,
+                    num_processes=num_processes):
+        jax.distributed.initialize(
+            **{k: v for k, v in kwargs.items() if k in params})
 
 
 def _synthetic(rows: int, dim: int, seed: int):

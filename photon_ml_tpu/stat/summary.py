@@ -75,7 +75,7 @@ def summarize(X) -> BasicStatisticalSummary:
     # every per-column statistic returns in ONE instrumented fetch
     # instead of a blocking np.asarray per statistic
     stats = jax.device_get(_column_stats(X))
-    record_host_fetch()
+    record_host_fetch(site="stat.summary")
     return BasicStatisticalSummary(count=int(X.shape[0]), **stats)
 
 

@@ -43,6 +43,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from photon_ml_tpu.obs import trace
 from photon_ml_tpu.utils.faults import fault_point, hits as fault_hits
 
 _MANIFEST = "manifest.json"
@@ -203,34 +204,35 @@ class CheckpointManager:
     def save(self, step: int, state: Any) -> None:
         """Durable and atomic: write + checksum + fsync into a tmp dir,
         then rename; the manifest carries the data files' crc32s."""
-        final = self._step_dir(step)
-        tmp = final + _TMP_SUFFIX
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
-        arrays: dict[str, np.ndarray] = {}
-        skeleton = _flatten(state, "root", arrays)
-        arrays_path = os.path.join(tmp, _ARRAYS)
-        np.savez(arrays_path, **arrays)
-        _fsync_file(arrays_path)
-        # manifest written LAST: its presence marks the step complete
-        with open(os.path.join(tmp, _MANIFEST), "w") as fh:
-            json.dump({"step": step, "format_version": 2,
-                       "checksums": {_ARRAYS: _file_crc32(arrays_path)},
-                       "skeleton": skeleton}, fh)
-            fh.flush()
-            os.fsync(fh.fileno())
-        fired_before = fault_hits("ckpt.save")
-        fault_point("ckpt.save", path=tmp)
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)
-        _fsync_dir(self.directory)
-        # the bytes just checksummed+fsync'd are known-good unless a
-        # ckpt.save drill tampered with them — skip re-reading them in
-        # retention's verified-step scan on the common path
-        self._retain(trusted_step=(
-            None if fault_hits("ckpt.save") != fired_before else step))
+        with trace.span("ckpt.save", step=step):
+            final = self._step_dir(step)
+            tmp = final + _TMP_SUFFIX
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            arrays: dict[str, np.ndarray] = {}
+            skeleton = _flatten(state, "root", arrays)
+            arrays_path = os.path.join(tmp, _ARRAYS)
+            np.savez(arrays_path, **arrays)
+            _fsync_file(arrays_path)
+            # manifest written LAST: its presence marks the step complete
+            with open(os.path.join(tmp, _MANIFEST), "w") as fh:
+                json.dump({"step": step, "format_version": 2,
+                           "checksums": {_ARRAYS: _file_crc32(arrays_path)},
+                           "skeleton": skeleton}, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            fired_before = fault_hits("ckpt.save")
+            fault_point("ckpt.save", path=tmp)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            _fsync_dir(self.directory)
+            # the bytes just checksummed+fsync'd are known-good unless a
+            # ckpt.save drill tampered with them — skip re-reading them in
+            # retention's verified-step scan on the common path
+            self._retain(trusted_step=(
+                None if fault_hits("ckpt.save") != fired_before else step))
 
     def raise_if_all_corrupt(self) -> None:
         """Raise :class:`CheckpointCorruptionError` when the directory
@@ -268,26 +270,28 @@ class CheckpointManager:
         mirror image of the ``ckpt.save`` drill. The integrity scan is
         re-run only when a fault actually fired (the hit counter moved) —
         the common restore pays for ONE scan."""
-        explicit = step is not None
-        if not explicit:
-            step = self._latest_valid_or_raise()
-        fired_before = fault_hits("ckpt.restore")
-        fault_point("ckpt.restore", path=self._step_dir(step))
-        if explicit:
-            if not self.verify_step(step):
-                raise CheckpointCorruptionError(
-                    f"checkpoint step {step} under {self.directory} "
-                    f"failed integrity verification")
-        elif fault_hits("ckpt.restore") != fired_before:
-            # a drill just touched the chosen step: re-resolve so a
-            # corrupt-mode fault exercises the real fallback path
-            step = self._latest_valid_or_raise()
-        d = self._step_dir(step)
-        with open(os.path.join(d, _MANIFEST)) as fh:
-            manifest = json.load(fh)
-        with np.load(os.path.join(d, _ARRAYS)) as npz:
-            arrays = {k: npz[k] for k in npz.files}
-        return _unflatten(manifest["skeleton"], arrays)
+        with trace.span("ckpt.restore",
+                        step=(-1 if step is None else step)):
+            explicit = step is not None
+            if not explicit:
+                step = self._latest_valid_or_raise()
+            fired_before = fault_hits("ckpt.restore")
+            fault_point("ckpt.restore", path=self._step_dir(step))
+            if explicit:
+                if not self.verify_step(step):
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {step} under {self.directory} "
+                        f"failed integrity verification")
+            elif fault_hits("ckpt.restore") != fired_before:
+                # a drill just touched the chosen step: re-resolve so a
+                # corrupt-mode fault exercises the real fallback path
+                step = self._latest_valid_or_raise()
+            d = self._step_dir(step)
+            with open(os.path.join(d, _MANIFEST)) as fh:
+                manifest = json.load(fh)
+            with np.load(os.path.join(d, _ARRAYS)) as npz:
+                arrays = {k: npz[k] for k in npz.files}
+            return _unflatten(manifest["skeleton"], arrays)
 
     def _retain(self, trusted_step: Optional[int] = None) -> None:
         """Prune to the newest ``max_to_keep`` steps — but never

@@ -95,6 +95,19 @@ class CoordinateQuarantinedEvent(Event):
 
 EventListener = Callable[[Event], None]
 
+_ERROR_LOGGER = None
+
+
+def _error_logger():
+    """Module-level fallback logger for contained listener failures
+    (stderr-only; created lazily so importing this module stays cheap)."""
+    global _ERROR_LOGGER
+    if _ERROR_LOGGER is None:
+        from photon_ml_tpu.utils.logging import PhotonLogger
+
+        _ERROR_LOGGER = PhotonLogger(log_path=None, echo=True)
+    return _ERROR_LOGGER
+
 
 class EventEmitter:
     """event/EventEmitter.scala analog: registration + locked dispatch."""
@@ -120,10 +133,26 @@ class EventEmitter:
         self.register_listener(listener)
 
     def send_event(self, event: Event) -> None:
+        """Dispatch ``event`` to every listener. A listener exception is
+        CONTAINED: it is logged (utils/logging) and counted on the
+        ``listener_errors`` metric instead of propagating into the
+        training loop that emitted the event — a broken log shipper must
+        not kill a multi-hour run — and the remaining listeners still
+        run."""
         with self._lock:
             listeners = list(self._listeners)
         for listener in listeners:
-            listener(event)
+            try:
+                listener(event)
+            except Exception as e:  # noqa: BLE001 — containment is the point
+                from photon_ml_tpu.obs.metrics import REGISTRY
+
+                name = getattr(listener, "__qualname__",
+                               type(listener).__name__)
+                REGISTRY.counter("listener_errors").inc(listener=name)
+                _error_logger().warn(
+                    f"event listener {name!r} raised on "
+                    f"{type(event).__name__}: {e!r} (contained)")
 
     def clear_listeners(self) -> None:
         with self._lock:

@@ -111,10 +111,15 @@ class Timer:
 
 @contextlib.contextmanager
 def timed_phase(name: str, logger: Optional[PhotonLogger] = None):
-    """Driver-phase timing idiom (cli/game/training/Driver.scala:648-711)."""
+    """Driver-phase timing idiom (cli/game/training/Driver.scala:648-711).
+    Also opens a ``driver.phase`` span, so every driver phase lands in the
+    run's trace when ``--trace-dir`` is on (no-op otherwise)."""
+    from photon_ml_tpu.obs import trace
+
     t = Timer().start()
     try:
-        yield t
+        with trace.span("driver.phase", phase=name):
+            yield t
     finally:
         t.stop()
         if logger:

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace produced by ``--trace-dir``.
+
+Reads a ``trace.json`` (or ``trace.<process_index>.json``) written by
+``photon_ml_tpu/obs`` and prints:
+
+1. the top-N span names by SELF time (total minus time spent in child
+   spans on the same thread — timestamp containment defines nesting, so
+   the report works on any Chrome trace with complete "X" events), and
+2. per-coordinate sweep attribution: how much wall-clock each coordinate's
+   ``cd.update`` spans cost per sweep — the "which coordinate ate the
+   sweep" question the observability layer exists to answer.
+
+Exit codes: 0 = report printed, 2 = unreadable/empty/invalid trace.
+
+Usage::
+
+    python tools/trace_report.py out/trace/trace.json [--top 15]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list[dict]:
+    """Complete ("ph": "X") events from a Chrome trace file (object with
+    ``traceEvents`` or a bare event array)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError("not a Chrome trace: traceEvents is not a list")
+    out = []
+    for e in events:
+        if (isinstance(e, dict) and e.get("ph") == "X"
+                and "ts" in e and "name" in e):
+            out.append(e)
+    return out
+
+
+def self_times(events: list[dict]) -> dict[str, dict]:
+    """Per-name {count, total_us, self_us} via a containment sweep per
+    (pid, tid): an event's self time is its duration minus its DIRECT
+    children's durations."""
+    stats: dict[str, dict] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0})
+    by_track: dict[tuple, list[dict]] = defaultdict(list)
+    for e in events:
+        by_track[(e.get("pid", 0), e.get("tid", 0))].append(e)
+    for track in by_track.values():
+        # sort by start asc, then duration desc so parents precede their
+        # children that start at the identical timestamp
+        track.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: list[tuple[float, float, list]] = []  # (end, dur, child_durs)
+        for e in track:
+            ts, dur = float(e["ts"]), float(e.get("dur", 0.0))
+            while stack and ts >= stack[-1][0] - 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1][2].append(dur)
+            child_durs: list = []
+            stack.append((ts + dur, dur, child_durs))
+            s = stats[e["name"]]
+            s["count"] += 1
+            s["total_us"] += dur
+            # children are appended as later events arrive; record the
+            # slot so self time resolves after the full sweep
+            s.setdefault("_pending", []).append((dur, child_durs))
+    for s in stats.values():
+        for dur, child_durs in s.pop("_pending", []):
+            s["self_us"] += max(0.0, dur - sum(child_durs))
+    return dict(stats)
+
+
+def sweep_attribution(events: list[dict]) -> dict[tuple, float]:
+    """(sweep, coordinate) -> total cd.update microseconds."""
+    out: dict[tuple, float] = defaultdict(float)
+    for e in events:
+        if e["name"] != "cd.update":
+            continue
+        args = e.get("args") or {}
+        out[(args.get("sweep", "?"), args.get("coordinate", "?"))] += \
+            float(e.get("dur", 0.0))
+    return dict(out)
+
+
+def format_report(events: list[dict], top: int) -> str:
+    lines = []
+    stats = self_times(events)
+    ranked = sorted(stats.items(), key=lambda kv: -kv[1]["self_us"])[:top]
+    lines.append(f"{'span':<24} {'count':>7} {'total_ms':>10} "
+                 f"{'self_ms':>10} {'avg_ms':>9}")
+    lines.append("-" * 64)
+    for name, s in ranked:
+        lines.append(
+            f"{name:<24} {s['count']:>7} {s['total_us'] / 1e3:>10.2f} "
+            f"{s['self_us'] / 1e3:>10.2f} "
+            f"{s['total_us'] / s['count'] / 1e3:>9.3f}")
+    attr = sweep_attribution(events)
+    if attr:
+        lines.append("")
+        lines.append("per-coordinate sweep attribution (cd.update):")
+        lines.append(f"{'sweep':>6} {'coordinate':<20} {'ms':>10} {'%':>6}")
+        lines.append("-" * 46)
+        by_sweep: dict = defaultdict(float)
+        for (sweep, _), us in attr.items():
+            by_sweep[sweep] += us
+
+        def sweep_key(sweep):
+            # numeric sweeps sort numerically (2 before 10); non-numeric
+            # labels (the "?" fallback) sort after, lexicographically
+            try:
+                return (0, float(sweep), "")
+            except (TypeError, ValueError):
+                return (1, 0.0, str(sweep))
+
+        for (sweep, coord), us in sorted(
+                attr.items(),
+                key=lambda kv: (sweep_key(kv[0][0]), -kv[1])):
+            pct = 100.0 * us / by_sweep[sweep] if by_sweep[sweep] else 0.0
+            lines.append(f"{str(sweep):>6} {str(coord):<20} "
+                         f"{us / 1e3:>10.2f} {pct:>5.1f}%")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="top spans by self-time + per-coordinate sweep "
+                    "attribution from a --trace-dir trace.json")
+    p.add_argument("trace", help="path to trace.json")
+    p.add_argument("--top", type=int, default=15,
+                   help="span names to show (by self time)")
+    ns = p.parse_args(argv)
+    try:
+        events = load_events(ns.trace)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: cannot read {ns.trace}: {e}",
+              file=sys.stderr)
+        return 2
+    if not events:
+        print(f"trace_report: {ns.trace} holds no complete span events",
+              file=sys.stderr)
+        return 2
+    print(format_report(events, ns.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
