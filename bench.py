@@ -475,6 +475,22 @@ def _instrumented_warm_pass(run_fn) -> dict:
     run_fn()
     train_secs_traced = time.perf_counter() - t0
     obs_trace.disable()
+
+    # fault-free-overhead probe: the SAME warm pass with a fault spec
+    # ARMED on the hot-loop point but never firing (flaky p=0 — every
+    # cd.update visit evaluates the full spec-matching + deterministic
+    # decision path, the chaos machinery's worst no-op case). The smoke
+    # test asserts this costs < 1% on the warm glmix path.
+    from photon_ml_tpu.utils import faults as faults_mod
+
+    faults_mod.arm("cd.update", "flaky", times=1_000_000_000,
+                   probability=0.0)
+    try:
+        t0 = time.perf_counter()
+        run_fn()
+        train_secs_chaos = time.perf_counter() - t0
+    finally:
+        faults_mod.disarm_all()
     return {
         "result": result,
         "train_secs_warm": train_secs_warm,
@@ -485,6 +501,9 @@ def _instrumented_warm_pass(run_fn) -> dict:
         "retraces": retraces,
         "train_secs_traced": train_secs_traced,
         "trace_overhead_pct": (100.0 * (train_secs_traced - train_secs_warm)
+                               / train_secs_warm),
+        "train_secs_chaos_armed": train_secs_chaos,
+        "chaos_overhead_pct": (100.0 * (train_secs_chaos - train_secs_warm)
                                / train_secs_warm),
     }
 

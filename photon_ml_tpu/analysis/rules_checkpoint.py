@@ -14,7 +14,14 @@ for.
 
 Writers: dict literals passed (directly or through one local name) to
 ``<ckpt-ish>.save(step, state)`` calls — receivers whose name matches
-``ckpt``/``checkpoint``. Readers: string subscripts and ``.get`` calls
+``ckpt``/``checkpoint`` — or to a save WRAPPER: a plain call whose
+function name mentions both ``ckpt``/``checkpoint`` and ``save`` and
+that mirrors the save shape plus the manager up front,
+``(manager, step, snapshot, ...)`` — e.g.
+``_checkpoint_save_contained(mgr, step, {...})`` — so hoisting the
+save into a containment helper keeps the schema visible while a
+2-arg name-alike helper doesn't pollute the key union.
+Readers: string subscripts and ``.get`` calls
 on snapshot variables — names bound from ``<ckpt-ish>.restore()`` or
 ``loads_state(...)``, plus the conventional names ``snap`` /
 ``resume_snapshot`` / ``snapshot``. Both directions compare against the
@@ -33,6 +40,8 @@ from photon_ml_tpu.analysis.dataflow import Dataflow
 from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
 
 _CKPT_RECV_RE = re.compile(r"ckpt|checkpoint", re.IGNORECASE)
+_SAVE_WRAPPER_RE = re.compile(r"(?=.*(?:ckpt|checkpoint))(?=.*save)",
+                              re.IGNORECASE)
 _SNAP_NAMES = {"snap", "snapshot", "resume_snapshot"}
 
 
@@ -104,16 +113,32 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
     for mod in modules:
         scope_of = build_scope_map(mod.tree)
         for node in ast.walk(mod.tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "save"
-                    and len(node.args) >= 2):
+            if not isinstance(node, ast.Call):
                 continue
-            recv = _receiver_name(node.func.value)
-            if not recv or not _CKPT_RECV_RE.search(recv):
+            state_args: list[ast.expr] = []
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "save" \
+                    and len(node.args) >= 2:
+                recv = _receiver_name(node.func.value)
+                if recv and _CKPT_RECV_RE.search(recv):
+                    state_args = [node.args[1]]
+            elif isinstance(node.func, ast.Name) \
+                    and _SAVE_WRAPPER_RE.search(node.func.id) \
+                    and len(node.args) >= 3:
+                # containment wrappers mirror the .save shape plus the
+                # manager up front — (manager, step, snapshot, ...) —
+                # so only args[2:] are schema candidates; a 2-arg
+                # helper that happens to match the name (e.g.
+                # save_checkpoint_report(mgr, {...})) is not a save site
+                state_args = list(node.args[2:])
+            if not state_args:
                 continue
             scope = scope_of.get(id(node)) or mod.tree
-            d = _resolve_dict_arg(scope, node.args[1], node.lineno)
+            d = None
+            for arg in state_args:
+                d = _resolve_dict_arg(scope, arg, node.lineno)
+                if d is not None:
+                    break
             if d is None:
                 continue
             keys = _dict_keys(d)

@@ -1,0 +1,75 @@
+"""CLI drivers + their shared exit discipline.
+
+Documented exit semantics (the chaos campaign asserts these — a driver
+process must END one of exactly three ways, never a stack-trace crash):
+
+- ``0``  — success, possibly DEGRADED (quarantined shards/coordinates
+  are reported in the logs and metrics, coverage recorded);
+- ``3``  — CLEAN ABORT on a recognized terminal condition (shard loss
+  over ``--max-shard-loss-frac``, an all-corrupt checkpoint directory, a
+  required I/O that stayed down through its retries, an unrecovered
+  injected fault): one ``PHOTON_ABORT kind=<Type>: <message>`` line on
+  stderr, no traceback;
+- an injected ``kill``'s exit code — the process was scripted dead; the
+  checkpoint directory stays restorable and a relaunch resumes.
+
+Anything else (an unhandled traceback) is a bug the chaos campaign
+(``tools/chaos_drill.py``) exists to catch.
+"""
+
+from __future__ import annotations
+
+import sys
+
+CLEAN_ABORT_EXIT = 3
+
+
+def clean_abort_types() -> tuple:
+    """The exception classes that mean "documented terminal condition —
+    abort cleanly": resolved lazily so importing the CLI package stays
+    light."""
+    from photon_ml_tpu.data.ingest import ShardLossExceededError
+    from photon_ml_tpu.utils.checkpoint import CheckpointCorruptionError
+    from photon_ml_tpu.utils.faults import InjectedFault
+    from photon_ml_tpu.utils.retry import RetryExhaustedError
+
+    return (ShardLossExceededError, CheckpointCorruptionError,
+            RetryExhaustedError, InjectedFault)
+
+
+def build_event_bus(warn):
+    """The drivers' shared event-bus wiring: every event lands in the
+    warn log and, via the bridge, in the metrics stream. One builder so
+    the two drivers cannot drift (same reason
+    ``obs.run.start_observed_run_from_flags`` is shared)."""
+    from photon_ml_tpu.obs.bridge import MetricsEventListener
+    from photon_ml_tpu.utils.events import EventEmitter
+
+    events = EventEmitter()
+    events.register_listener(lambda e: warn(f"event: {e}"))
+    events.register_listener(MetricsEventListener())
+    return events
+
+
+def build_ingest_policy(max_shard_loss_frac: float, events, warn):
+    """A fresh degraded-ingest policy wired to the driver's event bus
+    (one per load — the coverage fraction is per-dataset)."""
+    from photon_ml_tpu.data.ingest import IngestPolicy
+
+    return IngestPolicy(max_shard_loss_frac=max_shard_loss_frac,
+                        events=events, warn=warn)
+
+
+def clean_abort(e: BaseException, log=None) -> SystemExit:
+    """Build the clean-abort exit for a recognized terminal condition:
+    one machine-greppable ``PHOTON_ABORT`` line on stderr, exit code
+    :data:`CLEAN_ABORT_EXIT`, no traceback. Usage::
+
+        except clean_abort_types() as e:
+            raise clean_abort(e, log=driver.logger.error) from None
+    """
+    if log is not None:
+        log(f"clean abort ({type(e).__name__}): {e}")
+    print(f"PHOTON_ABORT kind={type(e).__name__}: {e}",
+          file=sys.stderr, flush=True)
+    return SystemExit(CLEAN_ABORT_EXIT)

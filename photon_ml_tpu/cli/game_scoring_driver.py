@@ -58,6 +58,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--feature-shard-id-to-intercept-map", default="")
     p.add_argument("--random-effect-id-set", default="",
                    help="comma-separated id types present in the data")
+    p.add_argument("--max-shard-loss-frac", type=float, default=0.0,
+                   help="degraded-mode ingest budget (same contract as "
+                        "the training driver): a corrupt/unreadable "
+                        "input shard is quarantined and scoring "
+                        "continues on the survivors while the lost "
+                        "fraction stays within this budget; past it the "
+                        "run aborts cleanly (exit code 3). 0 = strict")
     p.add_argument("--evaluator-type", default="")
     p.add_argument("--model-id", default="")
     p.add_argument("--delete-output-dir-if-exists", default="false")
@@ -185,10 +192,23 @@ class GameScoringDriver:
                 f"process {ns.process_id}/{ns.num_processes}: scoring "
                 f"{len(input_paths)} of {len(files)} part file(s)")
         with timed_phase("prepareGameDataSet", self.logger):
+            from photon_ml_tpu.cli import (
+                build_event_bus,
+                build_ingest_policy,
+            )
+
+            ingest = build_ingest_policy(
+                ns.max_shard_loss_frac,
+                events=build_event_bus(self.logger.warn),
+                warn=self.logger.warn)
             data = load_game_dataset_avro(
                 input_paths, self.section_keys, index_maps,
-                id_types=id_types, response_required=False)
-        self.logger.info(f"scoring {data.num_samples} samples")
+                id_types=id_types, response_required=False,
+                policy=ingest)
+            ingest.finish(log=self.logger.warn)
+        self.logger.info(
+            f"scoring {data.num_samples} samples (data coverage "
+            f"{ingest.coverage_fraction:.1%})")
 
         with timed_phase("scoreGameDataSet", self.logger):
             scores = np.asarray(model.score(data))
@@ -224,11 +244,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     driver = GameScoringDriver(ns)
     from photon_ml_tpu.obs.run import start_observed_run_from_flags
 
+    from photon_ml_tpu.cli import clean_abort, clean_abort_types
+
     obs_run = start_observed_run_from_flags(
         ns, process_index=ns.process_id, num_processes=ns.num_processes,
         warn=driver.logger.warn)
     try:
         driver.run()
+    except clean_abort_types() as e:
+        # documented terminal conditions exit 3 with a PHOTON_ABORT
+        # line, never a stack trace (see photon_ml_tpu/cli/__init__.py)
+        raise clean_abort(e, log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"GAME scoring failed: {e}")
         raise

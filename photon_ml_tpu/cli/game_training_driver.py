@@ -63,7 +63,10 @@ from photon_ml_tpu.game.dataset import (
     build_fixed_effect_dataset,
     build_random_effect_dataset,
 )
-from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem
+from photon_ml_tpu.game.random_effect import (
+    AUTO_COMPACTION_CHUNK,
+    RandomEffectOptimizationProblem,
+)
 from photon_ml_tpu.io.data_format import (
     NameAndTermFeatureSets,
     load_game_dataset_avro,
@@ -142,6 +145,15 @@ def _parse_factored_grid(s: str) -> list[dict]:
     return grid
 
 
+def _parse_compaction_chunk(s: str) -> int:
+    """``--re-lane-compaction-chunk`` value: an int, or ``auto`` → the
+    ChunkAutoTuner sentinel (kept an int so the run-manifest flags stay
+    scalar)."""
+    if s.strip().lower() == "auto":
+        return AUTO_COMPACTION_CHUNK
+    return int(s)
+
+
 def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p = argparse.ArgumentParser(prog="game-training",
                                 description="GAME training on TPU")
@@ -175,13 +187,17 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "(rows, dims), cutting FLOPs/HBM on skewed entity "
                         "sizes (SURVEY hard part 1; not applied to "
                         "factored coordinates, which need one block)")
-    p.add_argument("--re-lane-compaction-chunk", type=int, default=0,
+    p.add_argument("--re-lane-compaction-chunk",
+                   type=_parse_compaction_chunk, default=0,
                    help="solve random-effect entity blocks in iteration "
                         "chunks of this size, compacting still-active "
                         "lanes between chunks so converged entities stop "
                         "paying for the slowest lane's iteration count "
                         "(0 = one dispatch to max_iterations; costs one "
-                        "small device fetch per chunk)")
+                        "small device fetch per chunk). 'auto' lets the "
+                        "chunk-size controller pick and re-tune between "
+                        "solves from the observed per-chunk active-lane "
+                        "decay (the re_chunk_active_lanes signal)")
     p.add_argument("--random-effect-blocks-dir", default=None,
                    help="build random-effect entity blocks through the "
                         "STREAMED builder with np.memmap destinations "
@@ -189,6 +205,16 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "coordinate): peak host RAM stays one part plus "
                         "O(N) scalar columns instead of CSR + all padded "
                         "blocks; blocks page to device per solve")
+    p.add_argument("--max-shard-loss-frac", type=float, default=0.0,
+                   help="degraded-mode ingest budget: a corrupt, "
+                        "truncated, or persistently unreadable Avro "
+                        "shard is QUARANTINED (skipped with a "
+                        "ShardQuarantinedEvent and a recorded "
+                        "data-coverage fraction) and training continues "
+                        "on the surviving shards, as long as the lost "
+                        "fraction stays within this budget; past it the "
+                        "run aborts cleanly (exit code 3). 0 (default) "
+                        "= strict: the first lost shard aborts")
     p.add_argument("--evaluator-type", default="")
     # default None (resolved to ALL single-process): multi-host must tell
     # an explicit model-output request apart from the argparse default
@@ -334,6 +360,9 @@ class GameTrainingDriver:
         self.index_maps: dict[str, IndexMap] = {}
         self.train_data: Optional[GameDataset] = None
         self.validate_data: Optional[GameDataset] = None
+        self.train_ingest = None  # IngestPolicy of the training load
+        self.validate_ingest = None
+        self._events = None  # driver-wide event bus, built on first use
 
     # -- pipeline ----------------------------------------------------------
 
@@ -369,13 +398,36 @@ class GameTrainingDriver:
             paths = resolve_input_paths(
                 self.ns.train_input_dirs, self.ns.train_date_range,
                 self.ns.train_date_range_days_ago)
-            sets = NameAndTermFeatureSets.from_paths(paths, all_sections)
+            sets = NameAndTermFeatureSets.from_paths(
+                paths, all_sections, policy=self._ingest_policy())
         for shard, sections in self.section_keys.items():
             self.index_maps[shard] = sets.index_map(
                 sections, add_intercept=self.intercept_map.get(shard, True))
         self.logger.info(
             f"feature maps: "
             f"{ {k: len(v) for k, v in self.index_maps.items()} }")
+
+    def _lane_chunk(self) -> int:
+        c = int(self.ns.re_lane_compaction_chunk)
+        return c if c == AUTO_COMPACTION_CHUNK else max(0, c)
+
+    def _event_bus(self):
+        """The driver-wide event bus: fault/recovery/quarantine AND
+        shard-quarantine events all land in the warn log and (via the
+        bridge) in the metrics stream. One emitter for the whole run so
+        ingest and coordinate descent share listeners."""
+        if self._events is None:
+            from photon_ml_tpu.cli import build_event_bus
+
+            self._events = build_event_bus(self.logger.warn)
+        return self._events
+
+    def _ingest_policy(self):
+        from photon_ml_tpu.cli import build_ingest_policy
+
+        return build_ingest_policy(self.ns.max_shard_loss_frac,
+                                   events=self._event_bus(),
+                                   warn=self.logger.warn)
 
     def _id_types(self) -> list[str]:
         id_types = {cfg.random_effect_type
@@ -389,20 +441,26 @@ class GameTrainingDriver:
         train_paths = resolve_input_paths(
             self.ns.train_input_dirs, self.ns.train_date_range,
             self.ns.train_date_range_days_ago)
+        self.train_ingest = self._ingest_policy()
         self.train_data = load_game_dataset_avro(
             train_paths, self.section_keys, self.index_maps,
-            id_types=self._id_types(), response_required=True)
+            id_types=self._id_types(), response_required=True,
+            policy=self.train_ingest)
+        self.train_ingest.finish(log=self.logger.warn)
         self.logger.info(
             f"train dataset: {self.train_data.num_samples} samples "
-            f"from {len(train_paths)} path(s)")
+            f"from {len(train_paths)} path(s), data coverage "
+            f"{self.train_ingest.coverage_fraction:.1%}")
         if self.ns.validate_input_dirs:
             validate_paths = resolve_input_paths(
                 self.ns.validate_input_dirs, self.ns.validate_date_range,
                 self.ns.validate_date_range_days_ago)
+            self.validate_ingest = self._ingest_policy()
             self.validate_data = load_game_dataset_avro(
                 validate_paths, self.section_keys,
                 self.index_maps, id_types=self._id_types(),
-                response_required=True)
+                response_required=True, policy=self.validate_ingest)
+            self.validate_ingest.finish(log=self.logger.warn)
 
     def _build_coordinates(self, fixed_cfgs, random_cfgs, factored_cfgs
                            ) -> dict:
@@ -431,8 +489,7 @@ class GameTrainingDriver:
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
                         config=re_cfg, task=self.task,
-                        lane_compaction_chunk=max(
-                            0, int(self.ns.re_lane_compaction_chunk))),
+                        lane_compaction_chunk=self._lane_chunk()),
                     latent_problem=GLMOptimizationProblem(
                         config=latent_cfg, task=self.task),
                     latent_dim=mf_cfg.num_factors,
@@ -465,8 +522,7 @@ class GameTrainingDriver:
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
                         config=opt_cfg, task=self.task,
-                        lane_compaction_chunk=max(
-                            0, int(self.ns.re_lane_compaction_chunk))))
+                        lane_compaction_chunk=self._lane_chunk()))
             else:
                 raise ValueError(
                     f"coordinate {cid!r} in updating sequence has no data "
@@ -539,7 +595,6 @@ class GameTrainingDriver:
         events = None
         if self.ns.recovery_policy != "none":
             from photon_ml_tpu.game.coordinate_descent import RecoveryPolicy
-            from photon_ml_tpu.utils.events import EventEmitter
 
             recovery = RecoveryPolicy(
                 max_retries=self.ns.recovery_max_retries,
@@ -548,14 +603,9 @@ class GameTrainingDriver:
                 max_consecutive_failures=(
                     self.ns.recovery_max_consecutive_failures),
                 quarantine_after=self.ns.recovery_quarantine_after)
-            events = EventEmitter()
-            events.register_listener(
-                lambda e: self.logger.warn(f"recovery event: {e}"))
-            # fault/recovery/quarantine counts land in metrics.jsonl via
-            # the event-bus → metrics bridge
-            from photon_ml_tpu.obs.bridge import MetricsEventListener
-
-            events.register_listener(MetricsEventListener())
+            # the shared driver bus: fault/recovery/quarantine counts
+            # land in metrics.jsonl via the event-bus → metrics bridge
+            events = self._event_bus()
         for gi, (f_cfgs, r_cfgs, fac_cfgs) in enumerate(combos):
             desc = (f"grid[{gi}]: fixed={ {k: v.render() for k, v in f_cfgs.items()} } "
                     f"random={ {k: v.render() for k, v in r_cfgs.items()} }")
@@ -642,6 +692,18 @@ class GameTrainingDriver:
             "best": {"description": best_desc,
                      "metric": _finite(best_result.best_metric)},
             "quarantined": quarantined_all,
+            # degraded-ingest record: the surviving-shard fraction and
+            # which shards were lost (the chaos campaign's coverage
+            # assertion reads these)
+            "data_coverage": (self.train_ingest.coverage_fraction
+                              if self.train_ingest is not None else 1.0),
+            "ingest": {
+                "train": (self.train_ingest.summary()
+                          if self.train_ingest is not None else None),
+                "validate": (self.validate_ingest.summary()
+                             if self.validate_ingest is not None
+                             else None),
+            },
             "grid": [
                 {"description": desc,
                  "quarantined": result.quarantined,
@@ -722,12 +784,18 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
         unsupported.append(
             "--recovery-policy (divergence recovery is wired into the "
             "single-process coordinate-descent loop only)")
-    if ns.re_lane_compaction_chunk > 0:  # <= 0 is "off" on every path
+    if ns.re_lane_compaction_chunk != 0:  # 0 is "off"; auto (-1) counts
         unsupported.append(
             "--re-lane-compaction-chunk (lane compaction gathers active "
             "lanes with per-chunk host round-trips; the multi-host solve "
             "keeps its entity axis mesh-sharded and runs the "
             "single-dispatch path)")
+    if ns.max_shard_loss_frac > 0:
+        unsupported.append(
+            "--max-shard-loss-frac (shard quarantine is wired into the "
+            "single-process ingest; the multi-host workers must all "
+            "agree on the surviving row set, which needs a gang-level "
+            "coverage consensus that does not exist yet)")
     if unsupported:
         raise ValueError(
             "multi-host mode (--num-processes > 1) does not support: "
@@ -936,11 +1004,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             return _run_supervised(ns, argv)
         return _run_multihost(ns)
     driver = GameTrainingDriver(ns)
+    from photon_ml_tpu.cli import clean_abort, clean_abort_types
     from photon_ml_tpu.obs.run import start_observed_run_from_flags
 
     obs_run = start_observed_run_from_flags(ns, warn=driver.logger.warn)
     try:
         driver.run()
+    except clean_abort_types() as e:
+        # documented terminal conditions (shard loss over budget,
+        # all-corrupt checkpoints, I/O down through its retries, an
+        # unrecovered injected fault) end with the PHOTON_ABORT line and
+        # exit code 3 — never a stack trace
+        raise clean_abort(e, log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"GAME training failed: {e}")
         raise

@@ -1,0 +1,151 @@
+"""Degraded-mode ingest: shard-level quarantine with a bounded loss budget.
+
+The source paper's production setting retrains daily over per-entity data
+sharded across a cluster (PAPER.md §1); at that scale a corrupt Avro part
+file or a flaky filesystem is routine, and Snap ML's lesson (PAPERS.md)
+is that hierarchical data management — not the solver — is the production
+bottleneck. The reference inherited shard-loss tolerance from HDFS +
+Spark task retry; this module is the multi-controller port's own answer:
+
+- every shard read goes through ``utils/retry`` first (transient I/O
+  recovers invisibly);
+- a shard that stays unreadable — or decodes corrupt — is QUARANTINED:
+  skipped with a :class:`~photon_ml_tpu.utils.events.ShardQuarantinedEvent`
+  on the event bus, a ``quarantined_shards{stage=...}`` counter, and a
+  driver-log warning, while ingestion continues on the survivors;
+- the recorded **data-coverage fraction** (surviving shards / total) is
+  checked against ``max_shard_loss_frac``: past the budget the run
+  aborts CLEANLY with :class:`ShardLossExceededError` (the drivers map it
+  to the documented exit code, never a stack trace), because a model
+  quietly trained on half its data is worse than no model.
+
+``IngestPolicy(max_shard_loss_frac=0)`` — the drivers' default — is the
+strict mode: the FIRST lost shard aborts (still cleanly). A policy of
+``None`` threaded through the io layer keeps the legacy raise-on-corrupt
+behavior for callers that predate this layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from photon_ml_tpu.obs.metrics import REGISTRY
+from photon_ml_tpu.utils.events import EventEmitter, ShardQuarantinedEvent
+
+
+class ShardLossExceededError(RuntimeError):
+    """Quarantined-shard fraction exceeded ``max_shard_loss_frac`` — the
+    clean-abort signal (documented driver exit semantics, not a crash)."""
+
+
+@dataclasses.dataclass
+class QuarantinedShard:
+    path: str
+    stage: str  # "open" | "decode" | "index"
+    reason: str
+
+
+class IngestPolicy:
+    """Per-load quarantine bookkeeping + loss budget.
+
+    One instance spans one dataset load (create it fresh per load — the
+    coverage fraction is per-dataset, not per-process). The io layer
+    calls :meth:`record_ok` / :meth:`quarantine` per shard;
+    :meth:`quarantine` raises :class:`ShardLossExceededError` as soon as
+    the loss fraction can no longer stay within budget, so a
+    mostly-gone dataset fails fast instead of after a full scan.
+    """
+
+    def __init__(self, max_shard_loss_frac: float = 0.0,
+                 events: Optional[EventEmitter] = None,
+                 warn: Optional[Callable[[str], None]] = None):
+        if not 0.0 <= max_shard_loss_frac <= 1.0:
+            raise ValueError(
+                f"max_shard_loss_frac must be in [0, 1], "
+                f"got {max_shard_loss_frac}")
+        self.max_shard_loss_frac = max_shard_loss_frac
+        self._events = events
+        self._warn = warn
+        self.shards_ok = 0
+        self.quarantined: list[QuarantinedShard] = []
+        self.expected_total: Optional[int] = None
+        # paths already announced (counter/event/warn) — survives
+        # begin()'s per-scan reset so a fallback rescan that loses the
+        # same shard again doesn't double-count the metrics
+        self._announced: set[str] = set()
+
+    # -- shard accounting --------------------------------------------------
+
+    def begin(self, expected_total: int) -> None:
+        """Announce the shard universe for early budget math (and reset
+        per-load counters so a fallback re-scan starts clean)."""
+        self.expected_total = expected_total
+        self.shards_ok = 0
+        self.quarantined = []
+
+    def record_ok(self, path: str) -> None:
+        self.shards_ok += 1
+
+    def quarantine(self, path: str, stage: str, error: BaseException) -> None:
+        """Record one lost shard; raises when the loss budget is blown.
+
+        The budget check uses the EXPECTED universe when known (announced
+        via :meth:`begin`): with 4 shards and a 25% budget, the second
+        loss aborts immediately — even mid-scan — because coverage can
+        no longer recover."""
+        entry = QuarantinedShard(path=path, stage=stage, reason=repr(error))
+        self.quarantined.append(entry)
+        if path not in self._announced:  # once per shard, not per scan
+            self._announced.add(path)
+            REGISTRY.counter("quarantined_shards").inc(stage=stage)
+            if self._warn is not None:
+                self._warn(
+                    f"shard quarantined ({stage}): {path}: {error!r}")
+            if self._events is not None:
+                self._events.send_event(ShardQuarantinedEvent(
+                    path=path, stage=stage, reason=repr(error)))
+        lost = len(self.quarantined)
+        total = (self.expected_total if self.expected_total
+                 else self.shards_ok + lost)
+        if total and lost / total > self.max_shard_loss_frac:
+            raise ShardLossExceededError(
+                f"{lost} of {total} shard(s) quarantined "
+                f"({lost / total:.0%} > --max-shard-loss-frac "
+                f"{self.max_shard_loss_frac:.0%}); refusing to train on "
+                f"{1 - lost / total:.0%} of the data — last loss: "
+                f"{path} ({stage}: {error!r})") from error
+
+    # -- reporting ---------------------------------------------------------
+
+    @property
+    def shards_lost(self) -> int:
+        return len(self.quarantined)
+
+    @property
+    def coverage_fraction(self) -> float:
+        """Surviving fraction of the shard universe (1.0 when nothing was
+        read yet — an empty load is not a degraded load)."""
+        total = self.shards_ok + self.shards_lost
+        return 1.0 if total == 0 else self.shards_ok / total
+
+    def summary(self) -> dict:
+        """JSON-able record for metrics.json / the driver log."""
+        return {
+            "data_coverage": self.coverage_fraction,
+            "shards_ok": self.shards_ok,
+            "shards_quarantined": [
+                {"path": q.path, "stage": q.stage, "reason": q.reason}
+                for q in self.quarantined],
+        }
+
+    def finish(self, log: Optional[Callable[[str], None]] = None) -> None:
+        """End-of-load bookkeeping: export the coverage gauge and log the
+        degraded-mode summary when any shard was lost."""
+        REGISTRY.gauge("data_coverage").set(self.coverage_fraction)
+        if self.quarantined and log is not None:
+            log(f"DEGRADED ingest: {self.shards_lost} of "
+                f"{self.shards_ok + self.shards_lost} shard(s) "
+                f"quarantined, data coverage "
+                f"{self.coverage_fraction:.1%}: "
+                f"{[q.path for q in self.quarantined]}")

@@ -260,6 +260,27 @@ def _to_jnp_states(d: dict) -> dict:
             for cid, v in d.items()}
 
 
+def _checkpoint_save_contained(manager, step: int, snapshot: dict,
+                               log, emit) -> bool:
+    """Save a snapshot, CONTAINING a persistently-unwritable disk
+    (CheckpointWriteError after the write-side retries): training state
+    is intact and the next cadence point tries again, so a full
+    checkpoint volume degrades durability instead of killing a
+    multi-hour run. The failure is logged, counted
+    (``ckpt_save_failures``), and announced as a FaultEvent."""
+    from photon_ml_tpu.utils.checkpoint import CheckpointWriteError
+
+    try:
+        manager.save(step, snapshot)
+        return True
+    except CheckpointWriteError as e:
+        REGISTRY.counter("ckpt_save_failures").inc()
+        emit(FaultEvent(point="ckpt.write_bytes", message=str(e)))
+        log(lambda: f"checkpoint step {step} NOT saved (degraded, "
+            f"training continues): {e}")
+        return False
+
+
 def run_coordinate_descent(
     coordinates: dict[str, Coordinate],
     num_iterations: int,
@@ -478,7 +499,7 @@ def run_coordinate_descent(
             "best_states": best_states,
         })
         record_host_fetch(site="ckpt.snapshot")
-        checkpoint_manager.save(step, {
+        saved = _checkpoint_save_contained(checkpoint_manager, step, {
             "sweep": sweep,
             "coordinate_index": next_ci,
             # legacy field: completed sweeps (pre-mid-sweep readers)
@@ -495,8 +516,9 @@ def run_coordinate_descent(
             "consecutive_failures": int(consecutive_failures),
             "coordinate_failures": dict(coordinate_failures),
             "quarantined": sorted(quarantined),
-        })
-        last_saved_step = step
+        }, log=log, emit=emit)
+        if saved:  # a failed save retries at the next cadence point
+            last_saved_step = step
 
     def run_update(ci, cid, it):
         """One guarded coordinate update (retry loop + bookkeeping +

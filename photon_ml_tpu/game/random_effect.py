@@ -93,6 +93,81 @@ def reset_solve_stats() -> None:
                         "compact_secs": 0.0, "lane_counts": []})
 
 
+#: ``lane_compaction_chunk`` sentinel (driver flag value ``auto``): the
+#: chunk size is chosen — and re-tuned between solves — by
+#: :class:`ChunkAutoTuner` from the observed per-chunk active-lane decay.
+AUTO_COMPACTION_CHUNK = -1
+
+
+def _pow2_at_most(x: int) -> int:
+    return 1 << max(int(x).bit_length() - 1, 0)
+
+
+class ChunkAutoTuner:
+    """Feedback controller for the lane-compaction chunk size.
+
+    The data source is the per-chunk active-lane sequence each compacted
+    solve produces (the same counts the ``re_chunk_active_lanes``
+    histogram aggregates — the ROADMAP item's promised signal): the
+    fraction of lanes still active after a solve's FIRST chunk says
+    whether the chunk budget was matched to the convergence profile.
+
+    - survival > 0.75: the chunk is shedding too few lanes to pay for
+      its per-chunk host fetch + re-pack → double it;
+    - survival < 0.25: most lanes idled through the tail of the chunk
+      before compaction could shed them → halve it;
+    - in between: keep it.
+
+    One tuner per coordinate problem (created lazily by
+    :class:`RandomEffectOptimizationProblem` — the problem instance
+    lives across sweeps, so feedback accumulates, while two coordinates
+    with IDENTICAL configs but opposite convergence profiles still tune
+    independently instead of ping-ponging one shared entry). State is
+    keyed per (solver, max_iterations) within the instance and clamped
+    to [4, max_iterations); a probe chunk of ``~max_iterations / 4``
+    (power of two, for compile-shape reuse) seeds each key. Chunk sizes
+    stay powers of two so re-tuning between sweeps revisits previously
+    compiled shapes instead of growing the jit cache without bound.
+    """
+
+    MIN_CHUNK = 4
+
+    def __init__(self):
+        self._chunks: dict = {}
+
+    def chunk_for(self, solver: str, max_iterations: int) -> int:
+        if max_iterations <= self.MIN_CHUNK:
+            return 0  # nothing to chunk: single dispatch
+        key = (solver, max_iterations)
+        c = self._chunks.get(key)
+        if c is None:
+            c = max(self.MIN_CHUNK, _pow2_at_most(max_iterations // 4))
+            self._chunks[key] = c
+        return c
+
+    def update(self, solver: str, max_iterations: int,
+               lane_counts: list) -> None:
+        """Feed one solve's per-chunk active-lane sequence back."""
+        if max_iterations <= self.MIN_CHUNK or not lane_counts:
+            return
+        key = (solver, max_iterations)
+        c = self._chunks.get(key)
+        if c is None or lane_counts[0] <= 0:
+            return
+        if len(lane_counts) == 1:
+            # everything converged inside one chunk: the budget was
+            # bigger than the straggler tail needed
+            survival = 0.0
+        else:
+            survival = lane_counts[1] / lane_counts[0]
+        if survival > 0.75:
+            c *= 2
+        elif survival < 0.25:
+            c //= 2
+        self._chunks[key] = min(max(c, self.MIN_CHUNK),
+                                _pow2_at_most(max_iterations - 1))
+
+
 def _fit_blocks_impl(
     X: Array,
     labels: Array,
@@ -216,7 +291,8 @@ def _dispatch_fit(X, labels, offsets, weights, initial, obj, l1, solver,
 
 def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
                           solver, max_iter, tolerance, chunk: int,
-                          donate: bool):
+                          donate: bool,
+                          lane_seq: Optional[list] = None):
     """Chunked solve with active-lane compaction (Snap ML-style: don't pay
     straggler cost for converged subproblems).
 
@@ -243,6 +319,8 @@ def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
         # auto-tuner needs, and the ``re_chunk_active_lanes`` histogram
         # aggregates it across the run
         active_lanes = int(X.shape[0]) if idx is None else int(len(idx))
+        if lane_seq is not None:  # the auto-tuner's feedback signal
+            lane_seq.append(active_lanes)
         t0 = time.perf_counter()
         with trace.span("re.compact_chunk", chunk=chunk_index,
                         active_lanes=active_lanes, budget=budget):
@@ -299,7 +377,15 @@ class RandomEffectOptimizationProblem:
     # solver runs ``lane_compaction_chunk`` iterations at a time and only
     # still-unconverged lanes re-dispatch (see _fit_blocks_compacted).
     # 0 keeps the single-dispatch all-lanes-to-max-lane-count behavior.
+    # AUTO_COMPACTION_CHUNK (-1, driver flag value "auto") lets this
+    # problem's own ChunkAutoTuner pick — and re-tune between solves —
+    # from the observed per-chunk active-lane decay.
     lane_compaction_chunk: int = 0
+    # per-coordinate controller state (the problem instance lives
+    # across sweeps, so auto-mode feedback persists; identical configs
+    # on different coordinates still tune independently)
+    chunk_tuner: ChunkAutoTuner = dataclasses.field(
+        default_factory=ChunkAutoTuner, compare=False, repr=False)
 
     def objective(self) -> GLMObjective:
         cfg = self.config
@@ -313,14 +399,23 @@ class RandomEffectOptimizationProblem:
     def _fit(self, X, labels, offsets, weights, x0, obj, l1_arr,
              solver: str, donate: bool):
         """One entity block through the solver — compacted in iteration
-        chunks when ``lane_compaction_chunk`` engages, one dispatch
-        otherwise."""
+        chunks when ``lane_compaction_chunk`` engages (auto-tuned when
+        it is AUTO_COMPACTION_CHUNK), one dispatch otherwise."""
         cfg = self.config
         chunk = self.lane_compaction_chunk
+        auto = chunk == AUTO_COMPACTION_CHUNK
+        if auto:
+            chunk = self.chunk_tuner.chunk_for(solver, cfg.max_iterations)
         if 0 < chunk < cfg.max_iterations and int(X.shape[0]) > 1:
-            return _fit_blocks_compacted(
+            lane_seq: Optional[list] = [] if auto else None
+            out = _fit_blocks_compacted(
                 X, labels, offsets, weights, x0, obj, l1_arr, solver,
-                cfg.max_iterations, float(cfg.tolerance), chunk, donate)
+                cfg.max_iterations, float(cfg.tolerance), chunk, donate,
+                lane_seq=lane_seq)
+            if auto:
+                self.chunk_tuner.update(solver, cfg.max_iterations,
+                                        lane_seq)
+            return out
         return _dispatch_fit(
             X, labels, offsets, weights, x0, obj, l1_arr, solver,
             cfg.max_iterations, float(cfg.tolerance), donate)

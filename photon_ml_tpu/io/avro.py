@@ -26,6 +26,9 @@ import struct
 import zlib
 from typing import Any, Iterable, Iterator, Optional
 
+from photon_ml_tpu.utils.faults import fault_point
+from photon_ml_tpu.utils.retry import RetryExhaustedError, call_with_retry
+
 MAGIC = b"Obj\x01"
 SYNC_SIZE = 16
 DEFAULT_SYNC_INTERVAL = 16_000  # records per block (approximate)
@@ -659,6 +662,9 @@ def write_container(path: str, schema: Any, records: Iterable[dict],
 
 def read_container(path: str) -> tuple[Any, list[Any]]:
     """Read an Avro object container file → (schema, records)."""
+    # the OS-level drill site (io_error/flaky/slow), shared with the
+    # native reader's block walk: fires before the bytes are opened
+    fault_point("io.shard_open", tag=os.path.basename(path))
     with open(path, "rb") as fh:
         buf = fh.read()
     if buf[:4] != MAGIC:
@@ -700,7 +706,13 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
         data = buf[dec.pos:dec.pos + size]
         dec.pos += size
         if codec == "deflate":
-            data = zlib.decompress(data, -15)
+            try:
+                data = zlib.decompress(data, -15)
+            except zlib.error as e:
+                # corruption is ONE exception type (ValueError) to every
+                # consumer — the shard-quarantine layer dispatches on it
+                raise ValueError(
+                    f"{path}: corrupt deflate block: {e}") from e
         elif codec != "null":
             raise ValueError(f"unsupported codec {codec!r}")
         if count > len(data) and count > 1_000_000:
@@ -713,8 +725,16 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
                 f"{path}: implausible block count {count} for "
                 f"{len(data)}-byte payload")
         bdec = BinaryDecoder(data)
-        for _ in range(count):
-            append(reader(bdec))
+        try:
+            for _ in range(count):
+                append(reader(bdec))
+        except (IndexError, struct.error, UnicodeDecodeError,
+                KeyError) as e:
+            # flipped bytes inside a null-codec block surface as varint/
+            # utf-8/overrun errors mid-record: normalize to the one
+            # corruption exception type
+            raise ValueError(
+                f"{path}: corrupt record data in block: {e!r}") from e
         if bdec.pos != len(data):
             raise ValueError(
                 f"{path}: block decoded {bdec.pos} of {len(data)} bytes "
@@ -727,13 +747,112 @@ def read_container(path: str) -> tuple[Any, list[Any]]:
     return schema, records
 
 
-def read_records(path: str) -> list[Any]:
+def check_container_framing(path: str) -> None:
+    """Validate a container's FRAME structure — magic, header metadata,
+    block varints, payload bounds, deflate integrity, sync markers —
+    without decoding a single record. Raises the same
+    ``ValueError``/``OSError`` taxonomy as :func:`read_container` on a
+    corrupt/truncated file and returns None on a well-framed one.
+
+    This is the cheap corrupt-vs-unsupported probe for the degraded
+    ingest fast path: when the native decoder declines a shard, framing
+    errors mean QUARANTINE (the shard is damaged) while a well-framed
+    shard means the schema is genuinely unsupported (fall back to the
+    interpreted reader — which also owns the rare frames-ok-but-
+    corrupt-record-bytes case during its rescan)."""
+    fault_point("io.shard_open", tag=os.path.basename(path))
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    if buf[:4] != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    dec = BinaryDecoder(buf, 4)
+    meta = {}
+    try:
+        while True:
+            count = dec.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                dec.read_long()
+                count = -count
+            for _ in range(count):
+                k = dec.read_string()
+                meta[k] = dec.read_bytes()
+        parse_schema(meta["avro.schema"].decode())
+    except (IndexError, KeyError, UnicodeDecodeError) as e:
+        raise ValueError(f"{path}: corrupt container header: {e!r}") from e
+    codec = meta.get("avro.codec", b"null").decode()
+    if dec.pos + SYNC_SIZE > len(buf):
+        raise ValueError(f"{path}: truncated before sync marker")
+    sync = buf[dec.pos:dec.pos + SYNC_SIZE]
+    dec.pos += SYNC_SIZE
+    while dec.pos < len(buf):
+        try:
+            count = dec.read_long()
+            size = dec.read_long()
+        except IndexError as e:
+            raise ValueError(
+                f"{path}: truncated block header") from e
+        if count < 0 or size < 0 or dec.pos + size > len(buf):
+            raise ValueError(
+                f"{path}: corrupt block header (count={count}, "
+                f"size={size}, {len(buf) - dec.pos} bytes left)")
+        if codec == "deflate":
+            try:
+                zlib.decompress(buf[dec.pos:dec.pos + size], -15)
+            except zlib.error as e:
+                raise ValueError(
+                    f"{path}: corrupt deflate block: {e}") from e
+        dec.pos += size
+        if buf[dec.pos:dec.pos + SYNC_SIZE] != sync:
+            raise ValueError(
+                f"{path}: sync marker mismatch (corrupt block)")
+        dec.pos += SYNC_SIZE
+
+
+def read_shard(path: str, reader=read_container, policy=None):
+    """One part file through ``reader`` with the degraded-ingest protocol
+    shared by every shard-granular load path:
+
+    - the ``io.avro_read`` fault point fires per attempt (``corrupt`` /
+      ``partial`` mutate the shard ON DISK, so the decode below sees the
+      damage exactly like a real bad disk);
+    - transient failures (``OSError``, injected faults) retry with
+      deterministic backoff (``retries{site="io.avro_read"}``);
+    - a shard that stays unreadable — or decodes corrupt (``ValueError``,
+      which is deterministic and NOT retried) — is quarantined through
+      ``policy`` (an :class:`~photon_ml_tpu.data.ingest.IngestPolicy`)
+      and ``None`` is returned; with no policy the error raises exactly
+      as it always did.
+    """
+    def attempt():
+        fault_point("io.avro_read", tag=os.path.basename(path), path=path)
+        return reader(path)
+
+    try:
+        result = call_with_retry(attempt, site="io.avro_read")
+    except (RetryExhaustedError, ValueError, FileNotFoundError) as e:
+        # FileNotFoundError skips the retry schedule (permanent) but a
+        # vanished shard is still a quarantinable loss
+        if policy is None:
+            raise
+        policy.quarantine(path, stage=("decode" if isinstance(e, ValueError)
+                                       else "open"), error=e)
+        return None
+    if policy is not None:
+        policy.record_ok(path)
+    return result
+
+
+def read_records(path: str, policy=None) -> list[Any]:
     """Records from a container file or a directory of part files —
-    whichever ``path`` is."""
+    whichever ``path`` is. ``policy`` engages shard quarantine
+    (:func:`read_shard`)."""
     if os.path.isdir(path):
-        _, records = read_directory(path)
+        _, records = read_directory(path, policy=policy)
     else:
-        _, records = read_container(path)
+        out = read_shard(path, policy=policy)
+        records = [] if out is None else out[1]
     return records
 
 
@@ -758,13 +877,18 @@ def expand_part_paths(paths) -> list[str]:
     return sorted(out)
 
 
-def read_directory(path: str) -> tuple[Any, list[Any]]:
+def read_directory(path: str, policy=None) -> tuple[Any, list[Any]]:
     """Read all ``*.avro`` files under a directory (the reference's
-    partitioned-output layout: part-*.avro shards)."""
+    partitioned-output layout: part-*.avro shards). With ``policy`` a
+    corrupt/unreadable part is quarantined and skipped instead of killing
+    the whole load (:func:`read_shard`)."""
     schema = None
     records: list[Any] = []
     for part in list_avro_parts(path):
-        s, recs = read_container(part)
+        out = read_shard(part, policy=policy)
+        if out is None:
+            continue
+        s, recs = out
         schema = schema or s
         records.extend(recs)
     return schema, records

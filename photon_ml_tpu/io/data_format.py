@@ -38,12 +38,15 @@ import scipy.sparse as sp
 
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.io.avro import read_records as _read_records
+from photon_ml_tpu.io.avro import read_shard as _read_shard
 from photon_ml_tpu.io.index_map import (
     DELIMITER,
     INTERCEPT_KEY,
     IndexMap,
     feature_key,
 )
+from photon_ml_tpu.utils.faults import fault_point
+from photon_ml_tpu.utils.retry import RetryExhaustedError, call_with_retry
 
 WILDCARD = "*"  # io/GLMSuite.scala:377
 
@@ -142,6 +145,56 @@ def _iter_columnar_parts(paths):
 
     for p in paths:
         yield read_columnar(p)
+
+
+#: Sentinel: "this shard was quarantined — skip it, keep the fast path"
+#: (distinct from None = "unsupported shape — fall back whole-input").
+_QUARANTINED = object()
+
+
+def _columnar_part_or_quarantine(path: str, policy):
+    """``read_columnar`` under the degraded-ingest protocol: returns the
+    columnar part, ``None`` for a shape the native decoder doesn't cover
+    (caller falls back to the interpreted whole-input path), or
+    :data:`_QUARANTINED` when the shard was lost to the policy.
+
+    The native decoder DECLINES corrupt framing with ``None`` instead of
+    raising (the interpreted reader owns the diagnostics), so on a None
+    with a policy active the container FRAMING is probed once — no
+    record decode — to tell a corrupt shard (quarantine it, keep the
+    fast path for the rest) from a genuinely unsupported schema (fall
+    back)."""
+    from photon_ml_tpu.io.avro import check_container_framing
+    from photon_ml_tpu.io.native_avro import read_columnar
+
+    def attempt():
+        fault_point("io.avro_read", tag=os.path.basename(path), path=path)
+        return read_columnar(path)
+
+    try:
+        part = call_with_retry(attempt, site="io.avro_read")
+    except (RetryExhaustedError, ValueError, FileNotFoundError) as e:
+        if policy is None:
+            raise
+        policy.quarantine(path, stage=("decode" if isinstance(e, ValueError)
+                                       else "open"), error=e)
+        return _QUARANTINED
+    if part is None and policy is not None:
+        # the probe re-opens the file, so it gets the SAME retry
+        # protocol as every other open: a transient EIO mid-probe must
+        # not quarantine a healthy-but-unsupported shard
+        try:
+            call_with_retry(lambda: check_container_framing(path),
+                            site="io.shard_open")
+        except (RetryExhaustedError, ValueError, FileNotFoundError) as e:
+            policy.quarantine(path,
+                              stage=("decode" if isinstance(e, ValueError)
+                                     else "open"), error=e)
+            return _QUARANTINED
+        return None
+    if part is not None and policy is not None:
+        policy.record_ok(path)
+    return part
 
 
 def _feature_col_ok(col) -> bool:
@@ -562,7 +615,8 @@ def _columnar_game_dataset(
         feature_shard_sections: dict[str, Sequence[str]],
         index_maps: dict[str, IndexMap],
         id_types: Sequence[str],
-        response_required: bool) -> Optional[GameDataset]:
+        response_required: bool,
+        policy=None) -> Optional[GameDataset]:
     """Vectorized GAME assembly from native columnar reads (the 20M-row
     ingestion path), streamed part by part so peak memory is bounded by
     the largest part plus the assembled CSR (the reference streams
@@ -582,7 +636,12 @@ def _columnar_game_dataset(
     shard_acc: dict[str, list] = {s: [] for s in feature_shard_sections}
     base = 0
     part_files = [f for p in paths for f in _columnar_part_paths(p)]
-    for part in _iter_columnar_parts(part_files):
+    if policy is not None:
+        policy.begin(len(part_files))
+    for pf in part_files:
+        part = _columnar_part_or_quarantine(pf, policy)
+        if part is _QUARANTINED:
+            continue  # shard lost; survivors keep streaming
         if part is None:
             return None
         schema, count, cols = part
@@ -751,7 +810,8 @@ def load_game_dataset_avro(
         feature_shard_sections: dict[str, Sequence[str]],
         index_maps: dict[str, IndexMap],
         id_types: Sequence[str] = (),
-        response_required: bool = True) -> GameDataset:
+        response_required: bool = True,
+        policy=None) -> GameDataset:
     """Avro records → columnar :class:`GameDataset`: one CSR per feature
     shard (union of that shard's sections, intercept appended when the
     shard's index map has the intercept key), response/offset/weight
@@ -760,13 +820,30 @@ def load_game_dataset_avro(
     ``path`` may be a single file/directory or a list of them (the dated
     daily-partition layout resolves to several directories). Dispatches to
     the native columnar decoder when available (falls back per schema
-    shape)."""
+    shape).
+
+    ``policy`` (an :class:`~photon_ml_tpu.data.ingest.IngestPolicy`)
+    engages shard-level quarantine on BOTH decode paths: a corrupt,
+    truncated, or persistently unreadable part file is skipped (with a
+    ``ShardQuarantinedEvent`` and a recorded coverage fraction) instead
+    of killing the load; past the policy's loss budget the load aborts
+    cleanly with ``ShardLossExceededError``."""
     paths = [path] if isinstance(path, str) else list(path)
     fast = _columnar_game_dataset(paths, feature_shard_sections,
-                                  index_maps, id_types, response_required)
+                                  index_maps, id_types, response_required,
+                                  policy=policy)
     if fast is not None:
         return fast
-    if isinstance(path, str):
+    if policy is not None:
+        # shard-granular interpreted fallback: quarantine per part file
+        part_files = [f for p in paths for f in _columnar_part_paths(p)]
+        policy.begin(len(part_files))
+        records = []
+        for pf in part_files:
+            out = _read_shard(pf, policy=policy)
+            if out is not None:
+                records.extend(out[1])
+    elif isinstance(path, str):
         records = _read_records(path)
     else:
         records = [r for p in path for r in _read_records(p)]
@@ -869,15 +946,15 @@ class NameAndTermFeatureSets:
         return NameAndTermFeatureSets(sets)
 
     @staticmethod
-    def from_paths(paths: Sequence[str], section_keys: Sequence[str]
-                   ) -> "NameAndTermFeatureSets":
+    def from_paths(paths: Sequence[str], section_keys: Sequence[str],
+                   policy=None) -> "NameAndTermFeatureSets":
         """Feature-map scan over data files: columnar fast path when the
         native decoder handles every part (the unique name/term tables ARE
         the name-term sets — the scan never touches per-entry data), else
         the per-record loop (GAMEDriver.prepareFeatureMapsDefault's
-        distinct() scan)."""
-        from photon_ml_tpu.io.native_avro import read_columnar
-
+        distinct() scan). ``policy`` quarantines corrupt/unreadable parts
+        instead of failing the scan (same degraded-ingest protocol as the
+        dataset load that follows it)."""
         # one FILE decoded at a time (directories expand to their part
         # files): the scan only keeps the (tiny) name-term sets, never a
         # whole decoded dataset
@@ -888,9 +965,13 @@ class NameAndTermFeatureSets:
             files.extend(list_avro_parts(p) if os.path.isdir(p) else [p])
         sets: dict[str, set[tuple[str, str]]] = {
             k: set() for k in section_keys}
+        if policy is not None:
+            policy.begin(len(files))
         ok = True
         for f in files:
-            part = read_columnar(f)
+            part = _columnar_part_or_quarantine(f, policy)
+            if part is _QUARANTINED:
+                continue
             if part is None:
                 ok = False
                 break
@@ -908,8 +989,11 @@ class NameAndTermFeatureSets:
             return NameAndTermFeatureSets(sets)
         from photon_ml_tpu.io.avro import read_records as _rr
 
+        if policy is not None:
+            policy.begin(len(files))
         return NameAndTermFeatureSets.from_records(
-            (r for p in paths for r in _rr(p)), section_keys)
+            (r for p in paths for r in _rr(p, policy=policy)),
+            section_keys)
 
     def index_map(self, section_keys: Sequence[str],
                   add_intercept: bool) -> IndexMap:
@@ -931,6 +1015,20 @@ class NameAndTermFeatureSets:
     @staticmethod
     def load(directory: str,
              section_keys: Sequence[str]) -> "NameAndTermFeatureSets":
+        # feature maps are REQUIRED state — no quarantine here, but the
+        # read retries transient I/O (drillable at io.index_map) and a
+        # persistent failure surfaces as RetryExhaustedError, which the
+        # drivers map to a clean abort
+        def attempt():
+            fault_point("io.index_map", tag=os.path.basename(directory))
+            return NameAndTermFeatureSets._load_once(directory,
+                                                     section_keys)
+
+        return call_with_retry(attempt, site="io.index_map")
+
+    @staticmethod
+    def _load_once(directory: str,
+                   section_keys: Sequence[str]) -> "NameAndTermFeatureSets":
         sets: dict[str, set[tuple[str, str]]] = {}
         for section in section_keys:
             pairs = set()

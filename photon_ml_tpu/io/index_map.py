@@ -24,6 +24,9 @@ from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
+from photon_ml_tpu.utils.faults import fault_point
+from photon_ml_tpu.utils.retry import call_with_retry
+
 DELIMITER = ""
 INTERCEPT_NAME = "(INTERCEPT)"
 INTERCEPT_TERM = ""
@@ -120,14 +123,23 @@ class IndexMap:
 
     @staticmethod
     def load(directory: str, namespace: str = "global") -> "IndexMap":
-        with open(os.path.join(directory, f"{namespace}-meta.json")) as fh:
-            meta = json.load(fh)
-        fwd: dict[str, int] = {}
-        for p in range(meta["numPartitions"]):
-            with open(os.path.join(
-                    directory, f"{namespace}-index-map-{p}.json")) as fh:
-                fwd.update(json.load(fh))
-        return IndexMap(fwd)
+        # transient-I/O retries, drillable at io.index_map; a feature map
+        # is required state, so persistent failure surfaces as
+        # RetryExhaustedError (the drivers' clean-abort path)
+        def attempt():
+            fault_point("io.index_map", tag=namespace)
+            with open(os.path.join(directory,
+                                   f"{namespace}-meta.json")) as fh:
+                meta = json.load(fh)
+            fwd: dict[str, int] = {}
+            for p in range(meta["numPartitions"]):
+                with open(os.path.join(
+                        directory,
+                        f"{namespace}-index-map-{p}.json")) as fh:
+                    fwd.update(json.load(fh))
+            return IndexMap(fwd)
+
+        return call_with_retry(attempt, site="io.index_map")
 
     # -- off-heap conversion ----------------------------------------------
 
@@ -164,9 +176,14 @@ class OffHeapIndexMap:
                  expected_partitions: Optional[int] = None):
         self._dir = directory
         self._ns = namespace
-        with open(os.path.join(
-                directory, f"{namespace}-offheap-meta.json")) as fh:
-            meta = json.load(fh)
+
+        def read_meta():
+            fault_point("io.index_map", tag=namespace)
+            with open(os.path.join(
+                    directory, f"{namespace}-offheap-meta.json")) as fh:
+                return json.load(fh)
+
+        meta = call_with_retry(read_meta, site="io.index_map")
         self._num_partitions = int(meta["numPartitions"])
         if (expected_partitions is not None
                 and expected_partitions != self._num_partitions):

@@ -31,11 +31,13 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 import zlib
 from typing import Any, Optional
 
 import numpy as np
 
+from photon_ml_tpu.utils.faults import fault_point
 from photon_ml_tpu.io.avro import (
     MAGIC,
     PRIMITIVES,
@@ -172,6 +174,9 @@ def _read_blocks(path: str) -> Optional[tuple]:
 
     Any truncation (header metadata, block varints, payload) declines the
     fast path with None; the interpreted reader raises the diagnostic."""
+    # same OS-level drill site as io/avro.py's interpreted reader: both
+    # decode paths hit identical injected open failures
+    fault_point("io.shard_open", tag=os.path.basename(path))
     with open(path, "rb") as fh:
         buf = fh.read()
     if buf[:4] != MAGIC:

@@ -12,6 +12,9 @@ Counters written (all on the process registry unless one is injected):
 - ``faults{point, coordinate}`` — one per :class:`FaultEvent`
 - ``recoveries{action}`` — retried / recovered / skipped / aborted
 - ``quarantines{coordinate}`` — per-coordinate freeze events
+- ``faults{point="io.shard"}`` — data shards lost to degraded ingest
+  (the per-stage ``quarantined_shards`` counter is written directly by
+  the :class:`~photon_ml_tpu.data.ingest.IngestPolicy`)
 - ``optimization_logs`` — per-model optimization records (legacy driver)
 """
 
@@ -26,6 +29,7 @@ from photon_ml_tpu.utils.events import (
     FaultEvent,
     PhotonOptimizationLogEvent,
     RecoveryEvent,
+    ShardQuarantinedEvent,
 )
 
 
@@ -45,6 +49,11 @@ class MetricsEventListener:
             # before RecoveryEvent: both are terminal records, but a
             # quarantine is NOT a recovery action
             r.counter("quarantines").inc(coordinate=event.coordinate_id)
+        elif isinstance(event, ShardQuarantinedEvent):
+            # the IngestPolicy already counts quarantined_shards{stage}
+            # directly (it must work without an event bus); here the
+            # event only contributes to the faults stream for symmetry
+            r.counter("faults").inc(point="io.shard", coordinate="")
         elif isinstance(event, RecoveryEvent):
             r.counter("recoveries").inc(action=event.action)
         elif isinstance(event, PhotonOptimizationLogEvent):

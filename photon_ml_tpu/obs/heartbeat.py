@@ -81,10 +81,16 @@ class Heartbeat:
         if stalled and not self.stalled:
             self._registry.counter("stalls").inc()
             if self._warn is not None:
+                # dump the FULL open-span stack with per-span ages into
+                # the driver log — a hung chaos run must be diagnosable
+                # from the log alone (which span is wedged, how long)
+                report = self.tracer.open_span_report()
+                stack_dump = ("\n  ".join(report) if report
+                              else "(no open spans)")
                 self._warn(
                     f"heartbeat: STALL — no span closed in {age:.1f}s "
-                    f"(window {self.stall_seconds:.1f}s); open spans: "
-                    f"{record['open_spans']}")
+                    f"(window {self.stall_seconds:.1f}s); open-span "
+                    f"stack:\n  {stack_dump}")
         self.stalled = stalled
         self.beats += 1
         if self.out_path is not None:

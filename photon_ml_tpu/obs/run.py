@@ -34,6 +34,17 @@ from typing import Callable, Optional
 from photon_ml_tpu.obs import trace
 from photon_ml_tpu.obs.heartbeat import Heartbeat
 from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.utils.faults import fault_point
+from photon_ml_tpu.utils.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+    call_with_retry,
+)
+
+#: Trace-export retry: short and bounded — observability I/O must never
+#: stall (or kill) the run it is observing.
+_FLUSH_RETRY = RetryPolicy(max_attempts=3, base_delay_seconds=0.01,
+                           max_delay_seconds=0.1)
 
 
 def _git_describe(cwd: str) -> Optional[str]:
@@ -152,6 +163,7 @@ class ObservedRun:
             open(self.metrics_path, "w").close()
         open(self.spans_path, "w").close()  # this incarnation's spill
 
+        self._warn = warn
         self._spill_lock = threading.Lock()
         self._pending: list = []  # drained but not yet durably written
         self.tracer = trace.enable(process_index=process_index)
@@ -176,19 +188,42 @@ class ObservedRun:
             if len(self._pending) > cap:
                 self.tracer.spans_dropped += len(self._pending) - cap
                 self._pending = self._pending[-cap:]
-            with open(self.spans_path, "a") as fh:
-                for e in self._pending:
-                    fh.write(json.dumps(e) + "\n")
+
+            def write():
+                # the obs.flush drill site: a full disk / flaky trace
+                # mount retries briefly and then keeps the interval
+                # PENDING — observability I/O can degrade, never kill
+                fault_point("obs.flush", path=self.spans_path)
+                with open(self.spans_path, "a") as fh:
+                    for e in self._pending:
+                        fh.write(json.dumps(e) + "\n")
+
+            call_with_retry(write, site="obs.flush", policy=_FLUSH_RETRY)
             self._pending = []
 
     def finish(self) -> None:
         """Stop the heartbeat and flush trace + metrics files
-        (idempotent; call from the driver's ``finally``)."""
+        (idempotent; call from the driver's ``finally``). Every export
+        step is CONTAINED: a dead disk at exit loses trace output (with
+        a warning), never the run's exit status."""
         if self._finished:
             return
         self._finished = True
         self.heartbeat.stop()
-        self._spill()
+        for step, fn in (("spill", self._spill),
+                         ("manifest", self._finish_manifest),
+                         ("trace", self._finish_trace),
+                         ("metrics", self._finish_metrics)):
+            try:
+                fn()
+            except (OSError, ValueError, RetryExhaustedError) as e:
+                if self._warn is not None:
+                    self._warn(f"trace export ({step}) failed at finish: "
+                               f"{e!r} — continuing")
+        if trace.get_tracer() is self.tracer:
+            trace.disable()
+
+    def _finish_manifest(self) -> None:
         if self._manifest_args["num_processes"] > 1:
             # the gang is formed (or the run is over): the backend can be
             # probed safely now — rewrite the manifest with the live
@@ -196,17 +231,31 @@ class ObservedRun:
             with open(self.manifest_path, "w") as fh:
                 json.dump(run_manifest(probe_backend=True,
                                        **self._manifest_args), fh, indent=1)
+
+    def _finish_trace(self) -> None:
+        events = []
         with open(self.spans_path) as fh:
-            events = [json.loads(line) for line in fh if line.strip()]
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line from a killed incarnation
         doc = trace.chrome_document(events, self.tracer.process_index,
                                     self.tracer.start_unix)
         with open(self.trace_path, "w") as fh:
             json.dump(doc, fh)
-        with open(self.metrics_path, "a") as fh:
-            for record in self._registry.snapshot():
-                fh.write(json.dumps(record) + "\n")
-        if trace.get_tracer() is self.tracer:
-            trace.disable()
+
+    def _finish_metrics(self) -> None:
+        def write():
+            fault_point("obs.flush", path=self.metrics_path)
+            with open(self.metrics_path, "a") as fh:
+                for record in self._registry.snapshot():
+                    fh.write(json.dumps(record) + "\n")
+
+        call_with_retry(write, site="obs.flush", policy=_FLUSH_RETRY)
 
 
 def start_observed_run(trace_dir: str, **kwargs) -> ObservedRun:

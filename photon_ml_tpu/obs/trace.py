@@ -64,8 +64,10 @@ class _Span:
     def __enter__(self):
         stack = self._tracer._stack()
         self._depth = len(stack)
-        stack.append(self._name)
         self._start_ns = time.perf_counter_ns()
+        # (name, start_ns): the open-span report needs per-span ages to
+        # make a stalled run diagnosable from the log alone
+        stack.append((self._name, self._start_ns))
         return self
 
     def __exit__(self, *exc):
@@ -144,8 +146,26 @@ class Tracer:
             stacks = list(self._stacks.values())
         out: list[str] = []
         for stack in stacks:
-            out.extend(list(stack))
+            out.extend(name for name, _ in list(stack))
         return out
+
+    def open_span_report(self) -> list[str]:
+        """Per-thread open-span stacks WITH per-span ages, outermost
+        first — the postmortem the heartbeat dumps into the driver log
+        on a stall episode, so a hung run is diagnosable from the log
+        alone (which span is wedged, and for how long)."""
+        now = time.perf_counter_ns()
+        with self._lock:
+            stacks = list(self._stacks.items())
+        lines: list[str] = []
+        for tid, stack in stacks:
+            snap = list(stack)
+            if not snap:
+                continue
+            chain = " > ".join(f"{name} (open {(now - start) / 1e9:.1f}s)"
+                               for name, start in snap)
+            lines.append(f"thread {tid}: {chain}")
+        return lines
 
     def uptime_seconds(self) -> float:
         return (time.perf_counter_ns() - self._t0_ns) / 1e9

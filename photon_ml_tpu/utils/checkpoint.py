@@ -38,6 +38,7 @@ import io
 import json
 import os
 import shutil
+import zipfile
 import zlib
 from typing import Any, Optional
 
@@ -45,15 +46,40 @@ import numpy as np
 
 from photon_ml_tpu.obs import trace
 from photon_ml_tpu.utils.faults import fault_point, hits as fault_hits
+from photon_ml_tpu.utils.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+    call_with_retry,
+)
 
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"
 _STEP_PREFIX = "step_"
 _TMP_SUFFIX = ".tmp"
 
+#: Retry schedule for the snapshot payload write (the ``ckpt.write_bytes``
+#: fault site): transient ENOSPC/EIO re-write the tmp dir from scratch.
+_WRITE_RETRY = RetryPolicy(max_attempts=4, base_delay_seconds=0.02,
+                           max_delay_seconds=0.5)
+
 
 class CheckpointCorruptionError(RuntimeError):
     """An explicitly requested step failed integrity verification."""
+
+
+#: What a torn-but-checksummed step raises on read: np.load surfaces a
+#: truncated npz as BadZipFile, a mangled one as ValueError/KeyError/OSError.
+_UNREADABLE_STEP_ERRORS = (OSError, ValueError, KeyError,
+                           zipfile.BadZipFile)
+
+
+class CheckpointWriteError(RuntimeError):
+    """A snapshot could not be written durably (retries exhausted — e.g.
+    a persistently full disk). The caller decides whether losing THIS
+    snapshot is survivable; the coordinate-descent loop treats it as
+    degraded-but-alive (training continues, the failure is logged and
+    counted) since checkpoints are a durability aid, not training
+    state."""
 
 
 def _flatten(obj: Any, path: str, arrays: dict[str, np.ndarray]):
@@ -199,29 +225,76 @@ class CheckpointManager:
                 return step
         return None
 
+    def clean_stale_tmp(self) -> int:
+        """Remove leftover ``step_*.tmp`` dirs (a save killed before its
+        atomic rename leaves one behind; anything still suffixed ``.tmp``
+        is by definition unpublished garbage). Runs on every ``save()``
+        and ``restore()`` so a crash-looping run can't accumulate
+        partial-write litter. Returns the number removed."""
+        removed = 0
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith(_STEP_PREFIX) and name.endswith(_TMP_SUFFIX):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+                removed += 1
+        return removed
+
     # -- save/restore ------------------------------------------------------
 
     def save(self, step: int, state: Any) -> None:
         """Durable and atomic: write + checksum + fsync into a tmp dir,
-        then rename; the manifest carries the data files' crc32s."""
+        then rename; the manifest carries the data files' crc32s.
+
+        The payload write is retried (``utils/retry``): a transient
+        ENOSPC/EIO — drillable at the ``ckpt.write_bytes`` fault point,
+        which fires between the array write and its checksum — rewrites
+        the tmp dir from scratch; persistent failure raises
+        :class:`CheckpointWriteError` with the tmp dir cleaned up, so an
+        unwritable disk degrades checkpointing instead of littering the
+        directory."""
         with trace.span("ckpt.save", step=step):
+            self.clean_stale_tmp()
             final = self._step_dir(step)
             tmp = final + _TMP_SUFFIX
-            if os.path.exists(tmp):
-                shutil.rmtree(tmp)
-            os.makedirs(tmp)
             arrays: dict[str, np.ndarray] = {}
             skeleton = _flatten(state, "root", arrays)
             arrays_path = os.path.join(tmp, _ARRAYS)
-            np.savez(arrays_path, **arrays)
-            _fsync_file(arrays_path)
-            # manifest written LAST: its presence marks the step complete
-            with open(os.path.join(tmp, _MANIFEST), "w") as fh:
-                json.dump({"step": step, "format_version": 2,
-                           "checksums": {_ARRAYS: _file_crc32(arrays_path)},
-                           "skeleton": skeleton}, fh)
-                fh.flush()
-                os.fsync(fh.fileno())
+
+            def write_tmp():
+                if os.path.exists(tmp):
+                    shutil.rmtree(tmp)
+                os.makedirs(tmp)
+                np.savez(arrays_path, **arrays)
+                # fires BETWEEN the payload write and its checksum: a
+                # `partial`-mode drill here is a torn write whose crc
+                # faithfully records the torn bytes — the published step
+                # verifies but cannot be loaded, and restore() must fall
+                # back PAST it; enospc/io_error/flaky are transient write
+                # failures the retry recovers by rewriting the tmp dir
+                fault_point("ckpt.write_bytes", path=arrays_path)
+                _fsync_file(arrays_path)
+                # manifest written LAST: its presence marks the step
+                # complete
+                with open(os.path.join(tmp, _MANIFEST), "w") as fh:
+                    json.dump(
+                        {"step": step, "format_version": 2,
+                         "checksums": {_ARRAYS: _file_crc32(arrays_path)},
+                         "skeleton": skeleton}, fh)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+
+            try:
+                call_with_retry(write_tmp, site="ckpt.write_bytes",
+                                policy=_WRITE_RETRY)
+            except RetryExhaustedError as e:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise CheckpointWriteError(
+                    f"checkpoint step {step} under {self.directory} "
+                    f"could not be written: {e}") from e
             fired_before = fault_hits("ckpt.save")
             fault_point("ckpt.save", path=tmp)
             if os.path.exists(final):
@@ -229,8 +302,10 @@ class CheckpointManager:
             os.rename(tmp, final)
             _fsync_dir(self.directory)
             # the bytes just checksummed+fsync'd are known-good unless a
-            # ckpt.save drill tampered with them — skip re-reading them in
-            # retention's verified-step scan on the common path
+            # ckpt.save drill tampered with them POST-checksum — skip
+            # re-reading them in retention's verified-step scan on the
+            # common path (a ckpt.write_bytes partial-write drill fires
+            # PRE-checksum, so its torn bytes still verify: trust holds)
             self._retain(trusted_step=(
                 None if fault_hits("ckpt.save") != fired_before else step))
 
@@ -255,6 +330,14 @@ class CheckpointManager:
         raise FileNotFoundError(
             f"no valid checkpoints under {self.directory}")
 
+    def _read_step(self, step: int) -> Any:
+        d = self._step_dir(step)
+        with open(os.path.join(d, _MANIFEST)) as fh:
+            manifest = json.load(fh)
+        with np.load(os.path.join(d, _ARRAYS)) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+        return _unflatten(manifest["skeleton"], arrays)
+
     def restore(self, step: Optional[int] = None) -> Any:
         """Restore ``step``, or (by default) the newest step that passes
         integrity verification. An explicitly requested corrupt step
@@ -267,11 +350,18 @@ class CheckpointManager:
         The ``ckpt.restore`` fault point fires on the step about to be
         read, BEFORE it is read: a ``corrupt``-mode drill flips its bytes
         and the default path must fall back to an older intact step, the
-        mirror image of the ``ckpt.save`` drill. The integrity scan is
-        re-run only when a fault actually fired (the hit counter moved) —
-        the common restore pays for ONE scan."""
+        mirror image of the ``ckpt.save`` drill.
+
+        Hardened against steps that VERIFY but cannot be loaded (a torn
+        write whose checksum faithfully recorded the torn bytes — the
+        ``ckpt.write_bytes`` partial drill): a failed read on the default
+        path falls back to the next verified+readable step instead of
+        crashing; on an explicit step it raises
+        :class:`CheckpointCorruptionError`. The common restore still pays
+        for exactly ONE integrity scan and ONE read."""
         with trace.span("ckpt.restore",
                         step=(-1 if step is None else step)):
+            self.clean_stale_tmp()
             explicit = step is not None
             if not explicit:
                 step = self._latest_valid_or_raise()
@@ -286,34 +376,70 @@ class CheckpointManager:
                 # a drill just touched the chosen step: re-resolve so a
                 # corrupt-mode fault exercises the real fallback path
                 step = self._latest_valid_or_raise()
-            d = self._step_dir(step)
-            with open(os.path.join(d, _MANIFEST)) as fh:
-                manifest = json.load(fh)
-            with np.load(os.path.join(d, _ARRAYS)) as npz:
-                arrays = {k: npz[k] for k in npz.files}
-            return _unflatten(manifest["skeleton"], arrays)
+            try:
+                return self._read_step(step)
+            except _UNREADABLE_STEP_ERRORS as e:
+                if explicit:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {step} under {self.directory} "
+                        f"verified but could not be loaded: {e!r}") from e
+                unreadable = {step}
+            # default-path fallback: newest-first past every step that
+            # fails verification or fails to load
+            for cand in reversed(self.all_steps()):
+                if cand in unreadable or not self.verify_step(cand):
+                    continue
+                try:
+                    return self._read_step(cand)
+                except _UNREADABLE_STEP_ERRORS:
+                    unreadable.add(cand)
+            raise CheckpointCorruptionError(
+                f"checkpoint dir {self.directory} has no step that both "
+                f"verifies and loads ({len(unreadable)} verified step(s) "
+                f"failed to read — torn writes?); clear the directory to "
+                f"retrain from scratch")
+
+    def _step_loadable(self, step: int) -> bool:
+        """Cheap readability probe: a torn write that still checksums
+        (the crc was computed over the already-truncated bytes — the
+        ``ckpt.write_bytes`` partial drill) breaks the npz's zip central
+        directory, so just OPENING it detects the tear without
+        decompressing anything. Byte-flip corruption is the crc scan's
+        job — the two checks are complementary."""
+        try:
+            with zipfile.ZipFile(
+                    os.path.join(self._step_dir(step), _ARRAYS)):
+                return True
+        except (OSError, zipfile.BadZipFile):
+            return False
 
     def _retain(self, trusted_step: Optional[int] = None) -> None:
         """Prune to the newest ``max_to_keep`` steps — but never
-        garbage-collect the only VERIFIED snapshot: if every step inside
-        the keep window is corrupt (torn writes racing a crash), the
-        newest verified step outside the window survives too, so a later
-        restore still has something intact to fall back to.
-        ``trusted_step`` is a step known valid without re-reading it
-        (save() just checksummed its bytes), so the common save pays no
-        verification I/O at all."""
+        garbage-collect the only RESTORABLE snapshot: if every step
+        inside the keep window is corrupt OR torn (a torn write still
+        checksums — its crc recorded the torn bytes — but cannot be
+        loaded), the newest verified+loadable step outside the window
+        survives too, so a later restore still has something to fall
+        back to. ``trusted_step`` is a step whose bytes save() just
+        checksummed, so it skips the crc read-back — but NOT the zip
+        probe, which is exactly what catches a torn trusted write."""
         if self.max_to_keep is None:
             return
         steps = self.all_steps()
         if len(steps) <= self.max_to_keep:
             return  # nothing would be pruned: skip the verification scan
         keep = set(steps[-self.max_to_keep:])
-        # newest-first: the just-written step usually verifies on the
-        # first pass, so a pruning save costs one crc read-back at most
-        if trusted_step not in keep and not any(
-                self.verify_step(s) for s in sorted(keep, reverse=True)):
+
+        def restorable(s: int) -> bool:
+            return ((s == trusted_step or self.verify_step(s))
+                    and self._step_loadable(s))
+
+        # newest-first: the just-written step usually passes on the
+        # first probe, so a pruning save costs one zip-directory open
+        # (and at most one crc read-back) on the common path
+        if not any(restorable(s) for s in sorted(keep, reverse=True)):
             for s in reversed(steps):
-                if s not in keep and self.verify_step(s):
+                if s not in keep and restorable(s):
                     keep.add(s)
                     break
         for step in steps:

@@ -93,6 +93,20 @@ class CoordinateQuarantinedEvent(Event):
     message: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class ShardQuarantinedEvent(Event):
+    """A data shard was skipped by the degraded-ingest layer
+    (``photon_ml_tpu/data/ingest.py``): corrupt, truncated, or
+    persistently unreadable after retries. Training continues on the
+    surviving shards; the recorded coverage fraction and the
+    ``--max-shard-loss-frac`` threshold decide whether the run is
+    allowed to proceed degraded or must abort cleanly."""
+
+    path: str
+    stage: str  # "open" | "decode" | "index"
+    reason: str = ""
+
+
 EventListener = Callable[[Event], None]
 
 _ERROR_LOGGER = None

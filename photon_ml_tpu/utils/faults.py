@@ -7,44 +7,44 @@ way to script them reproducibly. This module is the single switchboard:
 production code calls :func:`fault_point` at named sites and tests (or an
 operator drilling a cluster) arm failures against those names.
 
-Fault points instrumented in the codebase:
+The fault points instrumented in the codebase are enumerated in
+:data:`FAULT_POINTS` (the machine-readable registry the chaos campaign
+``tools/chaos_drill.py`` sweeps) and documented row-for-row in the README
+``PHOTON_FAULTS`` table, which photonlint W401/W402 keeps in sync with
+the call sites in both directions.
 
-- ``cd.update``          — after each coordinate-descent coordinate update
-                           (game/coordinate_descent.py); tagged
-                           ``"<sweep>.<coordinate_index>"`` so a drill can
-                           kill one SPECIFIC update mid-sweep (e.g.
-                           ``cd.update@1.1=kill:1``)
-- ``cd.sweep``           — at the top of each coordinate-descent sweep,
-                           tagged with the sweep index (both the
-                           single-process loop in
-                           game/coordinate_descent.py and the multi-host
-                           one in parallel/multihost.py)
-- ``optimizer.gradient`` — on the solver output of a GLM solve
-                           (optimize/problem.py)
-- ``ckpt.save``          — after a checkpoint's tmp dir is fully written,
-                           before the atomic rename (utils/checkpoint.py)
-- ``ckpt.restore``       — on the checkpoint step about to be read, before
-                           it is read (utils/checkpoint.py); ``corrupt``
-                           flips its bytes so the restore must fall back
-                           to an older intact step, ``raise`` fails the
-                           restore outright
-- ``worker.start``       — in a multi-host worker right after
-                           ``jax.distributed.initialize``
-                           (parallel/multihost.py)
+Modes:
 
-Modes: ``raise`` (InjectedFault), ``nan`` (poison the arrays passed to the
-point), ``delay`` (sleep), ``corrupt`` (flip bytes of the file/dir passed
-to the point), ``kill`` (``os._exit``).
+- ``raise``    — raise :class:`InjectedFault` (a transient stand-in the
+                 retry layer in ``utils/retry.py`` recovers from)
+- ``nan``      — poison the float arrays passed to the point
+- ``delay``    — sleep ``arg`` seconds (default 1.0)
+- ``slow``     — sleep like ``delay`` but with a small default (0.05s):
+                 the "laggy NFS" drill for I/O sites
+- ``corrupt``  — flip bytes in the middle of the file/dir passed to the
+                 point
+- ``partial``  — truncate the file/dir passed to the point to half its
+                 size (a torn write)
+- ``kill``     — ``os._exit(arg)`` (default 17)
+- ``io_error`` — raise ``OSError(EIO)`` (retryable I/O failure)
+- ``enospc``   — raise ``OSError(ENOSPC)`` (disk full)
+- ``flaky``    — probabilistic ``OSError(EIO)``: each VISIT to the point
+                 fires with probability ``arg`` (default 0.5), decided by
+                 a deterministic hash of (``PHOTON_FAULTS_SEED``, point,
+                 tag, visit index) — the same seed reproduces the same
+                 firing pattern in every process, so a flaky-I/O drill is
+                 replayable bit-for-bit
 
 Arming:
 
 - programmatic: ``arm("cd.update", "raise", times=2)``
 - environment:  ``PHOTON_FAULTS="worker.start@0=kill:1;ckpt.save=raise:1"``
   — ``point[@tag]=mode[:times[:arg]]``, ``;``-separated. ``times`` bounds
-  total firings (default 1); ``arg`` is seconds for ``delay`` and the exit
-  code for ``kill``. A ``@tag`` suffix restricts the spec to call sites
-  passing that ``tag`` (e.g. the multi-host process id), so one shared
-  environment can target a single worker of a gang.
+  total firings (default 1); ``arg`` is seconds for ``delay``/``slow``,
+  the exit code for ``kill``, and the firing probability for ``flaky``.
+  A ``@tag`` suffix restricts the spec to call sites passing that ``tag``
+  (e.g. the multi-host process id), so one shared environment can target
+  a single worker of a gang.
 
 Cross-process accounting: when ``PHOTON_FAULTS_STATE_DIR`` is set, each
 firing atomically claims a marker file there (``O_CREAT|O_EXCL``), so a
@@ -56,6 +56,8 @@ the gang-restart tests depend on.
 from __future__ import annotations
 
 import dataclasses
+import errno
+import hashlib
 import os
 import threading
 import time
@@ -63,8 +65,76 @@ from typing import Any, Optional
 
 ENV_SPECS = "PHOTON_FAULTS"
 ENV_STATE_DIR = "PHOTON_FAULTS_STATE_DIR"
+ENV_SEED = "PHOTON_FAULTS_SEED"
 
-MODES = ("raise", "nan", "delay", "corrupt", "kill")
+MODES = ("raise", "nan", "delay", "slow", "corrupt", "partial", "kill",
+         "io_error", "enospc", "flaky")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPointInfo:
+    """One registered fault site: where it fires and which modes make
+    sense there (the chaos campaign sweeps ``point × modes``)."""
+
+    description: str
+    modes: tuple[str, ...]
+    has_path: bool = False  # the site passes a file/dir (corrupt/partial)
+    multihost_only: bool = False
+
+
+#: The registry of drillable fault points. tools/chaos_drill.py sweeps
+#: this table; the README PHOTON_FAULTS table documents it row-for-row
+#: (photonlint W401/W402 reconciles table ⇄ call sites, and
+#: tests/test_chaos.py reconciles table ⇄ this registry).
+FAULT_POINTS: dict[str, FaultPointInfo] = {
+    "cd.update": FaultPointInfo(
+        "after each coordinate-descent coordinate update "
+        "(game/coordinate_descent.py); tag <sweep>.<coordinate_index>",
+        modes=("raise", "nan", "delay", "kill")),
+    "cd.sweep": FaultPointInfo(
+        "at the top of each CD sweep (single-process and multi-host "
+        "loops); tag = sweep index",
+        modes=("delay", "kill")),
+    "optimizer.gradient": FaultPointInfo(
+        "on the solver output of a GLM solve (optimize/problem.py)",
+        modes=("raise", "nan")),
+    "ckpt.save": FaultPointInfo(
+        "after a snapshot's tmp dir is written, before the atomic "
+        "rename (utils/checkpoint.py)",
+        modes=("raise", "kill", "corrupt"), has_path=True),
+    "ckpt.restore": FaultPointInfo(
+        "on the snapshot about to be read, before it is read "
+        "(utils/checkpoint.py)",
+        modes=("raise", "corrupt"), has_path=True),
+    "ckpt.write_bytes": FaultPointInfo(
+        "after the snapshot's array payload is written, before it is "
+        "checksummed (utils/checkpoint.py)",
+        modes=("io_error", "enospc", "flaky", "partial", "kill"),
+        has_path=True),
+    "io.shard_open": FaultPointInfo(
+        "before an Avro shard's bytes are opened/read (io/avro.py "
+        "interpreted reader AND io/native_avro.py native reader); tag = "
+        "shard basename",
+        modes=("raise", "io_error", "flaky", "slow", "delay")),
+    "io.avro_read": FaultPointInfo(
+        "per shard at decode time in the part-iteration loops "
+        "(io/avro.py read_directory, io/data_format.py GAME ingest); "
+        "tag = shard basename; corrupt/partial mutate the shard on disk",
+        modes=("raise", "io_error", "corrupt", "partial", "flaky"),
+        has_path=True),
+    "io.index_map": FaultPointInfo(
+        "on a feature index-map load (io/index_map.py IndexMap.load / "
+        "OffHeapIndexMap, io/data_format.py NameAndTermFeatureSets.load)",
+        modes=("raise", "io_error", "flaky", "slow")),
+    "obs.flush": FaultPointInfo(
+        "before the observability layer appends spans/metrics to the "
+        "trace dir (obs/run.py)",
+        modes=("io_error", "enospc", "flaky")),
+    "worker.start": FaultPointInfo(
+        "in a multi-host worker right after jax.distributed.initialize "
+        "(parallel/multihost.py); tag = process id",
+        modes=("raise", "kill", "delay"), multihost_only=True),
+}
 
 
 class InjectedFault(RuntimeError):
@@ -77,20 +147,52 @@ class InjectedFault(RuntimeError):
 
 @dataclasses.dataclass
 class FaultSpec:
-    """One armed failure: fires at ``point`` up to ``times`` times."""
+    """One armed failure: fires at ``point`` up to ``times`` times.
+
+    ``probability`` only matters for ``flaky``: each VISIT decides
+    independently (and deterministically, see :func:`flaky_decision`)
+    whether to fire; ``times`` still bounds the total firings."""
 
     point: str
     mode: str
     times: int = 1
     tag: Optional[str] = None  # only fire for matching fault_point(tag=...)
-    delay_seconds: float = 1.0
+    # None = mode default (1.0s for delay, 0.05s for slow) — a sentinel,
+    # not a magic value, so an EXPLICIT 1.0s slow drill stays 1.0s
+    delay_seconds: Optional[float] = None
     exit_code: int = 17
+    probability: float = 0.5
     fired: int = 0
+    visits: int = 0  # flaky-mode visit counter (the decision index)
 
     def __post_init__(self):
         if self.mode not in MODES:
             raise ValueError(f"unknown fault mode {self.mode!r}; "
                              f"expected one of {MODES}")
+        if self.delay_seconds is None:
+            self.delay_seconds = 0.05 if self.mode == "slow" else 1.0
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(
+                f"flaky probability must be in [0, 1], "
+                f"got {self.probability}")
+
+
+def flaky_decision(seed: int, point: str, tag: Optional[str],
+                   visit: int, probability: float) -> bool:
+    """Deterministic per-visit firing decision for ``flaky`` mode: a
+    keyed blake2b hash of (seed, point, tag, visit) mapped to [0, 1) and
+    compared against ``probability`` — the same seed reproduces the same
+    firing pattern in every process that visits the point the same
+    number of times (the replayability contract the flaky-I/O drills
+    depend on)."""
+    if probability <= 0.0:
+        return False
+    if probability >= 1.0:
+        return True
+    key = f"{seed}:{point}:{tag or ''}:{visit}".encode("utf-8")
+    h = int.from_bytes(
+        hashlib.blake2b(key, digest_size=8).digest(), "big")
+    return (h / 2.0 ** 64) < probability
 
 
 class FaultRegistry:
@@ -105,10 +207,12 @@ class FaultRegistry:
     # -- arming ------------------------------------------------------------
 
     def arm(self, point: str, mode: str, times: int = 1,
-            tag: Optional[str] = None, delay_seconds: float = 1.0,
-            exit_code: int = 17) -> FaultSpec:
+            tag: Optional[str] = None,
+            delay_seconds: Optional[float] = None,
+            exit_code: int = 17, probability: float = 0.5) -> FaultSpec:
         spec = FaultSpec(point=point, mode=mode, times=times, tag=tag,
-                         delay_seconds=delay_seconds, exit_code=exit_code)
+                         delay_seconds=delay_seconds, exit_code=exit_code,
+                         probability=probability)
         with self._lock:
             self._specs.append(spec)
         return spec
@@ -158,7 +262,8 @@ class FaultRegistry:
         # same markers and silently starve one another's budget
         key = "_".join(str(p) for p in (
             spec.point, spec.tag or "", spec.mode, spec.times,
-            spec.delay_seconds, spec.exit_code)).replace(os.sep, "_")
+            spec.delay_seconds, spec.exit_code,
+            spec.probability)).replace(os.sep, "_")
         for n in range(spec.times):
             marker = os.path.join(state_dir, f"{key}.{n}")
             try:
@@ -183,24 +288,42 @@ class FaultRegistry:
         if not specs:
             return arrays
         for spec in specs:
+            if spec.mode == "flaky":
+                # the per-visit decision is deterministic in
+                # (PHOTON_FAULTS_SEED, point, tag, visit index): same
+                # seed → same firing pattern in every process
+                with self._lock:
+                    visit = spec.visits
+                    spec.visits += 1
+                seed = int(os.environ.get(ENV_SEED, "0") or 0)
+                if not flaky_decision(seed, point, tag, visit,
+                                      spec.probability):
+                    continue
             if not self._claim(spec):
                 continue
             with self._lock:
                 self._hits[point] = self._hits.get(point, 0) + 1
             if spec.mode == "raise":
                 raise InjectedFault(point)
-            if spec.mode == "delay":
+            if spec.mode in ("io_error", "flaky"):
+                raise OSError(errno.EIO,
+                              f"injected I/O error at {point!r}")
+            if spec.mode == "enospc":
+                raise OSError(errno.ENOSPC,
+                              f"injected ENOSPC at {point!r}")
+            if spec.mode in ("delay", "slow"):
                 time.sleep(spec.delay_seconds)
             elif spec.mode == "kill":
                 os._exit(spec.exit_code)
             elif spec.mode == "nan":
                 arrays = poison_arrays(arrays)
-            elif spec.mode == "corrupt":
+            elif spec.mode in ("corrupt", "partial"):
                 if path is None:
                     raise InjectedFault(
-                        point, f"corrupt-mode fault at {point!r} needs a "
-                               f"path at the call site")
-                corrupt_path(path)
+                        point, f"{spec.mode}-mode fault at {point!r} "
+                               f"needs a path at the call site")
+                (corrupt_path if spec.mode == "corrupt"
+                 else truncate_path)(path)
         return arrays
 
 
@@ -221,10 +344,12 @@ def parse_fault_specs(raw: str) -> list[FaultSpec]:
         times = int(parts[1]) if len(parts) > 1 and parts[1] else 1
         kwargs: dict[str, Any] = {}
         if len(parts) > 2 and parts[2]:
-            if mode == "delay":
+            if mode in ("delay", "slow"):
                 kwargs["delay_seconds"] = float(parts[2])
             elif mode == "kill":
                 kwargs["exit_code"] = int(parts[2])
+            elif mode == "flaky":
+                kwargs["probability"] = float(parts[2])
         specs.append(FaultSpec(point=point.strip(), mode=mode, times=times,
                                tag=tag or None, **kwargs))
     return specs
@@ -257,6 +382,21 @@ def poison_arrays(arrays: Any) -> Any:
             return np.full_like(arrays, np.nan)
         return jnp.full_like(arrays, jnp.nan)
     return arrays
+
+
+def truncate_path(path: str) -> None:
+    """Truncate ``path`` (a file) to half its size, or every regular file
+    under it (a directory) — the torn/partial-write primitive the
+    ``partial`` fault mode and the degraded-ingest drills use."""
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            sub = os.path.join(path, name)
+            if os.path.isfile(sub):
+                truncate_path(sub)
+        return
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 2)
 
 
 def corrupt_path(path: str) -> None:

@@ -1,0 +1,149 @@
+"""Deadline/backoff retry combinator with deterministic jitter.
+
+The graceful-degradation layer's lowest brick: every I/O the trainer
+cannot afford to die on (checkpoint writes, Avro shard reads, index-map
+loads, trace export) goes through :func:`call_with_retry`, which retries
+TRANSIENT failures (``OSError`` and the drillable
+:class:`~photon_ml_tpu.utils.faults.InjectedFault`) with exponential
+backoff and gives up into :class:`RetryExhaustedError` — the typed
+signal the quarantine/clean-abort layers above dispatch on. Permanent
+failures (``ValueError`` from a corrupt decode, say) propagate on the
+first attempt; retrying a deterministic error only burns the deadline.
+
+Determinism: the jitter is a keyed blake2b hash of
+``(seed, site, attempt)`` — two processes (or two runs) retrying the
+same site walk the identical delay sequence, so a chaos drill's timing
+is replayable and a test can assert the exact schedule
+(:func:`backoff_delays`).
+
+Observability: each RETRY (not the first attempt — the common path pays
+nothing) increments ``retries{site=...}`` on the metrics registry and
+runs under a ``retry.attempt`` span, so ``metrics.jsonl`` answers "which
+I/O site is flaky and how hard are we working around it".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Callable, Optional, TypeVar
+
+from photon_ml_tpu.utils.faults import InjectedFault
+
+# NOTE: the obs imports (trace span + retries counter) live inside
+# call_with_retry's RETRY path, not at module level — obs/run.py imports
+# this module, and the first attempt (the only hot path) needs neither.
+
+T = TypeVar("T")
+
+
+class RetryExhaustedError(RuntimeError):
+    """A retried operation failed every attempt (or hit its deadline).
+
+    Carries the last underlying exception as ``__cause__`` plus the
+    ``site``/``attempts`` the failure burned — the typed terminal signal
+    the degraded-ingest quarantine and the drivers' clean-abort path
+    dispatch on (never a bare stack-trace crash)."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException,
+                 deadline_hit: bool = False):
+        why = "deadline exceeded" if deadline_hit else "attempts exhausted"
+        super().__init__(
+            f"{site}: {why} after {attempts} attempt(s); "
+            f"last error: {last!r}")
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+        self.deadline_hit = deadline_hit
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry schedule: ``max_attempts`` tries, exponential
+    backoff from ``base_delay_seconds`` capped at ``max_delay_seconds``,
+    an optional wall-clock ``deadline_seconds`` over the WHOLE call
+    (sleeps included), and the exception classes worth retrying."""
+
+    max_attempts: int = 4
+    base_delay_seconds: float = 0.02
+    max_delay_seconds: float = 1.0
+    deadline_seconds: Optional[float] = None
+    retry_on: tuple = (OSError, InjectedFault)
+    # Subclasses of retry_on that are PERMANENT anyway: a missing path
+    # stays missing — retrying only burns the deadline and rewraps a
+    # clear FileNotFoundError callers (and tests) dispatch on.
+    permanent_on: tuple = (FileNotFoundError,)
+    seed: int = 0
+
+
+#: The package default: 4 attempts, ~20/40/80 ms jittered backoff. I/O
+#: call sites share it so the worst-case stall per shard stays bounded
+#: well under a second.
+DEFAULT_POLICY = RetryPolicy()
+
+
+def _jitter_factor(seed: int, site: str, attempt: int) -> float:
+    """Deterministic jitter in [0.5, 1.0): keyed hash, not a PRNG, so
+    the sequence depends only on (seed, site, attempt)."""
+    key = f"{seed}:{site}:{attempt}".encode("utf-8")
+    h = int.from_bytes(hashlib.blake2b(key, digest_size=8).digest(), "big")
+    return 0.5 + (h / 2.0 ** 64) * 0.5
+
+
+def backoff_delays(site: str, policy: RetryPolicy = DEFAULT_POLICY
+                   ) -> list[float]:
+    """The exact sleep schedule ``call_with_retry`` walks for ``site``:
+    ``min(base * 2^n, max) * jitter(seed, site, n)`` for each retry slot
+    (one fewer than ``max_attempts``). Deterministic — tests assert it
+    verbatim."""
+    out = []
+    for attempt in range(max(policy.max_attempts - 1, 0)):
+        raw = min(policy.base_delay_seconds * (2.0 ** attempt),
+                  policy.max_delay_seconds)
+        out.append(raw * _jitter_factor(policy.seed, site, attempt))
+    return out
+
+
+def call_with_retry(fn: Callable[[], T], site: str,
+                    policy: RetryPolicy = DEFAULT_POLICY,
+                    warn: Optional[Callable[[str], None]] = None) -> T:
+    """Run ``fn`` with the retry protocol for ``site``.
+
+    - an exception NOT in ``policy.retry_on`` propagates immediately
+      (permanent failures don't burn the schedule);
+    - retryable failures sleep the deterministic backoff and re-run,
+      incrementing ``retries{site=...}`` and opening a ``retry.attempt``
+      span per retry;
+    - when attempts (or the deadline) run out the last error is wrapped
+      in :class:`RetryExhaustedError`.
+    """
+    from photon_ml_tpu.obs import trace
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    t0 = time.monotonic()
+    delays = backoff_delays(site, policy)
+    attempt = 0
+    while True:
+        try:
+            if attempt == 0:
+                return fn()
+            with trace.span("retry.attempt", site=site, attempt=attempt):
+                return fn()
+        except policy.retry_on as e:
+            if isinstance(e, policy.permanent_on):
+                raise
+            attempt += 1
+            if attempt >= policy.max_attempts:
+                raise RetryExhaustedError(site, attempt, e) from e
+            delay = delays[attempt - 1]
+            if (policy.deadline_seconds is not None
+                    and time.monotonic() - t0 + delay
+                    > policy.deadline_seconds):
+                raise RetryExhaustedError(site, attempt, e,
+                                          deadline_hit=True) from e
+            REGISTRY.counter("retries").inc(site=site)
+            if warn is not None:
+                warn(f"{site}: attempt {attempt} failed ({e!r}); "
+                     f"retrying in {delay * 1e3:.0f} ms")
+            time.sleep(delay)

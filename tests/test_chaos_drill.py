@@ -1,0 +1,46 @@
+"""Tier-1 gate for the chaos campaign: the curated smoke subset of
+``tools/chaos_drill.py`` runs as a real subprocess sweep (< 60 s) so a
+robustness-invariant regression — a fault mode that starts crashing with
+a stack trace, a kill that stops resuming bit-exact, a corrupt shard
+that kills ingest instead of quarantining — fails loudly in CI.
+
+The full point × mode matrix is the same script without ``--smoke``
+(a few minutes); run it when touching the fault/retry/quarantine layers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DRILL = os.path.join(_REPO, "tools", "chaos_drill.py")
+
+
+def test_chaos_smoke_campaign(tmp_path):
+    report_path = str(tmp_path / "chaos_report.json")
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, _DRILL, "--smoke",
+         "--workdir", str(tmp_path / "work"),
+         "--report", report_path],
+        cwd=_REPO, env=env, text=True, capture_output=True, timeout=420)
+    assert proc.returncode == 0, \
+        (f"chaos smoke campaign failed rc={proc.returncode}\n"
+         f"{proc.stdout}\n{proc.stderr[-3000:]}")
+    assert "CHAOS_OK" in proc.stdout
+
+    with open(report_path) as fh:
+        report = json.load(fh)
+    assert report["cells_failed"] == 0
+    cells = {c["cell"]: c for c in report["cells"]}
+    # the smoke subset must keep covering each invariant class:
+    assert cells["io.avro_read=corrupt"]["outcome"].startswith("degraded")
+    assert cells["scenario.corrupt_shard"]["passed"]  # ISSUE acceptance
+    assert cells["cd.update=kill"]["outcome"] == "killed+resumed"
+    assert cells["io.index_map=io_error"]["outcome"] == "clean_abort"
+    assert cells["obs.flush=io_error"]["outcome"] == "ok"

@@ -361,6 +361,37 @@ def test_w5_suppressed(tmp_path):
     assert [f.rule for f in report.suppressed] == ["W501"]
 
 
+W5_WRAPPER = """
+def _checkpoint_save_contained(manager, step, snapshot):
+    manager.save(step, snapshot)
+
+def save(mgr, sweep, states):
+    _checkpoint_save_contained(mgr, sweep,
+                               {"sweep": sweep, "states": states})
+    # name-alike 2-arg helper: NOT a save site — its dict must not
+    # widen the written-key union (it would be a false W502)
+    save_checkpoint_report(mgr, {"path": "out", "elapsed": 1.0})
+
+def save_checkpoint_report(mgr, info):
+    pass
+
+def resume(ckpt_mgr):
+    snap = ckpt_mgr.restore()
+    return snap["sweep"], snap.get("states")
+"""
+
+
+def test_w5_save_wrapper_counts_as_writer(tmp_path):
+    """A dict passed to a checkpoint-save containment wrapper
+    (`_checkpoint_save_contained(mgr, step, {...})`) is a save site:
+    hoisting `.save` into a helper must not blind the schema check
+    (it would W501 every key the wrapper writes). A 2-arg helper whose
+    name merely matches is NOT one — its dict stays out of the union."""
+    report = run_fixture(tmp_path, {"mod.py": W5_WRAPPER},
+                         families={"W5"})
+    assert report.new == []
+
+
 def test_w3_self_rebind_is_clean(tmp_path):
     """`x = donating(x)` — THE idiomatic donation pattern — must not
     fire: the name is rebound to the result the moment the call
