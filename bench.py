@@ -442,7 +442,11 @@ def _instrumented_warm_pass(run_fn) -> dict:
     stats dict, ``host_syncs_per_update`` (all instrumented fetch sites /
     updates; steady-state contract 2.0 = 1 hot-loop epilogue + 1
     amortized sweep-boundary drain), ``hot_loop_syncs_per_update``
-    (contract 1.0), the per-site fetch breakdown, the warm pass's retrace
+    (contract ≤ 1.0 — asserted: the pipelined/blocked loop must never
+    re-serialize into extra blocking reads), ``cd_pipeline_depth`` (max
+    in-flight updates — 2 when double-buffering engages) and
+    ``cd_overlap_fraction`` (how much of the epilogue latency the
+    overlap hid), the per-site fetch breakdown, the warm pass's retrace
     delta (steady-state contract 0 — a warm retrace is an
     instrumentation/compile-cache regression), and the traced pass's
     ``train_secs_traced`` / ``trace_overhead_pct`` (the smoke test
@@ -466,6 +470,22 @@ def _instrumented_warm_pass(run_fn) -> dict:
                              / hot["updates"] if hot["updates"] else None)
     hot_loop_syncs_per_update = (hot["epilogue_fetches"] / hot["updates"]
                                  if hot["updates"] else None)
+    # pipelined-mode contract: the HOT-LOOP fetch rate is AT MOST 1.0
+    # amortized (1 fused-epilogue fetch per update at block size 1, 1/B
+    # per block of B) — a regression that re-serializes the loop into
+    # extra blocking reads fails the bench loudly, not silently
+    if hot_loop_syncs_per_update is not None:
+        assert hot_loop_syncs_per_update <= 1.0, (
+            f"hot-loop fetch rate {hot_loop_syncs_per_update} > 1.0/update "
+            f"({hot['epilogue_fetches']} fetches / {hot['updates']} "
+            f"updates): the one-round-trip pipelined contract broke")
+    # double-buffering depth + how much of the epilogue latency the
+    # overlap actually hid: overlap/(overlap+residual wait)
+    cd_pipeline_depth = hot["max_inflight"]
+    hidden = hot["overlap_secs"]
+    residual = hot["epilogue_wait_secs"]
+    cd_overlap_fraction = (hidden / (hidden + residual)
+                           if (hidden + residual) > 0 else None)
     host_fetch_sites = sync_telemetry.host_fetches_by_site()
     retraces = int(obs_registry.counter("retraces").total()
                    - retraces_start)
@@ -497,6 +517,8 @@ def _instrumented_warm_pass(run_fn) -> dict:
         "hot": hot,
         "host_syncs_per_update": host_syncs_per_update,
         "hot_loop_syncs_per_update": hot_loop_syncs_per_update,
+        "cd_pipeline_depth": cd_pipeline_depth,
+        "cd_overlap_fraction": cd_overlap_fraction,
         "host_fetch_sites": host_fetch_sites,
         "retraces": retraces,
         "train_secs_traced": train_secs_traced,
@@ -623,6 +645,22 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     _progress(f"glmix traced warm {train_secs_traced:.1f}s "
               f"(overhead {trace_overhead_pct:+.1f}%)")
 
+    # Block-parallel warm pass on the MAIN glmix config (--cd-block-size
+    # 2: both coordinates solve against the stale sweep-start total, one
+    # fused correction epilogue per sweep instead of two) — the direct
+    # wall-clock comparison point against the sequential warm record.
+    run_coordinate_descent(  # compile the block-2 epilogue shape
+        coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
+        labels=labels_j, weights=weights_j, offsets=offsets_j,
+        block_size=2)
+    t0 = time.perf_counter()
+    run_coordinate_descent(
+        coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
+        labels=labels_j, weights=weights_j, offsets=offsets_j,
+        block_size=2)
+    train_secs_warm_block2 = time.perf_counter() - t0
+    _progress(f"glmix train warm block-2 {train_secs_warm_block2:.1f}s")
+
     # Steady-state per-stage attribution of one RE update (everything is
     # already compiled at these shapes): offset gather (sample->entity
     # resharding), vmapped solve, score scatter (entity->sample), plus the
@@ -678,6 +716,32 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
               f"(chunks {compact_stats['chunks']}, active lanes "
               f"{compact_stats['lane_counts']})")
 
+    # Block-size ladder on the straggler config: one warm CD sweep per
+    # --cd-block-size in (1, 2, 4) over (fixed, straggler per-user). A
+    # block solves its coordinates concurrently against the stale
+    # block-start total and pays ONE fused correction epilogue, so the
+    # ladder shows what block parallelism buys when the RE solve is the
+    # long pole (4 clamps to the 2-coordinate sweep width — recorded
+    # anyway so the ladder shape is comparable across rounds).
+    straggler_coords = {
+        "fixed": coords["fixed"],
+        "per-user": RandomEffectCoordinate(dataset=re_ds,
+                                           problem=compacted_prob),
+    }
+    ladder = {}
+    for bs in (1, 2, 4):
+        run_coordinate_descent(  # warm this block shape's epilogue
+            straggler_coords, num_iterations=1,
+            task=TaskType.LOGISTIC_REGRESSION, labels=labels_j,
+            weights=weights_j, offsets=offsets_j, block_size=bs)
+        t0 = time.perf_counter()
+        run_coordinate_descent(
+            straggler_coords, num_iterations=1,
+            task=TaskType.LOGISTIC_REGRESSION, labels=labels_j,
+            weights=weights_j, offsets=offsets_j, block_size=bs)
+        ladder[str(bs)] = round(time.perf_counter() - t0, 2)
+    _progress(f"glmix straggler-config block-size ladder: {ladder}")
+
     return {
         "n_samples": n, "n_users": len(data.id_vocabs["userId"]),
         "d_global": d_global,
@@ -686,6 +750,9 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
         "dataset_build_secs": round(build_secs, 2),
         "train_secs": round(train_secs, 2),
         "train_secs_warm": round(train_secs_warm, 2),
+        # the same warm training pass with --cd-block-size 2 (one fused
+        # correction epilogue per sweep instead of two)
+        "train_secs_warm_block2": round(train_secs_warm_block2, 2),
         "compile_overhead_secs": round(train_secs - train_secs_warm, 2),
         "per_update_secs": sweep_secs,
         "per_update_secs_warm": sweep_secs_warm,
@@ -697,6 +764,18 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
         # epilogue)
         "host_syncs_per_update": host_syncs_per_update,
         "host_syncs_per_update_hot_loop": hot_loop_syncs_per_update,
+        # double-buffering telemetry: max in-flight updates (2 = the
+        # pipeline engaged) and the fraction of epilogue latency the
+        # dispatch overlap hid (1.0 = fetches always found the result
+        # ready; 0.0 = every fetch blocked for the full epilogue)
+        "cd_pipeline_depth": probe["cd_pipeline_depth"],
+        "cd_overlap_fraction": (
+            None if probe["cd_overlap_fraction"] is None
+            else round(probe["cd_overlap_fraction"], 3)),
+        # one warm CD sweep per --cd-block-size over the straggler
+        # config: what block-parallel sweeps buy when the RE solve is
+        # the long pole
+        "cd_block_ladder_secs": ladder,
         # per-site breakdown of the warm run's instrumented fetches
         # (labeled host_fetches counter; values sum to the legacy total)
         "host_fetch_sites": host_fetch_sites,
@@ -852,6 +931,10 @@ def bench_game_full(n=400_000, n_users=6040, n_movies=3706, d_global=32,
         "compile_overhead_secs": round(train_secs - train_secs_warm, 2),
         "host_syncs_per_update": host_syncs_per_update,
         "host_syncs_per_update_hot_loop": hot_loop_syncs_per_update,
+        "cd_pipeline_depth": probe["cd_pipeline_depth"],
+        "cd_overlap_fraction": (
+            None if probe["cd_overlap_fraction"] is None
+            else round(probe["cd_overlap_fraction"], 3)),
         "host_fetch_sites": host_fetch_sites,
         "retraces": retraces,
         "trace_overhead_pct": round(trace_overhead_pct, 2),

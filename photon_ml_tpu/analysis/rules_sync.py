@@ -12,6 +12,15 @@ whole package statically:
 - **W104** ``jax.device_get`` in a function whose scope chain never
   calls ``record_host_fetch`` — an *uninstrumented* fetch that
   ``host_syncs_per_update`` telemetry cannot see.
+- **W105** pipeline-depth discipline: a deferred epilogue handle (the
+  result of a ``dispatch_update``-style call) still unconsumed when a
+  SECOND subsequent dispatch is issued — i.e. a fetch that would land
+  more than one coordinate late. The double-buffered CD sweep's
+  contract is depth ≤ 1: every in-flight block is resolved
+  (``resolve_update``/``fetch_update``) before the dispatch after next,
+  so divergence recovery only ever has to act ONE update late. A
+  deeper pipeline silently widens the rollback window; this rule makes
+  that structural instead of tribal knowledge.
 
 ``utils/sync_telemetry.py`` itself is exempt: it IS the instrument.
 """
@@ -29,6 +38,17 @@ _EXEMPT_SUFFIX = "utils/sync_telemetry.py"
 _RECORD_FETCH = "record_host_fetch"
 _CONVERTERS = {"float", "int", "bool"}
 _NP_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+# W105: calls whose name ends with the dispatch suffix produce a deferred
+# epilogue handle; ones ending with a consume suffix resolve it. Suffix
+# matching covers both the bare closure names in coordinate_descent.py
+# and dotted/imported forms.
+_DISPATCH_SUFFIX = "dispatch_update"
+_CONSUME_SUFFIXES = ("resolve_update", "fetch_update")
+#: Loop bodies are interpreted this many times so a handle created in
+#: iteration k and aged by the dispatches of iterations k+1 and k+2 is
+#: observed crossing the depth-1 line.
+_LOOP_PASSES = 3
 
 
 def build_scope_map(tree: ast.Module) -> dict[int, Optional[ast.AST]]:
@@ -64,6 +84,186 @@ def _instrumented_scopes(mod: ModuleInfo,
     return out
 
 
+def _call_suffix_name(mod: ModuleInfo, node: ast.Call) -> Optional[str]:
+    """Best-effort callable name for suffix matching: the resolved dotted
+    name when the package index knows it, else the bare/attr name."""
+    d = mod.resolve(node.func)
+    if d is not None:
+        return d
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+class _PipelineDepthWalker:
+    """Abstract interpreter for W105: tracks, per function scope, which
+    variables hold a deferred dispatch handle and how many SUBSEQUENT
+    dispatches each has survived unconsumed (its "age"). A handle
+    reaching age 2 at a dispatch site is a finding — that fetch would
+    land more than one coordinate late.
+
+    Consumption = the variable passed to a ``resolve_update``/
+    ``fetch_update``-suffixed call, rebound, deleted, or transferred to
+    another name (``pending = cur`` moves the handle, it doesn't copy
+    it). ``If`` branches merge keeping only handles live on BOTH paths
+    (max age) — precision over recall; loop bodies run ``_LOOP_PASSES``
+    times so loop-carried ages surface."""
+
+    def __init__(self, mod: ModuleInfo, findings: list):
+        self.mod = mod
+        self.findings = findings
+        self.state: dict[str, int] = {}
+
+    # -- entry points -------------------------------------------------------
+
+    def run(self, body: list) -> None:
+        self.state = {}
+        self._stmts(body)
+
+    # -- statement dispatch -------------------------------------------------
+
+    def _stmts(self, stmts) -> None:
+        for s in stmts or []:
+            self._stmt(s)
+
+    def _stmt(self, s: ast.AST) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _PipelineDepthWalker(self.mod, self.findings)
+            sub.run(s.body)
+            return
+        if isinstance(s, ast.ClassDef):
+            sub = _PipelineDepthWalker(self.mod, self.findings)
+            sub.run(s.body)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._expr(s.iter)
+            for _ in range(_LOOP_PASSES):
+                self._stmts(s.body)
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.While):
+            self._expr(s.test)
+            for _ in range(_LOOP_PASSES):
+                self._stmts(s.body)
+            self._stmts(s.orelse)
+            return
+        if isinstance(s, ast.If):
+            self._expr(s.test)
+            before = dict(self.state)
+            self._stmts(s.body)
+            after_body = self.state
+            self.state = dict(before)
+            self._stmts(s.orelse)
+            after_else = self.state
+            # keep only handles alive on BOTH paths (precision: a handle
+            # consumed on either path may well be consumed at runtime)
+            self.state = {
+                name: max(after_body[name], after_else[name])
+                for name in set(after_body) & set(after_else)}
+            return
+        if isinstance(s, ast.Try):
+            # conservative flattening: body, then handlers, then
+            # orelse/finally see the accumulated state — consumption on
+            # any of these paths counts
+            self._stmts(s.body)
+            for h in s.handlers:
+                self._stmts(h.body)
+            self._stmts(s.orelse)
+            self._stmts(s.finalbody)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._expr(item.context_expr)
+            self._stmts(s.body)
+            return
+        if isinstance(s, ast.Assign):
+            self._assign(s)
+            return
+        if isinstance(s, ast.AnnAssign) and s.value is not None:
+            if isinstance(s.target, ast.Name):
+                self._bind(s.target.id, s.value)
+            else:
+                self._expr(s.value)
+            return
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    self.state.pop(t.id, None)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _assign(self, s: ast.Assign) -> None:
+        # rebinding any tracked name kills its old handle
+        for t in s.targets:
+            for name_node in ast.walk(t):
+                if isinstance(name_node, ast.Name):
+                    self.state.pop(name_node.id, None)
+        if len(s.targets) == 1 and isinstance(s.targets[0], ast.Name):
+            self._bind(s.targets[0].id, s.value)
+        else:
+            self._expr(s.value)
+
+    def _bind(self, target: str, value: ast.expr) -> None:
+        if isinstance(value, ast.Call):
+            name = _call_suffix_name(self.mod, value)
+            if name is not None and name.endswith(_DISPATCH_SUFFIX):
+                self._visit_call_args(value)
+                self._age_all(value)
+                self.state[target] = 0
+                return
+        if isinstance(value, ast.Name) and value.id in self.state:
+            self.state[target] = self.state.pop(value.id)  # transfer
+            return
+        self._expr(value)
+
+    # -- expressions --------------------------------------------------------
+
+    def _expr(self, e: Optional[ast.expr]) -> None:
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_suffix_name(self.mod, node)
+            if name is None:
+                continue
+            if any(name.endswith(sfx) for sfx in _CONSUME_SUFFIXES):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        self.state.pop(arg.id, None)
+                for kw in node.keywords:  # fetch_update(p=handle) consumes
+                    if isinstance(kw.value, ast.Name):
+                        self.state.pop(kw.value.id, None)
+            elif name.endswith(_DISPATCH_SUFFIX):
+                # un-bound dispatch still advances the pipeline clock
+                self._age_all(node)
+
+    def _visit_call_args(self, call: ast.Call) -> None:
+        for arg in call.args:
+            self._expr(arg)
+        for kw in call.keywords:
+            self._expr(kw.value)
+
+    def _age_all(self, at: ast.Call) -> None:
+        for name in list(self.state):
+            self.state[name] += 1
+            if self.state[name] >= 2:
+                self.findings.append(Finding(
+                    "W105", self.mod.relpath, at.lineno, at.col_offset,
+                    f"deferred epilogue handle {name!r} is still "
+                    f"unconsumed at its second subsequent dispatch — "
+                    f"the fetch would land more than one coordinate "
+                    f"late (pipeline depth > 1); resolve it "
+                    f"(resolve_update/fetch_update) at most one "
+                    f"dispatch later"))
+                # report once per handle per site chain
+                self.state.pop(name, None)
+
+
 def check(modules: list[ModuleInfo], index: PackageIndex,
           flows: dict[str, Dataflow], ctx) -> list[Finding]:
     findings: list[Finding] = []
@@ -73,6 +273,7 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
         flow = flows[mod.relpath]
         scope_of = build_scope_map(mod.tree)
         instrumented = _instrumented_scopes(mod, scope_of)
+        _PipelineDepthWalker(mod, findings).run(mod.tree.body)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue

@@ -198,6 +198,32 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "chunk-size controller pick and re-tune between "
                         "solves from the observed per-chunk active-lane "
                         "decay (the re_chunk_active_lanes signal)")
+    p.add_argument("--cd-block-size", type=int, default=1,
+                   help="solve this many coordinates per sweep "
+                        "CONCURRENTLY against a stale device-resident "
+                        "score total, then apply one fused correction "
+                        "epilogue that re-canonicalizes the ids-order "
+                        "total (one device fetch per block, 1/B "
+                        "amortized syncs/update). 1 (default) = the "
+                        "sequential sweep. Block updates use stale "
+                        "partial scores, so trajectories match the "
+                        "sequential sweep within tolerance — do not "
+                        "raise this when coordinates' scores are "
+                        "strongly coupled (see README 'Performance')")
+    # default None (resolved to 1 single-process): multi-host must tell
+    # an explicit pipeline-depth request apart from the argparse default
+    # (its gang-synchronous worker has no pipeline to configure)
+    p.add_argument("--cd-pipeline-depth", type=int, default=None,
+                   choices=[0, 1],
+                   help="1 (default): double-buffer coordinate updates "
+                        "— dispatch the next solve against the previous "
+                        "fused epilogue's device-resident outputs before "
+                        "blocking on its fetch, overlapping host "
+                        "dispatch with device compute (bit-identical "
+                        "floats to the sequential sweep; recovery acts "
+                        "one update late, rolling the speculative "
+                        "dispatch back on divergence). 0: sequential "
+                        "dispatch-then-fetch")
     p.add_argument("--random-effect-blocks-dir", default=None,
                    help="build random-effect entity blocks through the "
                         "STREAMED builder with np.memmap destinations "
@@ -629,7 +655,10 @@ class GameTrainingDriver:
                         self.ns.checkpoint_every_coordinates),
                     resume_snapshot=resume_snapshot,
                     recovery=recovery,
-                    events=events)
+                    events=events,
+                    block_size=max(1, int(self.ns.cd_block_size)),
+                    pipeline_depth=(1 if self.ns.cd_pipeline_depth is None
+                                    else int(self.ns.cd_pipeline_depth)))
             if result.quarantined:
                 self.logger.warn(
                     f"{desc}: quarantined coordinates (frozen at "
@@ -790,6 +819,19 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
             "lanes with per-chunk host round-trips; the multi-host solve "
             "keeps its entity axis mesh-sharded and runs the "
             "single-dispatch path)")
+    if ns.cd_block_size != 1:
+        unsupported.append(
+            "--cd-block-size (the multi-host worker runs its own "
+            "gang-synchronous CD loop; block-parallel sweeps are wired "
+            "into the single-process coordinate-descent loop only)")
+    # the argparse default (None) passes; only an EXPLICIT depth request
+    # is rejected — the multi-host worker has no pipeline to configure,
+    # so accepting 0 or 1 would promise behavior that doesn't exist
+    if ns.cd_pipeline_depth is not None:
+        unsupported.append(
+            "--cd-pipeline-depth (the multi-host worker runs its own "
+            "gang-synchronous CD loop; there is no per-coordinate "
+            "dispatch pipeline to configure there)")
     if ns.max_shard_loss_frac > 0:
         unsupported.append(
             "--max-shard-loss-frac (shard quarantine is wired into the "

@@ -180,34 +180,46 @@ def _fit_blocks_impl(
     max_iter: int,
     tolerance: float,
     boundary_convergence: bool = False,
+    resume=None,
+    return_carry: bool = False,
 ):
     """vmapped solve over entity blocks; returns (coefs [E,D], iters [E],
     final loss values [E], convergence codes [E] int8 — see
-    CONVERGENCE_CODE_NAMES). ``solver`` is "lbfgs"/"owlqn"/"tron".
+    CONVERGENCE_CODE_NAMES), plus a per-lane solver carry when
+    ``return_carry``. ``solver`` is "lbfgs"/"owlqn"/"tron".
 
     ``boundary_convergence`` is set by the lane-compaction driver on
     NON-final chunks: a lane that satisfies a convergence criterion on
     exactly its last budgeted iteration then reports that criterion
     instead of MaxIterations, so it leaves the active set with its true
-    reason rather than being re-dispatched from its optimum (where the
-    warm restart would report a spurious ObjectiveNotImproving). The
+    reason rather than being re-dispatched from its optimum. The
     default preserves the host-ordering classification
-    (Optimizer.scala:156-170): max-iterations wins."""
+    (Optimizer.scala:156-170): max-iterations wins.
 
-    def solve_one(Xe, ye, oe, we, x0):
+    ``resume`` is the previous chunk's per-lane carry (lane-compacted by
+    the caller): the solvers continue their loop state verbatim and every
+    convergence check stays anchored to the ORIGINAL dispatch's f₀/‖g₀‖,
+    so a chunked solve is bit-identical to the single dispatch."""
+
+    def solve_one(Xe, ye, oe, we, x0, res):
         batch = DenseBatch(X=Xe, labels=ye, offsets=oe, weights=we)
         if solver == "owlqn":
-            x, hist, progressed = minimize_owlqn(
+            out = minimize_owlqn(
                 _vg, x0, (obj, batch), l1=l1,
-                max_iter=max_iter, tolerance=tolerance)
+                max_iter=max_iter, tolerance=tolerance,
+                resume=res, return_carry=return_carry)
         elif solver == "tron":
-            x, hist, progressed = minimize_tron(
+            out = minimize_tron(
                 _vg, _hvp, x0, (obj, batch),
-                max_iter=max_iter, tolerance=tolerance)
+                max_iter=max_iter, tolerance=tolerance,
+                resume=res, return_carry=return_carry)
         else:
-            x, hist, progressed = minimize_lbfgs(
+            out = minimize_lbfgs(
                 _vg, x0, (obj, batch),
-                max_iter=max_iter, tolerance=tolerance)
+                max_iter=max_iter, tolerance=tolerance,
+                resume=res, return_carry=return_carry)
+        x, hist, progressed = out[:3]
+        carry = out[3] if return_carry else None
         k = hist.num_iterations
         final_value = hist.values[k]
         # Per-lane convergence classification mirroring the HOST ordering
@@ -217,11 +229,25 @@ def _fit_blocks_impl(
         # total-function fallback is FunctionValuesConverged like the host.
         # A lane that stalls with an unchanged objective therefore reports
         # ObjectiveNotImproving, keeping tracker counts aligned with the
-        # reference's countsByConvergence.
-        fv = (k >= 1) & (
-            jnp.abs(final_value - hist.values[jnp.maximum(k - 1, 0)])
-            <= tolerance * jnp.abs(hist.values[0]))
-        gv = hist.grad_norms[k] <= tolerance * hist.grad_norms[0]
+        # reference's countsByConvergence. On a resumed chunk the
+        # thresholds anchor to the ORIGINAL dispatch's f₀/‖g₀‖ and a
+        # k==0 exit compares against the pre-boundary value — the checks
+        # the uninterrupted loop would have run.
+        if res is None:
+            f0_anchor = hist.values[0]
+            g0n_anchor = hist.grad_norms[0]
+            prev_value = hist.values[jnp.maximum(k - 1, 0)]
+            fv_gate = k >= 1
+        else:
+            f0_anchor = res.f0
+            g0n_anchor = res.g0n
+            prev_value = jnp.where(k >= 1,
+                                   hist.values[jnp.maximum(k - 1, 0)],
+                                   res.prev_f)
+            fv_gate = True
+        fv = fv_gate & (jnp.abs(final_value - prev_value)
+                        <= tolerance * jnp.abs(f0_anchor))
+        gv = hist.grad_norms[k] <= tolerance * g0n_anchor
         converged = jnp.where(~progressed, CONV_NOT_PROGRESSED,
                               jnp.where(fv, CONV_FUNCTION_VALUES,
                                         jnp.where(gv, CONV_GRADIENT,
@@ -237,12 +263,19 @@ def _fit_blocks_impl(
         else:
             exhausted = CONV_MAX_ITERATIONS
         code = jnp.where(k >= max_iter, exhausted, converged)
+        if return_carry:
+            return x, k, final_value, code.astype(jnp.int8), carry
         return x, k, final_value, code.astype(jnp.int8)
 
-    return jax.vmap(solve_one)(X, labels, offsets, weights, initial)
+    if resume is None:
+        return jax.vmap(
+            lambda Xe, ye, oe, we, x0: solve_one(Xe, ye, oe, we, x0, None)
+        )(X, labels, offsets, weights, initial)
+    return jax.vmap(solve_one)(X, labels, offsets, weights, initial, resume)
 
 
-_STATIC = ("solver", "max_iter", "tolerance", "boundary_convergence")
+_STATIC = ("solver", "max_iter", "tolerance", "boundary_convergence",
+           "return_carry")
 _fit_blocks = partial(jax.jit, static_argnames=_STATIC)(_fit_blocks_impl)
 # Donating variants, only engaged off-CPU (the CPU runtime can't alias and
 # would warn per call) and only for callers that own the buffers:
@@ -273,20 +306,26 @@ _SEEN_DISPATCH_KEYS: set = set()
 def _dispatch_fit(X, labels, offsets, weights, initial, obj, l1, solver,
                   max_iter, tolerance, donate: bool,
                   donate_x0: bool = False,
-                  boundary_convergence: bool = False):
+                  boundary_convergence: bool = False,
+                  resume=None, return_carry: bool = False):
     SOLVE_STATS["dispatches"] += 1
     fn = _fit_blocks
     if donate and jax.default_backend() != "cpu":
-        fn = (_fit_blocks_donate_offsets_x0 if donate_x0
+        # the resumed-chunk path passes the gathered carry's x as BOTH
+        # the x0 arg and a resume leaf — never donate x0 there (aliasing
+        # a donated buffer with a live arg is a runtime error)
+        fn = (_fit_blocks_donate_offsets_x0
+              if donate_x0 and resume is None
               else _fit_blocks_donate_offsets)
     key = (id(fn), tuple(X.shape), str(X.dtype), tuple(initial.shape),
            str(initial.dtype), solver, max_iter, float(tolerance),
-           boundary_convergence)
+           boundary_convergence, resume is not None, return_carry)
     if key not in _SEEN_DISPATCH_KEYS:
         _SEEN_DISPATCH_KEYS.add(key)
         REGISTRY.counter("retraces").inc(site="re.dispatch")
     return fn(X, labels, offsets, weights, initial, obj, l1, solver,
-              max_iter, tolerance, boundary_convergence)
+              max_iter, tolerance, boundary_convergence, resume,
+              return_carry)
 
 
 def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
@@ -302,17 +341,23 @@ def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
     block and re-dispatched. A bucket where 90% of entities converge in 5
     iterations then costs ~10% of the lanes for the straggler tail instead
     of running every lane to the slowest lane's count. Each chunk costs
-    one small device→host fetch (the unconverged mask); chunk-boundary
-    warm restarts re-anchor the solvers' relative tolerances, so
-    coefficients match the single-dispatch solve within tolerance rather
-    than bitwise (see LaneCompactionState)."""
+    one small device→host fetch (the unconverged mask).
+
+    Restarts are EXACT: each non-final chunk also returns the solvers'
+    per-lane carry (iterate, curvature history / trust region, previous
+    objective, ORIGINAL f₀/‖g₀‖ anchors — LBFGSResume/TRONResume), which
+    is gathered down to the still-active lanes and resumed, so the
+    chunked solve runs bit-identically to the single dispatch instead of
+    re-anchoring its relative tolerances at every boundary."""
     state = LaneCompactionState.initial(x0, x0.dtype)
     idx: Optional[np.ndarray] = None
+    carry = None  # previous chunk's per-lane solver carry (device)
     cur = (X, labels, offsets, weights, x0)
     spent = 0
     chunk_index = 0
     while True:
         budget = min(chunk, max_iter - spent)
+        final_chunk = spent + budget >= max_iter
         # span per chunk, labeled with the REAL active-lane count entering
         # it (not the power-of-two padded dispatch width): the shrinking
         # sequence IS the iteration histogram the ROADMAP chunk-size
@@ -326,17 +371,26 @@ def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
                         active_lanes=active_lanes, budget=budget):
             # chunk 1 runs the caller's buffers (which later compactions
             # re-gather from: never donate them); compacted chunks run
-            # gathered copies this loop owns outright, x0 included.
-            # Non-final chunks classify boundary convergence so a lane
-            # converging on its last budgeted iteration leaves with its
-            # true reason instead of a re-dispatch from its optimum.
+            # gathered copies this loop owns outright — but x0 doubles as
+            # the carry's live iterate on resumed chunks, so only the
+            # offsets buffer is donated there. Non-final chunks classify
+            # boundary convergence so a lane converging on its last
+            # budgeted iteration leaves with its true reason instead of
+            # a re-dispatch from its optimum.
             donate_chunk = donate and idx is not None
-            c, it, v, k = _dispatch_fit(*cur, obj, l1, solver, budget,
-                                        tolerance, donate=donate_chunk,
-                                        donate_x0=donate_chunk,
-                                        boundary_convergence=(
-                                            spent + budget < max_iter))
-            still = state.absorb(idx, c, it, v, k, CONV_MAX_ITERATIONS)
+            out = _dispatch_fit(*cur, obj, l1, solver, budget,
+                                tolerance, donate=donate_chunk,
+                                donate_x0=donate_chunk,
+                                boundary_convergence=not final_chunk,
+                                resume=carry,
+                                return_carry=not final_chunk)
+            if final_chunk:
+                c, it, v, k = out
+                new_carry = None
+            else:
+                c, it, v, k, new_carry = out
+            still, still_local = state.absorb(idx, c, it, v, k,
+                                              CONV_MAX_ITERATIONS)
         REGISTRY.histogram("re_chunk_active_lanes").observe(active_lanes)
         SOLVE_STATS["solve_secs"] += time.perf_counter() - t0
         SOLVE_STATS["chunks"] += 1
@@ -350,9 +404,17 @@ def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
         idx_padded = np.concatenate(
             [still, np.full(pad - len(still), still[0], np.int32)])
         g = jax.device_put(idx_padded)
+        # data tensors gather by GLOBAL lane id; the carry gathers by the
+        # lanes' LOCAL positions within the chunk that produced it
+        local_padded = np.concatenate(
+            [still_local,
+             np.full(pad - len(still_local), still_local[0], np.int32)])
+        gl = jax.device_put(local_padded)
+        carry = jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, gl, axis=0), new_carry)
         cur = (jnp.take(X, g, axis=0), jnp.take(labels, g, axis=0),
                jnp.take(offsets, g, axis=0), jnp.take(weights, g, axis=0),
-               jnp.take(state.coefs, g, axis=0))
+               carry.x)
         SOLVE_STATS["compact_secs"] += time.perf_counter() - t0
         # bounded telemetry: long training runs append per compaction and
         # only bench/tests ever reset, so keep a rolling window

@@ -224,11 +224,14 @@ class LaneCompactionState:
     chunks; ``absorb`` folds one chunk's output back in and reports which
     lanes remain.
 
-    Warm restarts re-anchor the solvers' relative convergence thresholds
-    (|Δf| ≤ tol·|f₀|, ‖g‖ ≤ tol·‖g₀‖) at each chunk's start point, so
-    iteration trajectories are not bit-identical to the single-dispatch
-    solve — coefficients agree within solver tolerance (the parity test's
-    contract), and any run is deterministic for fixed inputs and chunking.
+    Chunk restarts carry the FULL per-lane solver state (the solvers'
+    ``LBFGSResume``/``TRONResume`` carries: iterate, curvature history /
+    trust region, previous objective) plus the ORIGINAL dispatch's
+    f₀/‖g₀‖ anchors, so the relative convergence thresholds
+    (|Δf| ≤ tol·|f₀|, ‖g‖ ≤ tol·‖g₀‖) never re-anchor and a chunked
+    solve runs exactly the iterations the single dispatch would — the
+    parity contract is bit-identical coefficients, not just tolerance
+    agreement (tests/test_sync_discipline.py).
     """
 
     coefs: Array  # [E, D] device
@@ -249,12 +252,15 @@ class LaneCompactionState:
         )
 
     def absorb(self, idx, c: Array, it: Array, v: Array, k: Array,
-               max_iterations_code: int) -> np.ndarray:
+               max_iterations_code: int) -> tuple[np.ndarray, np.ndarray]:
         """Fold one chunk's output (lane-compacted when ``idx`` is not
-        None) into the global buffers; returns the global ids of lanes the
-        chunk did NOT converge (they hit the chunk's iteration budget).
-        The unconverged mask is the ONE blocking device→host fetch of the
-        chunk — everything else stays on device."""
+        None) into the global buffers; returns ``(global_ids,
+        local_positions)`` of lanes the chunk did NOT converge (they hit
+        the chunk's iteration budget) — the local positions index this
+        chunk's dispatch lanes, which is what the carry-based restart
+        gathers the per-lane solver state with. The unconverged mask is
+        the ONE blocking device→host fetch of the chunk — everything
+        else stays on device."""
         import jax
 
         from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
@@ -265,7 +271,8 @@ class LaneCompactionState:
             unconverged = np.asarray(
                 jax.device_get(k == max_iterations_code))
             record_host_fetch(site="re.compact_mask")
-            return self.active[unconverged]
+            local = np.nonzero(unconverged)[0].astype(np.int32)
+            return self.active[unconverged], local
         n_real = len(idx)
         idx_dev = jax.device_put(idx)
         self.coefs = self.coefs.at[idx_dev].set(c[:n_real])
@@ -275,7 +282,8 @@ class LaneCompactionState:
         unconverged = np.asarray(
             jax.device_get(k[:n_real] == max_iterations_code))
         record_host_fetch(site="re.compact_mask")
-        return idx[unconverged]
+        local = np.nonzero(unconverged)[0].astype(np.int32)
+        return idx[unconverged], local
 
     def results(self) -> tuple[Array, Array, Array, Array]:
         return self.coefs, self.iterations, self.values, self.codes
@@ -325,10 +333,17 @@ def should_continue(
     max_iter: int,
     tolerance: float,
     made_progress: Array,
+    resumed: bool = False,
 ) -> Array:
     """jit-side mirror of the host convergence check (Optimizer.scala:156-170).
 
-    Iteration 0 (prev_value == init_value sentinel) always continues.
+    Iteration 0 (prev_value == init_value sentinel) always continues —
+    EXCEPT on a chunk-resumed solve (``resumed=True``), where
+    ``prev_value`` is the real objective from one iteration before the
+    restart point and ``init_value``/``init_grad_norm`` are the ORIGINAL
+    dispatch's anchors: the restart's first check must then be exactly
+    the check the uninterrupted loop would have run at that global
+    iteration, not an unconditional continue.
     """
     not_done = (
         (it < max_iter)
@@ -336,6 +351,8 @@ def should_continue(
         & (jnp.abs(value - prev_value) > tolerance * jnp.abs(init_value))
         & (grad_norm > tolerance * init_grad_norm)
     )
+    if resumed:
+        return not_done
     # Iteration 0 runs unless already at a stationary point (zero initial
     # gradient) — a warm start at the optimum must report GradientConverged,
     # not burn a degenerate line search.

@@ -56,6 +56,31 @@ class _LBFGSCarry(NamedTuple):
     iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
+class LBFGSResume(NamedTuple):
+    """Everything a chunked warm restart needs to continue THIS solve as
+    if it had never stopped: the live iterate state, the full two-loop
+    curvature history, the previous objective value (so the restart's
+    first convergence check is the uninterrupted loop's check, not a
+    sentinel-forced continue), and the ORIGINAL dispatch's f₀/‖g₀‖
+    anchors (the relative tolerances |Δf| ≤ tol·|f₀| and ‖g‖ ≤ tol·‖g₀‖
+    must never re-anchor at a chunk boundary). Produced by
+    ``return_carry=True``; under ``vmap`` every leaf grows a lane axis,
+    which is what lets the lane-compaction driver gather only the
+    still-active lanes' carries between chunks."""
+
+    x: Array
+    f: Array
+    g: Array
+    prev_f: Array
+    S: Array
+    Y: Array
+    rho: Array
+    valid: Array
+    head: Array
+    f0: Array  # original-dispatch anchor f₀
+    g0n: Array  # original-dispatch anchor ‖g₀‖
+
+
 def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
                        head: Array) -> Array:
     """Two-loop recursion over a masked circular history buffer."""
@@ -91,7 +116,7 @@ def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
     return -r
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 9))
 def _minimize_lbfgs_impl(
     value_and_grad_fn,
     x0: Array,
@@ -101,37 +126,61 @@ def _minimize_lbfgs_impl(
     tolerance: float,
     box: Optional[BoxConstraints] = None,
     track_iterates: bool = False,
+    resume: Optional[LBFGSResume] = None,
+    return_carry: bool = False,
 ):
     # ``data`` is a traced pytree (the batch): one compiled kernel per
     # function object serves every batch of the same shape — critical for the
     # GAME workload where thousands of per-entity solves reuse this kernel.
     # ``box=None`` vs a BoxConstraints pytree changes trace structure, so the
     # unconstrained path compiles with no projection code at all.
+    # ``resume`` continues a previous chunk's solve: the carry (iterate,
+    # curvature pairs, prev_f) and the ORIGINAL dispatch's f₀/‖g₀‖
+    # anchors come back verbatim, so every convergence check and line
+    # search is bit-identical to the uninterrupted loop's at the same
+    # global iteration (only ``it``/the history buffer restart at 0 —
+    # they are chunk-local bookkeeping).
     d = x0.shape[0]
     dtype = x0.dtype
-    f0, g0 = value_and_grad_fn(x0, data)
-    g0n = jnp.linalg.norm(g0)
+    if resume is None:
+        f_start, g_start = value_and_grad_fn(x0, data)
+        anchor_f0 = f_start
+        anchor_g0n = jnp.linalg.norm(g_start)
+        x_start = x0
+        prev_f0 = f_start + jnp.asarray(jnp.inf, dtype)
+        S0 = jnp.zeros((m, d), dtype)
+        Y0 = jnp.zeros((m, d), dtype)
+        rho0 = jnp.zeros(m, dtype)
+        valid0 = jnp.zeros(m, bool)
+        head0 = jnp.int32(0)
+    else:
+        x_start, f_start, g_start = resume.x, resume.f, resume.g
+        prev_f0 = resume.prev_f
+        S0, Y0, rho0 = resume.S, resume.Y, resume.rho
+        valid0, head0 = resume.valid, resume.head
+        anchor_f0, anchor_g0n = resume.f0, resume.g0n
 
     values = jnp.full(max_iter + 1, jnp.nan, dtype)
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype)
-    values = values.at[0].set(f0)
-    grad_norms = grad_norms.at[0].set(g0n)
-    iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+    values = values.at[0].set(f_start)
+    grad_norms = grad_norms.at[0].set(jnp.linalg.norm(g_start))
+    iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x_start)
                  if track_iterates else None)
 
     init = _LBFGSCarry(
-        it=jnp.int32(0), x=x0, f=f0, g=g0,
-        prev_f=f0 + jnp.asarray(jnp.inf, dtype),
-        S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros(m, dtype), valid=jnp.zeros(m, bool),
-        head=jnp.int32(0), made_progress=jnp.bool_(True),
+        it=jnp.int32(0), x=x_start, f=f_start, g=g_start,
+        prev_f=prev_f0,
+        S=S0, Y=Y0, rho=rho0, valid=valid0,
+        head=head0, made_progress=jnp.bool_(True),
         values=values, grad_norms=grad_norms, iterates=iterates0,
     )
 
     def cond(c: _LBFGSCarry) -> Array:
         return should_continue(
-            c.it, c.f, c.prev_f, jnp.linalg.norm(c.g), f0, g0n,
+            c.it, c.f, c.prev_f, jnp.linalg.norm(c.g),
+            anchor_f0, anchor_g0n,
             max_iter, tolerance, c.made_progress,
+            resumed=resume is not None,
         )
 
     def body(c: _LBFGSCarry) -> _LBFGSCarry:
@@ -148,11 +197,16 @@ def _minimize_lbfgs_impl(
             return f_a, jnp.dot(g_a, direction), g_a
 
         # Breeze convention: first iteration starts at 1/||d||, then 1.0.
-        init_alpha = jnp.where(
-            c.it == 0,
-            1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
-            jnp.asarray(1.0, dtype),
-        )
+        # A chunk-resumed solve is never at its true first iteration —
+        # its local it=0 is some global iteration > 0, so alpha stays 1.0.
+        if resume is None:
+            init_alpha = jnp.where(
+                c.it == 0,
+                1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
+                jnp.asarray(1.0, dtype),
+            )
+        else:
+            init_alpha = jnp.asarray(1.0, dtype)
         ls = strong_wolfe(phi, c.f, dphi0, c.g, init_alpha=init_alpha)
 
         x_new = c.x + ls.alpha * direction
@@ -204,6 +258,12 @@ def _minimize_lbfgs_impl(
     final = lax.while_loop(cond, body, init)
     history = RunHistory(values=final.values, grad_norms=final.grad_norms,
                          num_iterations=final.it, iterates=final.iterates)
+    if return_carry:
+        carry = LBFGSResume(
+            x=final.x, f=final.f, g=final.g, prev_f=final.prev_f,
+            S=final.S, Y=final.Y, rho=final.rho, valid=final.valid,
+            head=final.head, f0=anchor_f0, g0n=anchor_g0n)
+        return final.x, history, final.made_progress, carry
     return final.x, history, final.made_progress
 
 
@@ -216,6 +276,8 @@ def minimize_lbfgs(
     tolerance: float = DEFAULT_TOLERANCE,
     box: Optional[BoxConstraints] = None,
     track_iterates: bool = False,
+    resume: Optional[LBFGSResume] = None,
+    return_carry: bool = False,
 ):
     """Minimize ``f(x, data)`` from ``x0``; returns (x, RunHistory, made_progress).
 
@@ -225,6 +287,13 @@ def minimize_lbfgs(
     compile cache, while a fresh closure per batch would retrace and pin the
     captured arrays in the cache. ``track_iterates`` records per-iteration
     coefficient snapshots into the history (ModelTracker analog).
+
+    ``return_carry=True`` appends a :class:`LBFGSResume` to the return
+    tuple; passing it back via ``resume`` continues the solve EXACTLY
+    where it stopped (original f₀/‖g₀‖ anchors, curvature history,
+    previous objective) — the lane-compaction driver's chunk restarts
+    use this to stay bit-identical to a single dispatch.
     """
     return _minimize_lbfgs_impl(value_and_grad_fn, x0, data, max_iter, m,
-                                tolerance, box, track_iterates)
+                                tolerance, box, track_iterates,
+                                resume, return_carry)

@@ -34,7 +34,7 @@ from photon_ml_tpu.optimize.common import (
     project_box,
     should_continue,
 )
-from photon_ml_tpu.optimize.lbfgs import two_loop_direction
+from photon_ml_tpu.optimize.lbfgs import LBFGSResume, two_loop_direction
 
 Array = jnp.ndarray
 
@@ -70,7 +70,7 @@ class _OWLQNCarry(NamedTuple):
     iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8, 10))
 def _minimize_owlqn_impl(
     value_and_grad_fn,
     x0: Array,
@@ -81,6 +81,8 @@ def _minimize_owlqn_impl(
     l1: Array = 0.0,
     box: Optional[BoxConstraints] = None,
     track_iterates: bool = False,
+    resume: Optional[LBFGSResume] = None,
+    return_carry: bool = False,
 ):
     d = x0.shape[0]
     dtype = x0.dtype
@@ -90,29 +92,50 @@ def _minimize_owlqn_impl(
         f, g = value_and_grad_fn(x, data)
         return f + jnp.sum(l1 * jnp.abs(x)), g
 
-    f0, g0 = full_objective(x0)
-    pg0 = pseudo_gradient(x0, g0, l1)
-    pg0n = jnp.linalg.norm(pg0)
+    # ``resume`` continues a previous chunk's solve verbatim: carry
+    # (iterate, SMOOTH-gradient curvature pairs, prev F) plus the ORIGINAL
+    # F₀/‖pg₀‖ anchors, so chunked restarts never re-anchor the relative
+    # tolerances (see lbfgs.LBFGSResume — the carry shape is shared).
+    if resume is None:
+        f_start, g_start = full_objective(x0)
+        anchor_f0 = f_start
+        anchor_g0n = jnp.linalg.norm(pseudo_gradient(x0, g_start, l1))
+        x_start = x0
+        prev_f0 = f_start + jnp.asarray(jnp.inf, dtype)
+        S0 = jnp.zeros((m, d), dtype)
+        Y0 = jnp.zeros((m, d), dtype)
+        rho0 = jnp.zeros(m, dtype)
+        valid0 = jnp.zeros(m, bool)
+        head0 = jnp.int32(0)
+    else:
+        x_start, f_start, g_start = resume.x, resume.f, resume.g
+        prev_f0 = resume.prev_f
+        S0, Y0, rho0 = resume.S, resume.Y, resume.rho
+        valid0, head0 = resume.valid, resume.head
+        anchor_f0, anchor_g0n = resume.f0, resume.g0n
 
-    values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f0)
-    grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(pg0n)
-    iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+    pg_start = pseudo_gradient(x_start, g_start, l1)
+    values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f_start)
+    grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(
+        jnp.linalg.norm(pg_start))
+    iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x_start)
                  if track_iterates else None)
 
     init = _OWLQNCarry(
-        it=jnp.int32(0), x=x0, f=f0, g=g0,
-        prev_f=f0 + jnp.asarray(jnp.inf, dtype),
-        S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros(m, dtype), valid=jnp.zeros(m, bool),
-        head=jnp.int32(0), made_progress=jnp.bool_(True),
+        it=jnp.int32(0), x=x_start, f=f_start, g=g_start,
+        prev_f=prev_f0,
+        S=S0, Y=Y0, rho=rho0, valid=valid0,
+        head=head0, made_progress=jnp.bool_(True),
         values=values, grad_norms=grad_norms, iterates=iterates0,
     )
 
     def cond(c: _OWLQNCarry) -> Array:
         pg = pseudo_gradient(c.x, c.g, l1)
         return should_continue(
-            c.it, c.f, c.prev_f, jnp.linalg.norm(pg), f0, pg0n,
+            c.it, c.f, c.prev_f, jnp.linalg.norm(pg),
+            anchor_f0, anchor_g0n,
             max_iter, tolerance, c.made_progress,
+            resumed=resume is not None,
         )
 
     def body(c: _OWLQNCarry) -> _OWLQNCarry:
@@ -134,11 +157,16 @@ def _minimize_owlqn_impl(
                 x_new = project_box(x_new, box)
             return x_new
 
-        init_alpha = jnp.where(
-            c.it == 0,
-            1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
-            jnp.asarray(1.0, dtype),
-        )
+        # Chunk-resumed solves are past their true first iteration, so
+        # the 1/||d|| first-step convention must not re-fire at restart.
+        if resume is None:
+            init_alpha = jnp.where(
+                c.it == 0,
+                1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
+                jnp.asarray(1.0, dtype),
+            )
+        else:
+            init_alpha = jnp.asarray(1.0, dtype)
 
         # Backtracking: accept F(pi(x + a d)) <= F(x) + c1 * pg . (x_new - x).
         def ls_cond(state):
@@ -195,6 +223,12 @@ def _minimize_owlqn_impl(
     final = lax.while_loop(cond, body, init)
     history = RunHistory(values=final.values, grad_norms=final.grad_norms,
                          num_iterations=final.it, iterates=final.iterates)
+    if return_carry:
+        carry = LBFGSResume(
+            x=final.x, f=final.f, g=final.g, prev_f=final.prev_f,
+            S=final.S, Y=final.Y, rho=final.rho, valid=final.valid,
+            head=final.head, f0=anchor_f0, g0n=anchor_g0n)
+        return final.x, history, final.made_progress, carry
     return final.x, history, final.made_progress
 
 
@@ -208,11 +242,16 @@ def minimize_owlqn(
     tolerance: float = DEFAULT_TOLERANCE,
     box: Optional[BoxConstraints] = None,
     track_iterates: bool = False,
+    resume: Optional[LBFGSResume] = None,
+    return_carry: bool = False,
 ):
     """Minimize f(x, data) + l1 ||x||_1; returns (x, RunHistory, made_progress).
 
     ``value_and_grad_fn`` returns the SMOOTH part's (value, gradient); the L1
     term is handled here. ``l1`` may be scalar or per-coordinate (length d).
+    ``resume``/``return_carry`` continue a chunked solve bit-identically
+    (see :func:`minimize_lbfgs` — the carry shape is shared).
     """
     return _minimize_owlqn_impl(value_and_grad_fn, x0, data, max_iter, m,
-                                tolerance, l1, box, track_iterates)
+                                tolerance, l1, box, track_iterates,
+                                resume, return_carry)

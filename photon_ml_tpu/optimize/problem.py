@@ -210,7 +210,17 @@ class GLMOptimizationProblem:
         blocking device→host read happens here. The CD hot loop uses this
         so a fixed-effect update contributes zero syncs outside the fused
         epilogue fetch. The multi-device shard_map path keeps its eager
-        result (its collectives already fence)."""
+        result (its collectives already fence).
+
+        MULTI-IN-FLIGHT: each call returns an independent deferred
+        result owning its own device history buffers — the pipelined /
+        block-parallel CD sweep keeps several unmaterialized results
+        alive at once (the next update dispatches before the previous
+        tracker ever forces) and forces them in any order at the
+        sweep-boundary drain. Nothing here is shared across calls except
+        the jit cache, and a discarded result (a rolled-back speculative
+        dispatch) is simply never forced — its buffers free with the
+        last reference, no cleanup hook needed."""
         from photon_ml_tpu.parallel.mesh import DATA_AXIS, get_default_mesh
         from photon_ml_tpu.utils.faults import fault_point
 

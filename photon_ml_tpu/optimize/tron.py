@@ -130,7 +130,23 @@ class _TRONCarry(NamedTuple):
     iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
-@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8))
+class TRONResume(NamedTuple):
+    """Chunk-restart carry for TRON (see lbfgs.LBFGSResume): live iterate
+    state, the trust-region radius and failure count, the previous
+    objective, and the ORIGINAL f₀/‖g₀‖ anchors — a resumed chunk then
+    runs exactly the iterations the uninterrupted solve would have."""
+
+    x: Array
+    f: Array
+    g: Array
+    prev_f: Array
+    delta: Array
+    failures: Array
+    f0: Array
+    g0n: Array
+
+
+@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8, 10))
 def _minimize_tron_impl(
     value_and_grad_fn,
     hvp_fn,
@@ -141,27 +157,43 @@ def _minimize_tron_impl(
     max_failures: int,
     box: Optional[BoxConstraints] = None,
     track_iterates: bool = False,
+    resume: Optional[TRONResume] = None,
+    return_carry: bool = False,
 ):
     dtype = x0.dtype
-    f0, g0 = value_and_grad_fn(x0, data)
-    g0n = jnp.linalg.norm(g0)
+    if resume is None:
+        f_start, g_start = value_and_grad_fn(x0, data)
+        anchor_f0 = f_start
+        anchor_g0n = jnp.linalg.norm(g_start)
+        x_start = x0
+        prev_f0 = f_start + jnp.asarray(jnp.inf, dtype)
+        delta0 = anchor_g0n
+        failures0 = jnp.int32(0)
+    else:
+        x_start, f_start, g_start = resume.x, resume.f, resume.g
+        prev_f0 = resume.prev_f
+        delta0, failures0 = resume.delta, resume.failures
+        anchor_f0, anchor_g0n = resume.f0, resume.g0n
 
-    values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f0)
-    grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(g0n)
-    iterates0 = (jnp.zeros((max_iter + 1,) + x0.shape, dtype).at[0].set(x0)
-                 if track_iterates else None)
+    values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f_start)
+    grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(
+        jnp.linalg.norm(g_start))
+    iterates0 = (jnp.zeros((max_iter + 1,) + x_start.shape, dtype)
+                 .at[0].set(x_start) if track_iterates else None)
 
     init = _TRONCarry(
-        it=jnp.int32(0), x=x0, f=f0, g=g0,
-        prev_f=f0 + jnp.asarray(jnp.inf, dtype),
-        delta=g0n, failures=jnp.int32(0), made_progress=jnp.bool_(True),
+        it=jnp.int32(0), x=x_start, f=f_start, g=g_start,
+        prev_f=prev_f0,
+        delta=delta0, failures=failures0, made_progress=jnp.bool_(True),
         values=values, grad_norms=grad_norms, iterates=iterates0,
     )
 
     def cond(c: _TRONCarry) -> Array:
         return should_continue(
-            c.it, c.f, c.prev_f, jnp.linalg.norm(c.g), f0, g0n,
+            c.it, c.f, c.prev_f, jnp.linalg.norm(c.g),
+            anchor_f0, anchor_g0n,
             max_iter, tolerance, c.made_progress,
+            resumed=resume is not None,
         ) & (c.failures < max_failures)
 
     def body(c: _TRONCarry) -> _TRONCarry:
@@ -184,7 +216,12 @@ def _minimize_tron_impl(
         step_norm = jnp.linalg.norm(step)
 
         # First iteration: tighten the initial region to the step scale.
-        delta = jnp.where(c.it == 0, jnp.minimum(c.delta, step_norm), c.delta)
+        # A chunk-resumed solve carries its live region — never re-tighten.
+        if resume is None:
+            delta = jnp.where(c.it == 0,
+                              jnp.minimum(c.delta, step_norm), c.delta)
+        else:
+            delta = c.delta
 
         # Step-scale prediction alpha (TRON.scala:201-206).
         denom = f_arith - c.f - gs
@@ -249,6 +286,12 @@ def _minimize_tron_impl(
     final = lax.while_loop(cond, body, init)
     history = RunHistory(values=final.values, grad_norms=final.grad_norms,
                          num_iterations=final.it, iterates=final.iterates)
+    if return_carry:
+        carry = TRONResume(
+            x=final.x, f=final.f, g=final.g, prev_f=final.prev_f,
+            delta=final.delta, failures=final.failures,
+            f0=anchor_f0, g0n=anchor_g0n)
+        return final.x, history, final.made_progress, carry
     return final.x, history, final.made_progress
 
 
@@ -262,6 +305,8 @@ def minimize_tron(
     max_failures: int = DEFAULT_MAX_FAILURES,
     box: Optional[BoxConstraints] = None,
     track_iterates: bool = False,
+    resume: Optional[TRONResume] = None,
+    return_carry: bool = False,
 ):
     """Trust-region Newton; returns (x, RunHistory, made_progress).
 
@@ -269,6 +314,9 @@ def minimize_tron(
     Requires a twice-differentiable objective — the smoothed-hinge loss has no
     usable Hessian, so the problem factory refuses TRON for it exactly as the
     reference's OptimizerFactory does (OptimizerFactory.scala:78-79).
+    ``resume``/``return_carry`` continue a chunked solve bit-identically
+    (see :class:`TRONResume`).
     """
     return _minimize_tron_impl(value_and_grad_fn, hvp_fn, x0, data, max_iter,
-                               tolerance, max_failures, box, track_iterates)
+                               tolerance, max_failures, box, track_iterates,
+                               resume, return_carry)

@@ -12,7 +12,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_crash_resume_drill_end_to_end(tmp_path):
+def _run_drill(tmp_path, *extra):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # a fault armed by an outer harness must not leak into the drill's
@@ -22,7 +22,7 @@ def test_crash_resume_drill_end_to_end(tmp_path):
     p = subprocess.run(
         [sys.executable,
          os.path.join(_REPO, "tools", "crash_resume_drill.py"),
-         "--workdir", str(tmp_path), "--sweeps", "3"],
+         "--workdir", str(tmp_path), "--sweeps", "3", *extra],
         env=env, cwd=_REPO, text=True, capture_output=True, timeout=420)
     assert p.returncode == 0, (
         f"drill failed rc={p.returncode}\nstdout:\n{p.stdout}\n"
@@ -30,3 +30,25 @@ def test_crash_resume_drill_end_to_end(tmp_path):
     assert "DRILL_OK" in p.stdout, p.stdout
     assert "bit-exact" in p.stdout, p.stdout
     assert "refused cleanly" in p.stdout, p.stdout
+    return p
+
+
+def test_crash_resume_drill_end_to_end(tmp_path):
+    """Block size 1: the checkpoint-free reference role runs the
+    DEFAULT double-buffered sweep (real speculation) while the
+    crash/resume roles run sequentially, so the drill's bit-exactness
+    check also proves pipelined == sequential through a real
+    kill/resume cycle."""
+    _run_drill(tmp_path)
+
+
+def test_crash_resume_drill_mid_block(tmp_path):
+    """Block size 2: the kill lands MID-BLOCK (coordinate 1 of a 2-wide
+    block). Snapshots exist only at block boundaries, resume lands on
+    the killed update's block start, and the resumed blocked run is
+    bit-exact vs the uninterrupted blocked reference."""
+    # the drill asserts the block-boundary resume point (sweep 1,
+    # coordinate 0) internally against the worker's WORKER_RESUME line;
+    # 2 sweeps is the minimum that puts the kill (sweep 1) mid-run
+    p = _run_drill(tmp_path, "--sweeps", "2", "--cd-block-size", "2")
+    assert "DRILL_OK sweeps=2 block_size=2" in p.stdout, p.stdout

@@ -111,6 +111,84 @@ def test_w1_suppressed(tmp_path):
     assert [f.rule for f in report.suppressed] == ["W101"]
 
 
+# -- W105 pipeline-depth discipline ----------------------------------------
+
+W105_POSITIVE = """
+def sweep(dispatch_update, resolve_update, blocks):
+    p0 = dispatch_update(blocks[0])
+    p1 = dispatch_update(blocks[1])
+    p2 = dispatch_update(blocks[2])   # W105: p0 now two dispatches old
+    resolve_update(p0)
+    resolve_update(p1)
+    resolve_update(p2)
+"""
+
+W105_LOOP_POSITIVE = """
+def sweep(dispatch_update, resolve_update, blocks):
+    older = None
+    newer = None
+    for b in blocks:
+        cur = dispatch_update(b)      # W105: 'older' survives 2 dispatches
+        if older is not None:
+            resolve_update(older)
+        older = newer
+        newer = cur
+"""
+
+W105_NEGATIVE = """
+def sweep(dispatch_update, resolve_update, fetch_update, blocks):
+    pending = None
+    for b in blocks:
+        cur = dispatch_update(b)      # depth 1: pending is one old, fine
+        if pending is not None:
+            resolve_update(pending)
+        pending = cur
+    if pending is not None:
+        resolve_update(pending)
+
+def ladder(dispatch_update, fetch_update, b):
+    p = dispatch_update(b)
+    objective, loss = fetch_update(p)
+    return objective, loss
+"""
+
+W105_SUPPRESSED = """
+def sweep(dispatch_update, resolve_update, blocks):
+    p0 = dispatch_update(blocks[0])
+    p1 = dispatch_update(blocks[1])
+    # photonlint: allow-W105(fixture: bounded two-deep drain follows)
+    p2 = dispatch_update(blocks[2])
+    for p in (p0, p1, p2):
+        resolve_update(p)
+"""
+
+
+def test_w105_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W105_POSITIVE},
+                         families={"W1"})
+    assert rules_of(report) == ["W105"]
+    assert "p0" in report.new[0].message
+
+
+def test_w105_loop_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W105_LOOP_POSITIVE},
+                         families={"W1"})
+    assert "W105" in rules_of(report)
+
+
+def test_w105_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W105_NEGATIVE},
+                         families={"W1"})
+    assert report.new == []
+
+
+def test_w105_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W105_SUPPRESSED},
+                         families={"W1"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W105"]
+
+
 # -- W2xx jit purity -------------------------------------------------------
 
 W2_POSITIVE = """
@@ -551,6 +629,14 @@ CANARIES = {
     "W101": (
         "\n\ndef _photonlint_canary_sync():\n"
         "    return float(jnp.sum(jnp.zeros((3,))))\n"),
+    "W105": (
+        "\n\ndef _photonlint_canary_pipeline(dispatch_update, "
+        "resolve_update):\n"
+        "    p0 = dispatch_update(0)\n"
+        "    p1 = dispatch_update(1)\n"
+        "    p2 = dispatch_update(2)\n"
+        "    for p in (p0, p1, p2):\n"
+        "        resolve_update(p)\n"),
     "W201": (
         "\n\n@jax.jit\n"
         "def _photonlint_canary_jit(x):\n"

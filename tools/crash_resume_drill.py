@@ -7,10 +7,17 @@ a checkpoint/resume regression fails loudly in CI instead of surfacing
 as lost work on a TPU pod. What it proves, end to end with REAL process
 deaths:
 
-1. **Reference** — an uninterrupted run's final coordinate states.
+1. **Reference** — an uninterrupted run's final coordinate states,
+   computed with the DEFAULT double-buffered sweep (no checkpoint
+   barriers → the speculative dispatch path genuinely runs); the
+   crash/resume roles run ``--sequential``, so step 4 also proves
+   pipelined == sequential through the crash/resume cycle.
 2. **Crash** — the same run with mid-sweep checkpointing is killed by a
    deterministic injected fault (``cd.update@<sweep>.<coord>=kill``)
-   INSIDE a sweep, after some snapshots have landed.
+   INSIDE a sweep, after some snapshots have landed. With
+   ``--cd-block-size`` > 1 the kill lands MID-BLOCK: snapshots only
+   exist at block boundaries and resume must land on the killed
+   update's block start.
 3. **Resume** — a fresh process restores the newest intact snapshot and
    continues from the exact (sweep, coordinate) it died at; it must
    report a genuinely mid-sweep resume point, not a from-scratch rerun.
@@ -116,10 +123,19 @@ def _build(sweeps):
     return args
 
 
-def run_worker(sweeps, ckpt_dir, out_path):
+def run_worker(sweeps, ckpt_dir, out_path, block_size=1, sequential=False):
     """One training role: run CD (optionally checkpointed), save final
     per-coordinate states to ``out_path``. Resumes automatically from the
-    newest intact snapshot in ``ckpt_dir``."""
+    newest intact snapshot in ``ckpt_dir``.
+
+    ``sequential`` disables double-buffering (``pipeline_depth=0``): the
+    drill's crash/resume roles use it while the checkpoint-free
+    REFERENCE run keeps the default pipelined sweep (where speculation
+    genuinely executes), so the final bit-exactness check also proves
+    the pipelined path is bit-identical to the sequential one.
+    ``block_size`` > 1 runs the block-parallel sweep (the mid-block
+    crash cell: snapshots land at block boundaries only, and resume
+    must land on the killed update's block start)."""
     import numpy as np
 
     from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
@@ -140,7 +156,8 @@ def run_worker(sweeps, ckpt_dir, out_path):
     result = run_coordinate_descent(
         coords, n_iter, task, labels, weights, offsets,
         checkpoint_manager=mgr, checkpoint_every_coordinates=1,
-        resume_snapshot=snap)
+        resume_snapshot=snap, block_size=block_size,
+        pipeline_depth=0 if sequential else 1)
     final = {}
     for cid, m in result.model.models.items():
         # publish() output varies by coordinate kind; compare raw means
@@ -163,22 +180,31 @@ def _spawn(args, extra_env=None):
         env=env, cwd=_REPO, text=True, capture_output=True)
 
 
-def run_drill(workdir, sweeps):
+def run_drill(workdir, sweeps, block_size=1):
     import numpy as np
 
     ckpt = os.path.join(workdir, "ckpt")
     ref_out = os.path.join(workdir, "ref.npz")
     res_out = os.path.join(workdir, "resumed.npz")
-    worker = ["--worker", "--sweeps", str(sweeps), "--out"]
+    worker = ["--worker", "--sweeps", str(sweeps),
+              "--cd-block-size", str(block_size), "--out"]
 
-    # 1) uninterrupted reference (no checkpointing)
+    # 1) uninterrupted reference (no checkpointing) — runs the DEFAULT
+    # double-buffered sweep, and with no checkpoint-cadence barriers the
+    # speculative dispatch path genuinely executes here. The crash/
+    # resume roles below run --sequential (their per-update cadence
+    # would barrier the pipeline into sequential resolves anyway), so
+    # step 4's bit-exact comparison proves pipelined == sequential
+    # THROUGH a crash/resume cycle, not just resume correctness.
     p = _spawn(worker + [ref_out])
     assert p.returncode == 0 and "WORKER_DONE" in p.stdout, \
         f"reference run failed rc={p.returncode}\n{p.stdout}\n{p.stderr}"
-    print(f"drill: reference run complete ({ref_out})", flush=True)
+    print(f"drill: pipelined reference run complete ({ref_out})",
+          flush=True)
 
-    # 2) checkpointed run killed mid-sweep by an injected fault
-    p = _spawn(worker + [res_out, "--ckpt", ckpt], extra_env={
+    # 2) checkpointed SEQUENTIAL run killed mid-sweep by an injected fault
+    p = _spawn(worker + [res_out, "--ckpt", ckpt, "--sequential"],
+               extra_env={
         "PHOTON_FAULTS":
             f"cd.update@{KILL_SWEEP}.{KILL_COORD}=kill:1:{KILL_EXIT}"})
     assert p.returncode == KILL_EXIT, \
@@ -188,11 +214,14 @@ def run_drill(workdir, sweeps):
     print(f"drill: run killed mid-sweep at sweep {KILL_SWEEP} "
           f"coordinate {KILL_COORD} (rc={p.returncode})", flush=True)
 
-    # 3) resume — must pick up MID-sweep, not replay from scratch
-    p = _spawn(worker + [res_out, "--ckpt", ckpt])
+    # 3) resume — must pick up MID-sweep, not replay from scratch.
+    # Snapshots land at BLOCK boundaries, so the resume point is the
+    # killed update's block start (== the update itself at block size 1).
+    resume_coord = (KILL_COORD // block_size) * block_size
+    p = _spawn(worker + [res_out, "--ckpt", ckpt, "--sequential"])
     assert p.returncode == 0 and "WORKER_DONE" in p.stdout, \
         f"resume run failed rc={p.returncode}\n{p.stdout}\n{p.stderr}"
-    assert (f"WORKER_RESUME sweep={KILL_SWEEP} coordinate={KILL_COORD}"
+    assert (f"WORKER_RESUME sweep={KILL_SWEEP} coordinate={resume_coord}"
             in p.stdout), f"not a mid-sweep resume:\n{p.stdout}"
     print("drill: resumed mid-sweep from the newest snapshot", flush=True)
 
@@ -230,7 +259,8 @@ def run_drill(workdir, sweeps):
         raise AssertionError(
             "restore() returned from an all-corrupt checkpoint dir")
 
-    print(f"DRILL_OK sweeps={sweeps} snapshots={len(steps)}", flush=True)
+    print(f"DRILL_OK sweeps={sweeps} block_size={block_size} "
+          f"snapshots={len(steps)}", flush=True)
 
 
 def main(argv=None):
@@ -238,17 +268,28 @@ def main(argv=None):
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: a fresh tempdir)")
     ap.add_argument("--sweeps", type=int, default=3)
+    ap.add_argument("--cd-block-size", type=int, default=1,
+                    help="block-parallel sweep width for every role "
+                         "(the mid-block crash cell runs this at 2: "
+                         "snapshots land at block boundaries and resume "
+                         "lands on the killed update's block start)")
     ap.add_argument("--worker", action="store_true",
                     help="internal: run one training role")
+    ap.add_argument("--sequential", action="store_true",
+                    help="internal: run the worker with pipeline_depth=0 "
+                         "(the reference role — proves pipelined == "
+                         "sequential through the crash/resume cycle)")
     ap.add_argument("--ckpt", default=None)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.worker:
-        run_worker(args.sweeps, args.ckpt, args.out)
+        run_worker(args.sweeps, args.ckpt, args.out,
+                   block_size=args.cd_block_size,
+                   sequential=args.sequential)
         return
     workdir = args.workdir or tempfile.mkdtemp(prefix="crash_resume_drill_")
     os.makedirs(workdir, exist_ok=True)
-    run_drill(workdir, args.sweeps)
+    run_drill(workdir, args.sweeps, block_size=args.cd_block_size)
 
 
 if __name__ == "__main__":
