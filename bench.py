@@ -451,7 +451,9 @@ def _instrumented_warm_pass(run_fn) -> dict:
     instrumentation/compile-cache regression), and the traced pass's
     ``train_secs_traced`` / ``trace_overhead_pct`` (the smoke test
     asserts < 2% on a repetition-median basis; this single-shot record
-    tracks the trend)."""
+    tracks the trend) and the live-telemetry pass's
+    ``train_secs_export_live`` / ``trace_export_overhead_pct`` (same
+    contract with a connected --telemetry-endpoint consumer)."""
     from photon_ml_tpu.game import coordinate_descent as cd_mod
     from photon_ml_tpu.obs import trace as obs_trace
     from photon_ml_tpu.obs.metrics import REGISTRY as obs_registry
@@ -496,6 +498,49 @@ def _instrumented_warm_pass(run_fn) -> dict:
     train_secs_traced = time.perf_counter() - t0
     obs_trace.disable()
 
+    # live-telemetry probe: the SAME warm pass with tracing on AND a
+    # TelemetrySink connected to a real (discarding) local consumer,
+    # spans drained to it on a heartbeat-like cadence — the
+    # armed-but-idle cost of --telemetry-endpoint. The smoke test
+    # asserts < 2% (the PR 5 tracing-overhead contract, extended to
+    # the export plane); this single-shot record tracks the trend.
+    import socket
+    import threading
+
+    from photon_ml_tpu.obs.export import TelemetrySink
+
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+
+    def _discard():
+        conn, _ = server.accept()
+        while conn.recv(65536):
+            pass
+
+    threading.Thread(target=_discard, daemon=True).start()
+    sink = TelemetrySink("127.0.0.1:%d" % server.getsockname()[1])
+    tracer = obs_trace.enable()
+    stop_drain = threading.Event()
+
+    def _drain_loop():
+        while not stop_drain.wait(0.2):
+            for e in tracer.drain():
+                sink.emit({"kind": "span", **e})
+
+    drainer = threading.Thread(target=_drain_loop, daemon=True)
+    drainer.start()
+    try:
+        t0 = time.perf_counter()
+        run_fn()
+        train_secs_export = time.perf_counter() - t0
+    finally:
+        stop_drain.set()
+        drainer.join(timeout=2.0)
+        obs_trace.disable()
+        sink.close()
+        server.close()
+
     # fault-free-overhead probe: the SAME warm pass with a fault spec
     # ARMED on the hot-loop point but never firing (flaky p=0 — every
     # cd.update visit evaluates the full spec-matching + deterministic
@@ -524,6 +569,10 @@ def _instrumented_warm_pass(run_fn) -> dict:
         "train_secs_traced": train_secs_traced,
         "trace_overhead_pct": (100.0 * (train_secs_traced - train_secs_warm)
                                / train_secs_warm),
+        "train_secs_export_live": train_secs_export,
+        "trace_export_overhead_pct": (
+            100.0 * (train_secs_export - train_secs_warm)
+            / train_secs_warm),
         "train_secs_chaos_armed": train_secs_chaos,
         "chaos_overhead_pct": (100.0 * (train_secs_chaos - train_secs_warm)
                                / train_secs_warm),

@@ -93,7 +93,18 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "run_manifest.json here")
     p.add_argument("--trace-heartbeat-seconds", type=float, default=10.0)
     p.add_argument("--trace-stall-seconds", type=float, default=120.0)
-    return p.parse_args(argv)
+    p.add_argument("--telemetry-endpoint",
+                   help="with --trace-dir: stream telemetry records "
+                        "live to this consumer (host:port, "
+                        "unix:/path.sock, or file:/path.jsonl) — same "
+                        "contract as the training driver")
+    ns = p.parse_args(argv)
+    from photon_ml_tpu.cli.game_training_driver import (
+        _check_telemetry_flags,
+    )
+
+    _check_telemetry_flags(p, ns)
+    return ns
 
 
 class GameScoringDriver:
@@ -254,9 +265,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     except clean_abort_types() as e:
         # documented terminal conditions exit 3 with a PHOTON_ABORT
         # line, never a stack trace (see photon_ml_tpu/cli/__init__.py)
+        if obs_run is not None:
+            obs_run.set_exit_status("abort",
+                                    reason=f"{type(e).__name__}: {e}")
         raise clean_abort(e, log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"GAME scoring failed: {e}")
+        if obs_run is not None:
+            obs_run.set_exit_status("error",
+                                    reason=f"{type(e).__name__}: {e}")
         raise
     finally:
         if obs_run is not None:

@@ -346,7 +346,36 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "span closes within this window (logged, counted "
                         "on the 'stalls' metric, marked in the heartbeat "
                         "records)")
-    return p.parse_args(argv)
+    p.add_argument("--telemetry-endpoint",
+                   help="with --trace-dir: stream span/heartbeat/"
+                        "run-end records live as line-delimited JSON to "
+                        "this consumer — host:port (TCP), "
+                        "unix:/path.sock, or file:/path.jsonl; when a "
+                        "socket consumer is absent or slow, records "
+                        "fall back to <trace-dir>/telemetry.jsonl or "
+                        "are dropped (counted on telemetry_dropped) — "
+                        "the hot loop never blocks on telemetry. "
+                        "tools/photon_status.py is the bundled consumer")
+    ns = p.parse_args(argv)
+    _check_telemetry_flags(p, ns)
+    return ns
+
+
+def _check_telemetry_flags(p: argparse.ArgumentParser,
+                           ns: argparse.Namespace) -> None:
+    """Fail flag misuse at parse time with argparse's one-line usage
+    error (exit 2), not a ValueError traceback from the obs wiring."""
+    if not getattr(ns, "telemetry_endpoint", None):
+        return
+    if not ns.trace_dir:
+        p.error("--telemetry-endpoint requires --trace-dir (the live "
+                "stream is fed by the run's span spill + heartbeat)")
+    from photon_ml_tpu.obs.export import parse_endpoint
+
+    try:
+        parse_endpoint(ns.telemetry_endpoint)
+    except ValueError as e:
+        p.error(str(e))
 
 
 class GameTrainingDriver:
@@ -977,6 +1006,9 @@ def _run_multihost(ns: argparse.Namespace) -> None:
               f"objective={result['objective']:.6f}", flush=True)
     except Exception as e:
         driver.logger.error(f"multi-host GAME training failed: {e}")
+        if obs_run is not None:
+            obs_run.set_exit_status("error",
+                                    reason=f"{type(e).__name__}: {e}")
         raise
     finally:
         if obs_run is not None:
@@ -1057,9 +1089,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # all-corrupt checkpoints, I/O down through its retries, an
         # unrecovered injected fault) end with the PHOTON_ABORT line and
         # exit code 3 — never a stack trace
+        if obs_run is not None:  # the run_end record says WHY it ended
+            obs_run.set_exit_status("abort",
+                                    reason=f"{type(e).__name__}: {e}")
         raise clean_abort(e, log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"GAME training failed: {e}")
+        if obs_run is not None:
+            obs_run.set_exit_status("error",
+                                    reason=f"{type(e).__name__}: {e}")
         raise
     finally:
         if obs_run is not None:

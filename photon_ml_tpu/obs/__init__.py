@@ -15,12 +15,20 @@ log-only event bus):
 - ``obs.bridge`` — event-bus listener mirroring fault/recovery/
   quarantine events into counters.
 - ``obs.heartbeat`` — stall-detecting progress records for long runs.
+- ``obs.export`` — the live telemetry plane: a bounded non-blocking
+  sink streaming span/heartbeat/run-end records as line-delimited JSON
+  to a local socket (or file-tail) consumer while the run trains.
 - ``obs.run`` — the drivers' ``--trace-dir`` integration: run manifest,
-  live heartbeat stream, final trace/metrics flush.
+  live heartbeat stream, final trace/metrics flush, and the
+  ``--telemetry-endpoint`` sink wiring.
 """
 
 from photon_ml_tpu.obs import trace  # noqa: F401
 from photon_ml_tpu.obs.bridge import MetricsEventListener  # noqa: F401
+from photon_ml_tpu.obs.export import (  # noqa: F401
+    TELEMETRY_PROTO,
+    TelemetrySink,
+)
 from photon_ml_tpu.obs.heartbeat import Heartbeat  # noqa: F401
 from photon_ml_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,

@@ -41,7 +41,8 @@ class Heartbeat:
                  stall_seconds: float = 120.0,
                  warn: Optional[Callable[[str], None]] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 on_beat: Optional[Callable[[], None]] = None):
+                 on_beat: Optional[Callable[[], None]] = None,
+                 on_record: Optional[Callable[[dict], None]] = None):
         self.tracer = tracer
         self.out_path = out_path
         self.interval_seconds = float(interval_seconds)
@@ -49,6 +50,7 @@ class Heartbeat:
         self._warn = warn
         self._registry = registry or REGISTRY
         self._on_beat = on_beat
+        self._on_record = on_record
         self.stalled = False
         self.beats = 0
         self._write_failed = False
@@ -77,6 +79,10 @@ class Heartbeat:
             "last_span_close_age_s": round(age, 3),
             "open_spans": self.tracer.open_spans()[:8],
             "stalled": stalled,
+            # compact live counters: the telemetry stream / run-dir tail
+            # answers "how hard is the run working" WITHOUT waiting for
+            # the exit snapshot (tools/photon_status.py reads these)
+            "metric_totals": self._registry.totals(),
         }
         if stalled and not self.stalled:
             self._registry.counter("stalls").inc()
@@ -93,6 +99,12 @@ class Heartbeat:
                     f"stack:\n  {stack_dump}")
         self.stalled = stalled
         self.beats += 1
+        if self._on_record is not None:
+            try:  # the live-export hook must not kill the beat either
+                self._on_record(record)
+            except Exception as e:
+                if self._warn is not None:
+                    self._warn(f"heartbeat: on_record hook raised: {e!r}")
         if self.out_path is not None:
             try:
                 with self._write_lock:

@@ -200,6 +200,17 @@ class MetricsRegistry:
             out.extend(m.records())
         return out
 
+    def totals(self) -> dict[str, float]:
+        """``{name: label-summed total}`` for counters and gauges — the
+        compact per-heartbeat snapshot the live telemetry stream (and
+        ``tools/photon_status.py``) rides on. Histograms are skipped:
+        their full records only ship in the exit snapshot."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.total() for m in sorted(metrics,
+                                                  key=lambda m: m.name)
+                if isinstance(m, Counter)}
+
     def reset(self) -> None:
         """Zero every metric (bench/test isolation; registrations stay)."""
         with self._lock:

@@ -14,6 +14,8 @@ and appends the final metrics snapshot::
       trace.json          # Chrome trace events (Perfetto-loadable)
       spans.jsonl         # one span per line (jq/pandas-friendly, live)
       metrics.jsonl       # heartbeat lines (live) + final counter dump
+      telemetry.jsonl     # --telemetry-endpoint fallback stream (only
+                          # when a socket consumer never connects)
 
 In multi-host runs every process passes its ``process_index`` with
 ``num_processes > 1`` and writes ``trace.<i>.json`` /
@@ -32,6 +34,7 @@ import time
 from typing import Callable, Optional
 
 from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs.export import TELEMETRY_PROTO, TelemetrySink
 from photon_ml_tpu.obs.heartbeat import Heartbeat
 from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from photon_ml_tpu.utils.faults import fault_point
@@ -86,6 +89,7 @@ def run_manifest(flags: Optional[dict] = None,
         os.path.abspath(__file__))))
     return {
         "kind": "run_manifest",
+        "telemetry_proto": TELEMETRY_PROTO,
         "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "argv": list(sys.argv),
         "python": sys.version.split()[0],
@@ -126,12 +130,18 @@ class ObservedRun:
                  stall_seconds: float = 120.0,
                  warn: Optional[Callable[[str], None]] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 preserve_existing: bool = False):
+                 preserve_existing: bool = False,
+                 telemetry_endpoint: Optional[str] = None):
         self.trace_dir = trace_dir
         self._registry = registry or REGISTRY
+        self._process_index = process_index
+        self._exit_status = "ok"
+        self._exit_reason = ""
         suffix = f".{process_index}" if num_processes > 1 else ""
         self.trace_path = os.path.join(trace_dir, f"trace{suffix}.json")
         self.spans_path = os.path.join(trace_dir, f"spans{suffix}.jsonl")
+        self.telemetry_path = os.path.join(
+            trace_dir, f"telemetry{suffix}.jsonl")
         self.metrics_path = os.path.join(
             trace_dir, f"metrics{suffix}.jsonl")
         self.manifest_path = os.path.join(
@@ -153,6 +163,18 @@ class ObservedRun:
                                 **self._manifest_args)
         with open(self.manifest_path, "w") as fh:
             json.dump(manifest, fh, indent=1)
+
+        # Live telemetry plane (--telemetry-endpoint): a bounded
+        # non-blocking sink shipping NDJSON records to a local consumer,
+        # falling back to telemetry.jsonl in the trace dir when none
+        # connects. The manifest is the stream's first record — a
+        # consumer knows who it is watching before any span arrives.
+        self.sink: Optional[TelemetrySink] = None
+        if telemetry_endpoint:
+            self.sink = TelemetrySink(
+                telemetry_endpoint, fallback_path=self.telemetry_path,
+                registry=self._registry, warn=warn)
+            self.sink.emit(manifest)
         if preserve_existing and os.path.exists(self.metrics_path):
             with open(self.metrics_path, "a") as fh:
                 fh.write(json.dumps({
@@ -171,8 +193,16 @@ class ObservedRun:
             self.tracer, out_path=self.metrics_path,
             interval_seconds=heartbeat_seconds,
             stall_seconds=stall_seconds, warn=warn,
-            registry=self._registry, on_beat=self._spill).start()
+            registry=self._registry, on_beat=self._spill,
+            on_record=self._export_record).start()
         self._finished = False
+
+    def _export_record(self, record: dict) -> None:
+        """Ship one kind-tagged record (heartbeat, run_end) on the live
+        sink; a no-op without ``--telemetry-endpoint``."""
+        if self.sink is not None:
+            self.sink.emit({**record,
+                            "process_index": self._process_index})
 
     def _spill(self) -> None:
         """Drain the tracer's closed spans into ``spans.jsonl`` (runs on
@@ -181,7 +211,16 @@ class ObservedRun:
         them pending (capped at the tracer's buffer bound) for the next
         beat instead of losing the interval."""
         with self._spill_lock:
-            self._pending.extend(self.tracer.drain())
+            drained = self.tracer.drain()
+            if self.sink is not None:
+                # exported exactly once, at drain time: a failed FILE
+                # spill keeps spans pending for the next beat without
+                # duplicating them on the live stream
+                for e in drained:
+                    self.sink.emit({"kind": "span",
+                                    "process_index": self._process_index,
+                                    **e})
+            self._pending.extend(drained)
             if not self._pending:
                 return
             cap = self.tracer.max_buffered_spans
@@ -201,6 +240,14 @@ class ObservedRun:
             call_with_retry(write, site="obs.flush", policy=_FLUSH_RETRY)
             self._pending = []
 
+    def set_exit_status(self, status: str, reason: str = "") -> None:
+        """Record how the run is ending ("ok" default, "abort" on a
+        clean abort, "error" otherwise) — written as the ``run_end``
+        record at :meth:`finish` so ``tools/photon_status.py`` can tell
+        a finished run from an aborted one."""
+        self._exit_status = status
+        self._exit_reason = reason
+
     def finish(self) -> None:
         """Stop the heartbeat and flush trace + metrics files
         (idempotent; call from the driver's ``finally``). Every export
@@ -213,13 +260,16 @@ class ObservedRun:
         for step, fn in (("spill", self._spill),
                          ("manifest", self._finish_manifest),
                          ("trace", self._finish_trace),
-                         ("metrics", self._finish_metrics)):
+                         ("metrics", self._finish_metrics),
+                         ("run_end", self._finish_run_end)):
             try:
                 fn()
             except (OSError, ValueError, RetryExhaustedError) as e:
                 if self._warn is not None:
                     self._warn(f"trace export ({step}) failed at finish: "
                                f"{e!r} — continuing")
+        if self.sink is not None:
+            self.sink.close()
         if trace.get_tracer() is self.tracer:
             trace.disable()
 
@@ -257,6 +307,30 @@ class ObservedRun:
 
         call_with_retry(write, site="obs.flush", policy=_FLUSH_RETRY)
 
+    def _finish_run_end(self) -> None:
+        """Terminal record: the metrics stream (and the live telemetry
+        stream) ends with how the run ended, so a status consumer can
+        tell "finished clean" from "aborted" from "still running /
+        killed" (no run_end line at all)."""
+        record = {"kind": "run_end",
+                  "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                  "status": self._exit_status,
+                  "reason": self._exit_reason,
+                  "uptime_s": round(self.tracer.uptime_seconds(), 3),
+                  # final counter totals ride the terminal record: a
+                  # SOCKET consumer has no exit snapshot file to read,
+                  # and a short run's last heartbeat can predate the
+                  # tail of the work (photon-top reads these)
+                  "metric_totals": self._registry.totals()}
+        self._export_record(record)
+
+        def write():
+            fault_point("obs.flush", path=self.metrics_path)
+            with open(self.metrics_path, "a") as fh:
+                fh.write(json.dumps(record) + "\n")
+
+        call_with_retry(write, site="obs.flush", policy=_FLUSH_RETRY)
+
 
 def start_observed_run(trace_dir: str, **kwargs) -> ObservedRun:
     return ObservedRun(trace_dir, **kwargs)
@@ -270,11 +344,20 @@ def start_observed_run_from_flags(ns, process_index: int = 0,
     """Install the run-scoped tracer/heartbeat when the parsed driver
     flags carry ``--trace-dir`` (returns the ObservedRun to finish(), or
     None) — the one adapter both GAME drivers share."""
+    endpoint = getattr(ns, "telemetry_endpoint", None)
     if not getattr(ns, "trace_dir", None):
+        if endpoint:
+            # the sink rides the ObservedRun's tracer/heartbeat/spill
+            # machinery; silently ignoring the endpoint would hand the
+            # operator a consumer that never hears anything
+            raise ValueError(
+                "--telemetry-endpoint requires --trace-dir (the live "
+                "stream is fed by the run's span spill + heartbeat)")
         return None
     return start_observed_run(
         ns.trace_dir, process_index=process_index,
         num_processes=num_processes, flags=vars(ns),
         heartbeat_seconds=ns.trace_heartbeat_seconds,
         stall_seconds=ns.trace_stall_seconds, warn=warn,
-        preserve_existing=preserve_existing)
+        preserve_existing=preserve_existing,
+        telemetry_endpoint=endpoint)

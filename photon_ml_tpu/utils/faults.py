@@ -130,6 +130,13 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
         "before the observability layer appends spans/metrics to the "
         "trace dir (obs/run.py)",
         modes=("io_error", "enospc", "flaky")),
+    "obs.export": FaultPointInfo(
+        "on the telemetry exporter's writer thread, before each "
+        "connect/write of a record batch to the --telemetry-endpoint "
+        "consumer (obs/export.py); a batch that exhausts its retries "
+        "is dropped and counted on telemetry_dropped, never blocks "
+        "training",
+        modes=("io_error", "slow", "flaky")),
     "worker.start": FaultPointInfo(
         "in a multi-host worker right after jax.distributed.initialize "
         "(parallel/multihost.py); tag = process id",

@@ -672,6 +672,33 @@ def seeded_package(tmp_path_factory):
     return root
 
 
+def test_obs_export_drift_canary(tmp_path_factory):
+    """The live-plane fault point rides the same bidirectional W4xx
+    reconcile as every other point: renaming its README PHOTON_FAULTS
+    row makes the REAL ``obs/export.py`` call sites fire W401
+    (undocumented site) AND the now-phantom row fire W402 (row without
+    a site) — so the telemetry exporter cannot drift out of the
+    operator-facing fault table unnoticed."""
+    root = tmp_path_factory.mktemp("obs_export_canary")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    readme_text = (REPO_ROOT / "README.md").read_text()
+    assert "| `obs.export` |" in readme_text, \
+        "README PHOTON_FAULTS table lost its obs.export row"
+    (root / "README.md").write_text(readme_text.replace(
+        "| `obs.export` |", "| `obs.export.phantom` |"))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE)
+    w401 = [f for f in report.new if f.rule == "W401"
+            and '"obs.export"' in f.message]
+    assert w401, "no W401 for the undocumented obs.export call sites"
+    assert all(f.path == "photon_ml_tpu/obs/export.py" for f in w401)
+    w402 = [f for f in report.new if f.rule == "W402"
+            and "obs.export.phantom" in f.message]
+    assert w402, "no W402 for the phantom obs.export README row"
+
+
 def test_canaries_turn_the_run_red(seeded_package):
     report = runner.lint(
         seeded_package, paths=["photon_ml_tpu"],

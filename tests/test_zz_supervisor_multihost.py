@@ -236,3 +236,92 @@ def test_supervised_gang_resumes_from_checkpoint(
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(recs[0]["fixed"], fixed_ref,
                                rtol=5e-3, atol=5e-3)
+
+
+def test_multihost_trace_dir_merges_into_one_timeline(
+        tmp_path, multiprocess_backend):
+    """ISSUE acceptance: a 2-process gang run with a shared --trace-dir
+    leaves trace.0.json / trace.1.json, and tools/trace_merge.py folds
+    them into ONE valid Chrome-trace document with two tracks,
+    clock-aligned on each process's gang.form span; trace_report
+    --process composes with the merged document."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _write_game_part(str(data_dir / "part-00000.avro"),
+                     n=120, n_users=5, d_g=4, d_u=2, seed=50)
+    _write_game_part(str(data_dir / "part-00001.avro"),
+                     n=100, n_users=5, d_g=4, d_u=2, seed=51)
+    from photon_ml_tpu.io.data_format import NameAndTermFeatureSets
+
+    sets = NameAndTermFeatureSets.from_paths(
+        [str(data_dir)], ["globalFeatures", "userFeatures"])
+    fs_dir = tmp_path / "fs"
+    sets.save(str(fs_dir))
+
+    port = _free_port()
+    mh_out = str(tmp_path / "mh")
+    trace_dir = str(tmp_path / "trace")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m",
+             "photon_ml_tpu.cli.game_training_driver",
+             *_game_cli_args(str(data_dir), mh_out, str(fs_dir),
+                             num_iterations=1),
+             "--num-processes", "2", "--process-id", str(i),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--coordinator-timeout", "60",
+             "--heartbeat-timeout", "10",
+             "--trace-dir", trace_dir,
+             "--trace-heartbeat-seconds", "0.5"],
+            env=_worker_env(4), cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (f"worker {i} rc={rc}\nstdout:\n{out}\n"
+                         f"stderr:\n{err}")
+
+    import json
+
+    for i in range(2):
+        assert os.path.exists(
+            os.path.join(trace_dir, f"trace.{i}.json")), \
+            os.listdir(trace_dir)
+    merge = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_merge.py"),
+         trace_dir], capture_output=True, text=True, timeout=120)
+    assert merge.returncode == 0, merge.stdout + merge.stderr
+    merged_path = os.path.join(trace_dir, "merged_trace.json")
+    with open(merged_path) as fh:
+        doc = json.load(fh)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert doc["otherData"]["alignment"] == "gang.form"
+    # one anchored timeline: both gang.form spans end together, and
+    # every track is monotonic
+    ends = {}
+    for e in xs:
+        if e["name"] == "gang.form":
+            ends.setdefault(e["pid"], e["ts"] + e["dur"])
+    assert set(ends) == {0, 1}
+    assert ends[0] == pytest.approx(ends[1])
+    for pid in (0, 1):
+        ts = [e["ts"] for e in xs if e["pid"] == pid]
+        assert ts == sorted(ts)
+    # the merged document composes with the report/diff tooling
+    report = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         merged_path, "--process", "1", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert report.returncode == 0, report.stderr
+    assert json.loads(report.stdout)["processes"] == [1]

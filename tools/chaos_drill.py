@@ -135,7 +135,14 @@ def build_fixture(root: str) -> dict:
 
 def driver_args(data_dir: str, fs_dir: str, out_dir: str, ckpt_dir: str,
                 trace_dir: str) -> list[str]:
+    # --telemetry-endpoint points at a unix socket NOBODY ever serves:
+    # every cell (and the reference) trains under the live plane's
+    # worst consumer — a permanently dead one — so the obs.export cells
+    # drill the fault modes ON TOP of the dead-consumer fallback, and
+    # the bit-exact checks prove the plane never touches training math
     return [
+        "--telemetry-endpoint",
+        "unix:" + os.path.join(trace_dir, "no_consumer.sock"),
         "--train-input-dirs", data_dir,
         "--output-dir", out_dir,
         "--task-type", "LOGISTIC_REGRESSION",
@@ -175,10 +182,12 @@ CellDef = dict
 
 def build_cells(smoke: bool) -> list[CellDef]:
     def cell(point, mode, spec, expected, smoke_cell=False,
-             pre_run=False, note=""):
+             pre_run=False, note="", bit_exact=False,
+             expect_drops=False):
         return {"point": point, "mode": mode, "spec": spec,
                 "expected": expected, "smoke": smoke_cell,
-                "pre_run": pre_run, "note": note}
+                "pre_run": pre_run, "note": note,
+                "bit_exact": bit_exact, "expect_drops": expect_drops}
 
     cells = [
         # --- I/O layer: retry → quarantine → coverage budget ----------
@@ -248,6 +257,21 @@ def build_cells(smoke: bool) -> list[CellDef]:
              smoke_cell=True),
         cell("obs.flush", "enospc", "obs.flush=enospc:99", "ok"),
         cell("obs.flush", "flaky", "obs.flush=flaky:999:0.5", "ok"),
+        # --- live telemetry plane: a dead/flaky/laggy consumer leaves
+        # --- training exit-0 and BIT-EXACT, with only telemetry_dropped
+        # --- as evidence anything was ever wrong ----------------------
+        cell("obs.export", "io_error", "obs.export=io_error:99", "ok",
+             smoke_cell=True, bit_exact=True, expect_drops=True,
+             note="telemetry I/O hard down: batches dropped+counted, "
+                  "training result bit-exact"),
+        cell("obs.export", "slow", "obs.export=slow:20:0.05", "ok",
+             bit_exact=True,
+             note="laggy consumer path: writer thread absorbs the "
+                  "latency, hot loop never blocks"),
+        cell("obs.export", "flaky", "obs.export=flaky:999:0.5", "ok",
+             bit_exact=True,
+             note="seeded flaky telemetry I/O: retried or dropped, "
+                  "never fatal"),
     ]
     if smoke:
         cells = [c for c in cells if c["smoke"]]
@@ -276,6 +300,28 @@ def _final_objective(out_dir: str):
         record = json.load(fh)
     states = record["grid"][0]["states"]
     return record, (states[-1]["objective"] if states else None)
+
+
+def _telemetry_dropped_total(trace_dir: str):
+    """Sum of the telemetry_dropped counter's label sets in the run's
+    final metrics snapshot (None when the stream is missing)."""
+    path = os.path.join(trace_dir, "metrics.jsonl")
+    if not os.path.exists(path):
+        return None
+    total = 0.0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "counter" \
+                    and rec.get("name") == "telemetry_dropped":
+                total += rec.get("value", 0.0)
+    return total
 
 
 def _check_no_traceback(proc, failures):
@@ -417,6 +463,23 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
         else:
             outcome = {0: "ok", CLEAN_ABORT_EXIT: "clean_abort"}.get(
                 rc, f"rc={rc}")
+        if rc == 0 and c.get("bit_exact"):
+            # the telemetry-plane contract: a broken consumer changes
+            # NOTHING about the training result, float-for-float
+            _, obj = _final_objective(out)
+            if obj != reference_objective:
+                failures.append(
+                    f"result NOT bit-exact under {name}: final "
+                    f"objective {obj!r} vs reference "
+                    f"{reference_objective!r}")
+        if rc == 0 and c.get("expect_drops"):
+            drops = _telemetry_dropped_total(tracked)
+            if not drops:
+                failures.append(
+                    "expected telemetry_dropped > 0 in the final "
+                    f"metrics snapshot, found {drops!r}")
+            else:
+                outcome += f"+dropped({int(drops)})"
 
     # universal invariants for every cell
     _check_checkpoint_restorable(ckpt, failures)
@@ -550,6 +613,9 @@ def run_campaign(workdir: str, smoke: bool,
             "bit-exact resume after every kill cell",
             "trace/metrics streams parse line-complete after any cell",
             "corrupt shards quarantine with recorded coverage",
+            "a dead/flaky/laggy telemetry consumer leaves training "
+            "exit-0 and bit-exact, with only telemetry_dropped as "
+            "evidence (obs.export cells)",
         ],
         "cells": results,
     }

@@ -1,0 +1,441 @@
+#!/usr/bin/env python
+"""photon-top: live run status from the telemetry plane.
+
+Attaches to a (possibly still-training) GAME run two ways:
+
+- ``--run-dir DIR`` — tail the run's ``--trace-dir``: heartbeat records
+  stream into ``metrics[.i].jsonl`` and spans spill into
+  ``spans[.i].jsonl`` while the run trains, so the status needs no
+  socket at all;
+- ``--listen HOST:PORT`` (or ``unix:/path.sock``) — BE the
+  ``--telemetry-endpoint`` consumer: bind, let the run's processes
+  connect, and read their NDJSON record streams directly.
+
+Reports, per process and in aggregate: sweep / last-coordinate
+progress, coordinate updates done, ``host_syncs_per_update`` (the
+hot-loop discipline number), in-flight pipeline depth, retry /
+quarantine / telemetry-drop counters, and heartbeat stall state.
+
+``--json`` prints one machine-readable status document; ``--watch``
+re-renders the human view until the run ends. Exit codes (scripting
+contract):
+
+- ``0`` — healthy: running or finished clean
+- ``2`` — stalled: a process's latest heartbeat is flagged ``stalled``
+- ``3`` — aborted: a ``run_end`` record with status abort/error
+- ``4`` — no telemetry found (wrong dir, nothing connected in time)
+
+Usage::
+
+    python tools/photon_status.py --run-dir out/trace --json
+    python tools/photon_status.py --listen 127.0.0.1:9200 \
+        --for-seconds 30   # then start the run with
+                           # --telemetry-endpoint 127.0.0.1:9200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import threading
+import time
+
+_METRICS_RE = re.compile(r"^metrics(?:\.(\d+))?\.jsonl$")
+_TELEMETRY_RE = re.compile(r"^telemetry(?:\.(\d+))?\.jsonl$")
+_SPANS_RE = re.compile(r"^spans(?:\.(\d+))?\.jsonl$")
+
+EXIT_HEALTHY, EXIT_STALLED, EXIT_ABORTED, EXIT_NO_DATA = 0, 2, 3, 4
+
+
+# ---------------------------------------------------------------------------
+# Record collection
+# ---------------------------------------------------------------------------
+
+
+class RunDirTailer:
+    """Incremental run-dir reader: heartbeat / counter / run_end lines
+    from ``metrics[.i].jsonl`` (and the ``telemetry[.i].jsonl`` fallback
+    stream), span records from the live ``spans[.i].jsonl`` spill.
+
+    Each :meth:`poll` reads only the bytes appended since the previous
+    one (per-file offsets, advanced past COMPLETE lines only — a torn
+    live tail is re-read whole once finished), so ``--watch`` over a
+    long run costs O(new data) per tick, not a full re-parse of every
+    stream."""
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self._offsets: dict[str, int] = {}
+        self._records: list[dict] = []
+
+    def _tail_file(self, path: str, default_kind: str | None,
+                   process_index: int, skip_kinds: tuple = ()) -> None:
+        offset = self._offsets.get(path, 0)
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                chunk = fh.read()
+        except OSError:
+            return
+        if not chunk:
+            return
+        complete, sep, _tail = chunk.rpartition(b"\n")
+        if not sep:
+            return  # no finished line yet; keep the offset
+        self._offsets[path] = offset + len(complete) + 1
+        for line in complete.split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a torn line from a killed incarnation
+            if not isinstance(rec, dict):
+                continue
+            if "kind" not in rec:
+                if default_kind is None:
+                    continue
+                rec["kind"] = default_kind
+            if rec["kind"] in skip_kinds:
+                continue
+            rec.setdefault("process_index", process_index)
+            self._records.append(rec)
+
+    def poll(self) -> list[dict]:
+        """All records seen so far (previous polls' plus any new)."""
+        try:
+            names = sorted(os.listdir(self.run_dir))
+        except OSError:
+            return list(self._records)
+        for name in names:
+            if name.endswith(".prev"):
+                continue
+            path = os.path.join(self.run_dir, name)
+            m = _METRICS_RE.match(name)
+            if m:
+                self._tail_file(path, None, int(m.group(1) or 0))
+                continue
+            m = _TELEMETRY_RE.match(name)
+            if m:
+                # the fallback stream duplicates what the run ALSO
+                # writes to spans.jsonl (every span is spilled to the
+                # file regardless of the sink) — skip its span records
+                # so updates/sweep counts stay exactly-once
+                self._tail_file(path, None, int(m.group(1) or 0),
+                                skip_kinds=("span",))
+                continue
+            m = _SPANS_RE.match(name)
+            if m:
+                self._tail_file(path, "span", int(m.group(1) or 0))
+        return list(self._records)
+
+
+def read_run_dir(run_dir: str) -> list[dict]:
+    """One-shot view of a run dir's records (the --run-dir snapshot
+    path; --watch holds a RunDirTailer and polls it instead)."""
+    return RunDirTailer(run_dir).poll()
+
+
+class ListenCollector:
+    """The ``--telemetry-endpoint`` consumer side: accept connections,
+    parse NDJSON lines, accumulate records (thread-safe)."""
+
+    def __init__(self, listen: str):
+        self._lock = threading.Lock()
+        self._records: list[dict] = []
+        self.ended = threading.Event()
+        if listen.startswith("unix:"):
+            path = listen[len("unix:"):]
+            if os.path.exists(path):
+                os.unlink(path)
+            self._server = socket.socket(socket.AF_UNIX,
+                                         socket.SOCK_STREAM)
+            self._server.bind(path)
+        else:
+            host, _, port = listen.rpartition(":")
+            self._server = socket.socket(socket.AF_INET,
+                                         socket.SOCK_STREAM)
+            self._server.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEADDR, 1)
+            self._server.bind((host or "127.0.0.1", int(port)))
+        self._server.listen(8)
+        self._server.settimeout(0.2)
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._read_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _read_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        conn.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            *lines, buf = buf.split(b"\n")
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    with self._lock:
+                        self._records.append(rec)
+                    if rec.get("kind") == "run_end":
+                        self.ended.set()
+        conn.close()
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Status computation
+# ---------------------------------------------------------------------------
+
+
+def _as_int_label(value) -> int | None:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def compute_status(records: list[dict]) -> dict:
+    """Fold a record stream into the run-status document. Pure function
+    of the records — the run-dir and socket paths share it."""
+    procs: dict[int, dict] = {}
+
+    def proc(i) -> dict:
+        return procs.setdefault(int(i or 0), {
+            "updates": 0, "sweep": None, "last_coordinate": None,
+            "heartbeat": None, "run_end": None, "manifest": False,
+            "totals": {}, "spans_seen": 0,
+        })
+
+    for rec in records:
+        kind = rec.get("kind")
+        p = proc(rec.get("process_index", 0))
+        if kind == "run_manifest":
+            p["manifest"] = True
+        elif kind == "span":
+            p["spans_seen"] += 1
+            labels = rec.get("labels") or {}
+            if rec.get("name") == "cd.update":
+                p["updates"] += 1
+                if labels.get("coordinate") is not None:
+                    p["last_coordinate"] = labels["coordinate"]
+            if rec.get("name") in ("cd.update", "cd.sweep", "cd.block"):
+                sweep = _as_int_label(labels.get("sweep"))
+                if sweep is not None and (p["sweep"] is None
+                                          or sweep > p["sweep"]):
+                    p["sweep"] = sweep
+        elif kind == "heartbeat":
+            p["heartbeat"] = rec
+            p["totals"].update(rec.get("metric_totals") or {})
+        elif kind in ("counter", "gauge"):
+            # the exit snapshot: one line per label set — sum by name
+            # (it lands after the last heartbeat, so it wins)
+            name = rec.get("name")
+            if name:
+                snap = p.setdefault("_snap", {})
+                snap[name] = snap.get(name, 0.0) \
+                    + (rec.get("value", 0.0) or 0.0)
+        elif kind == "run_end":
+            p["run_end"] = rec
+            p["totals"].update(rec.get("metric_totals") or {})
+
+    out_procs = {}
+    agg = {"updates": 0, "max_sweep": None}
+    worst = "no_data"
+    rank = {"no_data": 0, "finished": 1, "running": 2, "stalled": 3,
+            "aborted": 4}
+    for i, p in sorted(procs.items()):
+        totals = dict(p["totals"])
+        totals.update(p.pop("_snap", {}))
+        hb = p["heartbeat"]
+        end = p["run_end"]
+        if end is not None:
+            state = ("finished" if end.get("status") == "ok"
+                     else "aborted")
+        elif hb is not None and hb.get("stalled"):
+            state = "stalled"
+        elif hb is not None or p["spans_seen"] or p["manifest"]:
+            state = "running"
+        else:
+            state = "no_data"
+        updates = p["updates"]
+        fetches = totals.get("host_fetches")
+        out_procs[i] = {
+            "state": state,
+            "sweep": p["sweep"],
+            "last_coordinate": p["last_coordinate"],
+            "updates": updates,
+            "host_syncs_per_update": (
+                round(fetches / updates, 3)
+                if fetches is not None and updates else None),
+            "inflight_pipeline_depth": totals.get("cd_inflight_updates"),
+            "retries": totals.get("retries", 0),
+            "quarantined_coordinates": totals.get("quarantines", 0),
+            "quarantined_shards": totals.get("quarantined_shards", 0),
+            "telemetry_dropped": totals.get("telemetry_dropped", 0),
+            "stalls": totals.get("stalls", 0),
+            "data_coverage": totals.get("data_coverage"),
+            "stalled": bool(hb and hb.get("stalled")),
+            "last_heartbeat_uptime_s": (hb or {}).get("uptime_s"),
+            "spans_seen": p["spans_seen"],
+            "run_end": ({"status": end.get("status"),
+                         "reason": end.get("reason", "")}
+                        if end else None),
+        }
+        agg["updates"] += updates
+        if p["sweep"] is not None and (agg["max_sweep"] is None
+                                       or p["sweep"] > agg["max_sweep"]):
+            agg["max_sweep"] = p["sweep"]
+        if rank[state] > rank[worst]:
+            worst = state
+    exit_code = {
+        "no_data": EXIT_NO_DATA, "finished": EXIT_HEALTHY,
+        "running": EXIT_HEALTHY, "stalled": EXIT_STALLED,
+        "aborted": EXIT_ABORTED,
+    }[worst]
+    return {
+        "kind": "run_status",
+        "status": worst,
+        "exit_code": exit_code,
+        "sweep": agg["max_sweep"],
+        "updates": agg["updates"],
+        "processes": out_procs,
+    }
+
+
+def format_status(status: dict, source: str) -> str:
+    lines = [f"photon-top — {source}: {status['status'].upper()} "
+             f"(sweep {status['sweep']}, "
+             f"{status['updates']} update(s))"]
+    header = (f"{'proc':>4} {'state':<9} {'sweep':>5} "
+              f"{'coordinate':<14} {'updates':>7} {'syncs/upd':>9} "
+              f"{'inflight':>8} {'retries':>7} {'quar':>5} "
+              f"{'dropped':>7} {'stalled':>7}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for i, p in sorted(status["processes"].items()):
+        quar = (p["quarantined_coordinates"] or 0) \
+            + (p["quarantined_shards"] or 0)
+        lines.append(
+            f"{i:>4} {p['state']:<9} "
+            f"{p['sweep'] if p['sweep'] is not None else '—':>5} "
+            f"{str(p['last_coordinate'] or '—'):<14} "
+            f"{p['updates']:>7} "
+            f"{p['host_syncs_per_update'] if p['host_syncs_per_update'] is not None else '—':>9} "
+            f"{p['inflight_pipeline_depth'] if p['inflight_pipeline_depth'] is not None else '—':>8} "
+            f"{p['retries']:>7.0f} {quar:>5.0f} "
+            f"{p['telemetry_dropped']:>7.0f} "
+            f"{'YES' if p['stalled'] else 'no':>7}")
+        if p["run_end"] and p["run_end"]["status"] != "ok":
+            lines.append(f"     └ run_end: {p['run_end']['status']} "
+                         f"{p['run_end']['reason']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="live run status from the telemetry plane "
+                    "(exit 0 healthy / 2 stalled / 3 aborted / "
+                    "4 no telemetry)")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--run-dir",
+                     help="the run's --trace-dir: tail its metrics/"
+                          "spans/telemetry streams")
+    src.add_argument("--listen",
+                     help="bind HOST:PORT (or unix:/path.sock) and "
+                          "consume the run's --telemetry-endpoint "
+                          "stream directly")
+    p.add_argument("--for-seconds", type=float, default=10.0,
+                   help="listen mode: collect records this long (or "
+                        "until a run_end arrives) before reporting")
+    p.add_argument("--watch", action="store_true",
+                   help="re-render every 2 s until the run ends")
+    p.add_argument("--json", action="store_true",
+                   help="print the status document as JSON")
+    ns = p.parse_args(argv)
+
+    if ns.run_dir:
+        source = f"run-dir {ns.run_dir}"
+        tailer = RunDirTailer(ns.run_dir)
+
+        def snapshot() -> dict:
+            return compute_status(tailer.poll())
+
+        ended = None
+    else:
+        source = f"listening on {ns.listen}"
+        collector = ListenCollector(ns.listen)
+
+        def snapshot() -> dict:
+            return compute_status(collector.records())
+
+        ended = collector.ended
+        if not ns.watch:
+            deadline = time.monotonic() + ns.for_seconds
+            while time.monotonic() < deadline \
+                    and not collector.ended.is_set():
+                time.sleep(0.1)
+
+    try:
+        while True:
+            status = snapshot()
+            if ns.watch and not ns.json:
+                print("\x1b[2J\x1b[H", end="")  # clear, home
+            print(json.dumps(status, indent=1) if ns.json
+                  else format_status(status, source))
+            if not ns.watch:
+                break
+            if status["status"] in ("finished", "aborted") or (
+                    ended is not None and ended.is_set()):
+                break
+            time.sleep(2.0)
+    finally:
+        if ns.listen:
+            collector.close()
+    return status["exit_code"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Merge a multi-host run dir's per-process traces into one timeline.
+
+Every process of a multi-host GAME run writes its own
+``trace.<process_index>.json`` / ``spans.<process_index>.jsonl`` into
+the shared ``--trace-dir`` — and nothing ever lines them up: each
+process's timestamps are relative to ITS tracer's monotonic epoch, so
+loading two files side by side shows two unrelated clocks. This tool
+merges them into one Perfetto-loadable Chrome-trace document with one
+track (``pid``) per process, clock-aligned on each process's
+``gang.form`` span — ``jax.distributed.initialize`` returns when the
+gang is formed, so the span's END is the closest thing the run has to a
+shared wall-clock instant on every host.
+
+Alignment ladder (recorded in ``otherData.alignment``):
+
+1. ``gang.form`` — every process has the anchor span: its end is mapped
+   to the same merged timestamp (the max across processes, so no span
+   moves left of zero relative to its own stream);
+2. ``start_unix`` — no anchor anywhere (e.g. single-host parts), but the
+   per-process ``trace.json`` carries ``otherData.start_unix_time``:
+   streams are offset by their wall-clock starts (~ms accuracy);
+3. ``none`` — raw concatenation with a warning (still loadable; the
+   tracks just don't share a clock).
+
+Usage::
+
+    python tools/trace_merge.py out/trace [--out merged_trace.json]
+                                [--anchor gang.form] [--from-spans]
+
+Exit codes: 0 = merged document written, 2 = no per-process traces
+found / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_TRACE_RE = re.compile(r"^trace(?:\.(\d+))?\.json$")
+_SPANS_RE = re.compile(r"^spans(?:\.(\d+))?\.jsonl$")
+
+DEFAULT_ANCHOR = "gang.form"
+
+
+def _load_trace_json(path: str) -> tuple[list[dict], dict]:
+    """(complete "X" events, otherData) from one per-process trace."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError(f"{path}: not a Chrome trace document")
+    events = [e for e in doc["traceEvents"]
+              if isinstance(e, dict) and e.get("ph") == "X"
+              and "ts" in e and "name" in e]
+    return events, doc.get("otherData") or {}
+
+
+def _load_spans_jsonl(path: str, process_index: int) -> list[dict]:
+    """spans.jsonl records → Chrome "X" events (the live-run path: the
+    run may still be training, trace.json not rebuilt yet)."""
+    events = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a live/killed run
+            if not isinstance(e, dict) or "name" not in e \
+                    or "ts_us" not in e:
+                continue
+            events.append({"name": e["name"], "cat": "photon", "ph": "X",
+                           "ts": e["ts_us"], "dur": e.get("dur_us", 0.0),
+                           "pid": process_index, "tid": e.get("tid", 0),
+                           "args": e.get("labels") or {}})
+    return events
+
+
+def discover_processes(run_dir: str, from_spans: bool = False
+                       ) -> dict[int, dict]:
+    """``{process_index: {"events": [...], "other": {...}, "source":
+    path}}`` for every per-process stream in the run dir. Prefers the
+    rebuilt ``trace[.i].json`` (it carries ``start_unix_time`` for the
+    fallback alignment); ``--from-spans`` (or a missing trace.json — a
+    run still in flight) reads the live ``spans[.i].jsonl`` spill."""
+    procs: dict[int, dict] = {}
+    names = sorted(os.listdir(run_dir))
+    for name in names:
+        if name.endswith(".prev"):
+            continue  # a relaunched worker's rotated prior incarnation
+        m = _TRACE_RE.match(name)
+        if m and not from_spans:
+            idx = int(m.group(1) or 0)
+            events, other = _load_trace_json(os.path.join(run_dir, name))
+            procs[idx] = {"events": events, "other": other,
+                          "source": name}
+    for name in names:
+        if name.endswith(".prev"):
+            continue
+        m = _SPANS_RE.match(name)
+        if not m:
+            continue
+        idx = int(m.group(1) or 0)
+        if idx in procs and procs[idx]["events"]:
+            continue  # trace.json already covered this process
+        events = _load_spans_jsonl(os.path.join(run_dir, name), idx)
+        if events:
+            procs[idx] = {"events": events, "other": {}, "source": name}
+    return procs
+
+
+def _anchor_us(events: list[dict], anchor: str) -> float | None:
+    """END of the process's FIRST anchor span (the gang-formation
+    barrier: every process leaves ``jax.distributed.initialize`` at the
+    same instant, so span end — not start — is the shared point)."""
+    best = None
+    for e in events:
+        if e["name"] == anchor:
+            if best is None or e["ts"] < best["ts"]:
+                best = e
+    if best is None:
+        return None
+    return float(best["ts"]) + float(best.get("dur", 0.0))
+
+
+def merge(procs: dict[int, dict], anchor: str = DEFAULT_ANCHOR,
+          warn=None) -> dict:
+    """One Chrome-trace document: per-process events on their own
+    ``pid`` track, timestamps shifted onto a shared clock."""
+    anchors = {i: _anchor_us(p["events"], anchor)
+               for i, p in procs.items()}
+    if all(a is not None for a in anchors.values()) and anchors:
+        # align every anchor end to the LATEST one: the barrier releases
+        # all processes together, and shifting right keeps every
+        # process's own stream non-negative
+        target = max(anchors.values())
+        shifts = {i: target - a for i, a in anchors.items()}
+        alignment = anchor
+    elif all("start_unix_time" in p["other"] for p in procs.values()):
+        t0 = min(p["other"]["start_unix_time"] for p in procs.values())
+        shifts = {i: (p["other"]["start_unix_time"] - t0) * 1e6
+                  for i, p in procs.items()}
+        alignment = "start_unix"
+    else:
+        missing = sorted(i for i, a in anchors.items() if a is None)
+        if warn is not None:
+            warn(f"no {anchor!r} span in process(es) {missing} and no "
+                 f"start_unix_time fallback — tracks are NOT aligned")
+        shifts = {i: 0.0 for i in procs}
+        alignment = "none"
+
+    out: list[dict] = []
+    for i in sorted(procs):
+        # one named, ordered track per process in the Perfetto UI
+        out.append({"ph": "M", "name": "process_name", "pid": i,
+                    "args": {"name": f"process {i} "
+                                     f"({procs[i]['source']})"}})
+        out.append({"ph": "M", "name": "process_sort_index", "pid": i,
+                    "args": {"sort_index": i}})
+        for e in procs[i]["events"]:
+            out.append({**e, "pid": i,
+                        "ts": float(e["ts"]) + shifts[i]})
+    xs = [e for e in out if e["ph"] == "X"]
+    xs.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": [e for e in out if e["ph"] == "M"] + xs,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_processes": sorted(procs),
+            "alignment": alignment,
+            "anchor_span": anchor,
+            "shifts_us": {str(i): shifts[i] for i in sorted(shifts)},
+        },
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge per-process traces from a multi-host "
+                    "--trace-dir into one Perfetto-loadable timeline "
+                    "(one track per process, clock-aligned on gang.form)")
+    p.add_argument("run_dir", help="the run's --trace-dir")
+    p.add_argument("--out", default=None,
+                   help="output path (default: "
+                        "<run_dir>/merged_trace.json)")
+    p.add_argument("--anchor", default=DEFAULT_ANCHOR,
+                   help="span name whose END is the shared clock anchor "
+                        f"(default: {DEFAULT_ANCHOR})")
+    p.add_argument("--from-spans", action="store_true",
+                   help="read the live spans[.i].jsonl spill instead of "
+                        "the rebuilt trace[.i].json (a run still in "
+                        "flight)")
+    ns = p.parse_args(argv)
+    try:
+        procs = discover_processes(ns.run_dir, from_spans=ns.from_spans)
+    except (OSError, ValueError) as e:
+        print(f"trace_merge: cannot read {ns.run_dir}: {e}",
+              file=sys.stderr)
+        return 2
+    procs = {i: p_ for i, p_ in procs.items() if p_["events"]}
+    if not procs:
+        print(f"trace_merge: no per-process trace/spans streams with "
+              f"events under {ns.run_dir}", file=sys.stderr)
+        return 2
+    doc = merge(procs, anchor=ns.anchor,
+                warn=lambda m: print(f"trace_merge: {m}",
+                                     file=sys.stderr))
+    out_path = ns.out or os.path.join(ns.run_dir, "merged_trace.json")
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh)
+    n_events = sum(len(p_["events"]) for p_ in procs.values())
+    print(f"trace_merge: {len(procs)} process track(s), {n_events} "
+          f"span(s), alignment={doc['otherData']['alignment']} -> "
+          f"{out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Summarize a Chrome trace produced by ``--trace-dir``.
 
-Reads a ``trace.json`` (or ``trace.<process_index>.json``) written by
+Reads a ``trace.json`` (or ``trace.<process_index>.json``, or a
+``tools/trace_merge.py`` merged multi-process document) written by
 ``photon_ml_tpu/obs`` and prints:
 
 1. the top-N span names by SELF time (total minus time spent in child
@@ -11,11 +12,16 @@ Reads a ``trace.json`` (or ``trace.<process_index>.json``) written by
    ``cd.update`` spans cost per sweep — the "which coordinate ate the
    sweep" question the observability layer exists to answer.
 
+``--process N`` restricts a merged multi-process document to one track;
+``--json`` emits the same stats machine-readably (the format
+``tools/trace_diff.py`` composes with).
+
 Exit codes: 0 = report printed, 2 = unreadable/empty/invalid trace.
 
 Usage::
 
     python tools/trace_report.py out/trace/trace.json [--top 15]
+                                 [--process 0] [--json]
 """
 
 from __future__ import annotations
@@ -127,6 +133,28 @@ def format_report(events: list[dict], top: int) -> str:
     return "\n".join(lines)
 
 
+def json_report(events: list[dict], top: int) -> dict:
+    """The machine-readable twin of :func:`format_report` — per-name
+    self-time stats plus sweep attribution, the document
+    ``tools/trace_diff.py`` and scripted perf checks consume."""
+    stats = self_times(events)
+    ranked = sorted(stats.items(), key=lambda kv: -kv[1]["self_us"])
+    return {
+        "kind": "trace_report",
+        "processes": sorted({e.get("pid", 0) for e in events}),
+        "span_count": len(events),
+        "spans": {name: {"count": s["count"],
+                         "total_us": s["total_us"],
+                         "self_us": s["self_us"]}
+                  for name, s in ranked[:top]},
+        "sweep_attribution": [
+            {"sweep": sweep, "coordinate": coord, "us": us}
+            for (sweep, coord), us in sorted(
+                sweep_attribution(events).items(),
+                key=lambda kv: (str(kv[0][0]), str(kv[0][1])))],
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="top spans by self-time + per-coordinate sweep "
@@ -134,6 +162,11 @@ def main(argv=None) -> int:
     p.add_argument("trace", help="path to trace.json")
     p.add_argument("--top", type=int, default=15,
                    help="span names to show (by self time)")
+    p.add_argument("--process", type=int, default=None,
+                   help="restrict a merged multi-process document to "
+                        "this process's track (pid)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the stats as JSON instead of the table")
     ns = p.parse_args(argv)
     try:
         events = load_events(ns.trace)
@@ -141,11 +174,19 @@ def main(argv=None) -> int:
         print(f"trace_report: cannot read {ns.trace}: {e}",
               file=sys.stderr)
         return 2
+    if ns.process is not None:
+        events = [e for e in events
+                  if int(e.get("pid", 0)) == ns.process]
     if not events:
-        print(f"trace_report: {ns.trace} holds no complete span events",
-              file=sys.stderr)
+        where = (f" for process {ns.process}"
+                 if ns.process is not None else "")
+        print(f"trace_report: {ns.trace} holds no complete span "
+              f"events{where}", file=sys.stderr)
         return 2
-    print(format_report(events, ns.top))
+    if ns.json:
+        print(json.dumps(json_report(events, ns.top), indent=1))
+    else:
+        print(format_report(events, ns.top))
     return 0
 
 
