@@ -710,6 +710,84 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     train_secs_warm_block2 = time.perf_counter() - t0
     _progress(f"glmix train warm block-2 {train_secs_warm_block2:.1f}s")
 
+    # Preemption-drill probe: deliver a REAL SIGTERM right before the
+    # warm pass's second commit barrier, let the graceful-stop path
+    # resolve the in-flight handle + snapshot + raise, then resume from
+    # that snapshot to completion. Dead time = (interrupted + resumed)
+    # wall clock minus one uninterrupted warm pass — the per-preemption
+    # cost a scheduler actually pays (snapshot write, restore, replayed
+    # dispatch warmup). The resumed objective must equal the warm run's
+    # bit for bit, or the probe is measuring a different trajectory.
+    import shutil as _shutil
+    import signal as _signal
+    import tempfile as _tempfile
+
+    from photon_ml_tpu.utils.checkpoint import (
+        CheckpointManager as _CkptMgr,
+    )
+    from photon_ml_tpu.utils.preempt import (
+        PreemptionRequested,
+        StopController,
+    )
+
+    class _SignalAtBarrier:
+        """SIGTERM the process at the Nth barrier poll, then delegate
+        to the real controller — the probe walks the actual
+        signal → latch → barrier path, in process."""
+
+        def __init__(self, controller, at_poll):
+            self._controller = controller
+            self._at_poll = at_poll
+            self._polls = 0
+
+        def should_stop(self):
+            self._polls += 1
+            if self._polls == self._at_poll:
+                os.kill(os.getpid(), _signal.SIGTERM)
+            return self._controller.should_stop()
+
+    preempt_ckpt = _tempfile.mkdtemp(prefix="bench_preempt_ckpt_")
+    controller = StopController()
+    controller.install_signal_handlers(signums=(_signal.SIGTERM,))
+    mgr = _CkptMgr(preempt_ckpt)
+    preempt_step = None
+    t0 = time.perf_counter()
+    try:
+        run_coordinate_descent(
+            coords, num_iterations=2,
+            task=TaskType.LOGISTIC_REGRESSION, labels=labels_j,
+            weights=weights_j, offsets=offsets_j,
+            checkpoint_manager=mgr,
+            stop=_SignalAtBarrier(controller, at_poll=2))
+    except PreemptionRequested as e:
+        preempt_step = e.step
+    finally:
+        controller.uninstall_signal_handlers()
+    preempt_interrupted_secs = time.perf_counter() - t0
+    assert preempt_step is not None, (
+        "preemption probe never preempted: the SIGTERM-at-barrier "
+        "path regressed")
+    t0 = time.perf_counter()
+    resumed = run_coordinate_descent(
+        coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
+        labels=labels_j, weights=weights_j, offsets=offsets_j,
+        resume_snapshot=mgr.restore())
+    preempt_resumed_secs = time.perf_counter() - t0
+    _shutil.rmtree(preempt_ckpt, ignore_errors=True)
+    assert (resumed.states[-1].objective
+            == result_warm.states[-1].objective), (
+        "preempt+resume objective diverged from the warm pass: "
+        f"{resumed.states[-1].objective!r} vs "
+        f"{result_warm.states[-1].objective!r}")
+    preempt_resume_dead_secs = (preempt_interrupted_secs
+                                + preempt_resumed_secs
+                                - train_secs_warm)
+    _progress(f"glmix preempt@{preempt_step} drill: interrupted "
+              f"{preempt_interrupted_secs:.1f}s + resumed "
+              f"{preempt_resumed_secs:.1f}s vs warm "
+              f"{train_secs_warm:.1f}s -> dead "
+              f"{preempt_resume_dead_secs:+.1f}s (bit-exact)")
+
     # Steady-state per-stage attribution of one RE update (everything is
     # already compiled at these shapes): offset gather (sample->entity
     # resharding), vmapped solve, score scatter (entity->sample), plus the
@@ -825,6 +903,14 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
         # config: what block-parallel sweeps buy when the RE solve is
         # the long pole
         "cd_block_ladder_secs": ladder,
+        # the SIGTERM-at-barrier drill: wall clock a preemption + resume
+        # costs over one uninterrupted warm pass (snapshot write,
+        # restore, replayed dispatch warmup), with the resumed
+        # trajectory asserted bit-exact
+        "preempt_step": preempt_step,
+        "preempt_interrupted_secs": round(preempt_interrupted_secs, 2),
+        "preempt_resumed_secs": round(preempt_resumed_secs, 2),
+        "preempt_resume_dead_secs": round(preempt_resume_dead_secs, 2),
         # per-site breakdown of the warm run's instrumented fetches
         # (labeled host_fetches counter; values sum to the legacy total)
         "host_fetch_sites": host_fetch_sites,

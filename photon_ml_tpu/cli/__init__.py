@@ -1,15 +1,21 @@
 """CLI drivers + their shared exit discipline.
 
 Documented exit semantics (the chaos campaign asserts these — a driver
-process must END one of exactly three ways, never a stack-trace crash):
+process must END one of exactly four ways, never a stack-trace crash):
 
 - ``0``  — success, possibly DEGRADED (quarantined shards/coordinates
   are reported in the logs and metrics, coverage recorded);
 - ``3``  — CLEAN ABORT on a recognized terminal condition (shard loss
   over ``--max-shard-loss-frac``, an all-corrupt checkpoint directory, a
   required I/O that stayed down through its retries, an unrecovered
-  injected fault): one ``PHOTON_ABORT kind=<Type>: <message>`` line on
-  stderr, no traceback;
+  injected fault, an operator-forced KeyboardInterrupt): one
+  ``PHOTON_ABORT kind=<Type>: <message>`` line on stderr, no traceback;
+- ``75`` — PREEMPTED (sysexits.h ``EX_TEMPFAIL``: temporary failure,
+  requeue): a stop source (SIGTERM/SIGINT, ``--max-train-seconds``,
+  ``--stop-file``) fired and the run stopped at a commit barrier with a
+  final snapshot written; one ``PHOTON_PREEMPTED step=<sweep>.<coord>``
+  line on stderr, no traceback. A relaunch with the same args resumes
+  bit-exact — supervisors treat 75 as "restart me";
 - an injected ``kill``'s exit code — the process was scripted dead; the
   checkpoint directory stays restorable and a relaunch resumes.
 
@@ -22,6 +28,9 @@ from __future__ import annotations
 import sys
 
 CLEAN_ABORT_EXIT = 3
+# sysexits.h EX_TEMPFAIL: the conventional "requeue me" code — distinct
+# from every shell/signal code (126-128+n) and from the chaos kill codes
+PREEMPTED_EXIT = 75
 
 
 def clean_abort_types() -> tuple:
@@ -73,3 +82,21 @@ def clean_abort(e: BaseException, log=None) -> SystemExit:
     print(f"PHOTON_ABORT kind={type(e).__name__}: {e}",
           file=sys.stderr, flush=True)
     return SystemExit(CLEAN_ABORT_EXIT)
+
+
+def preempted_exit(e, log=None) -> SystemExit:
+    """Build the preempted exit for a graceful stop: one
+    machine-greppable ``PHOTON_PREEMPTED step=<sweep>.<coord>`` line on
+    stderr, exit code :data:`PREEMPTED_EXIT`, no traceback. ``e`` is the
+    :class:`~photon_ml_tpu.utils.preempt.PreemptionRequested` the
+    training loop raised at its commit barrier. Usage mirrors
+    :func:`clean_abort`::
+
+        except PreemptionRequested as e:
+            raise preempted_exit(e, log=driver.logger.warn) from None
+    """
+    if log is not None:
+        log(f"preempted ({e.reason}) at step {e.step}")
+    print(f"PHOTON_PREEMPTED step={e.step} reason={e.reason}",
+          file=sys.stderr, flush=True)
+    return SystemExit(PREEMPTED_EXIT)

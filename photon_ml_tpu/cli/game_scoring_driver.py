@@ -269,6 +269,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             obs_run.set_exit_status("abort",
                                     reason=f"{type(e).__name__}: {e}")
         raise clean_abort(e, log=driver.logger.error) from None
+    except KeyboardInterrupt:
+        # an operator interrupt gets the same discipline as the
+        # documented terminal set: run_end emitted, telemetry drained,
+        # one PHOTON_ABORT line, exit 3, no traceback
+        if obs_run is not None:
+            obs_run.set_exit_status("abort", reason="KeyboardInterrupt")
+        raise clean_abort(KeyboardInterrupt("interrupted by operator"),
+                          log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"GAME scoring failed: {e}")
         if obs_run is not None:

@@ -299,6 +299,22 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "QUARANTINED (frozen at last-good state, descent "
                         "continues without it) instead of burning the "
                         "global budget; 0 disables")
+    # Cooperative preemption (utils/preempt.py): SIGTERM/SIGINT, a
+    # wall-clock budget, and an external stop file all request the same
+    # graceful stop — the CD loop finishes its current block, snapshots
+    # at the commit barrier, and exits PREEMPTED_EXIT (75) for a
+    # supervisor to relaunch with resume.
+    p.add_argument("--max-train-seconds", type=float, default=0.0,
+                   help="wall-clock budget measured from driver startup "
+                        "(ingest + compile included, like a scheduler "
+                        "quota); past it the run stops at the next "
+                        "commit barrier, snapshots, and exits 75 "
+                        "(preempted) for a clean requeue; 0 disables")
+    p.add_argument("--stop-file", default=None,
+                   help="cooperative external stop: when this path "
+                        "exists the run stops at the next commit "
+                        "barrier exactly like a SIGTERM (polled at "
+                        "most every 0.25s)")
     # Worker supervision (multi-host only): relaunch this host's crashed
     # worker process with bounded exponential backoff + jitter.
     p.add_argument("--max-worker-restarts", type=int, default=0,
@@ -687,7 +703,8 @@ class GameTrainingDriver:
                     events=events,
                     block_size=max(1, int(self.ns.cd_block_size)),
                     pipeline_depth=(1 if self.ns.cd_pipeline_depth is None
-                                    else int(self.ns.cd_pipeline_depth)))
+                                    else int(self.ns.cd_pipeline_depth)),
+                    stop=getattr(self, "stop", None))
             if result.quarantined:
                 self.logger.warn(
                     f"{desc}: quarantined coordinates (frozen at "
@@ -893,8 +910,13 @@ def _run_multihost(ns: argparse.Namespace) -> None:
     processes hold identical maps — the reference does the same with its
     standalone FeatureIndexingJob for large feature spaces.
     """
+    from photon_ml_tpu.cli import clean_abort, preempted_exit
     from photon_ml_tpu.parallel.multihost import run_game_worker
     from photon_ml_tpu.utils.date_range import resolve_input_paths
+    from photon_ml_tpu.utils.preempt import (
+        PreemptionRequested,
+        StopController,
+    )
 
     # config was validated by _check_multihost_args in main() — the single
     # validation site, BEFORE any supervisor starts
@@ -902,6 +924,13 @@ def _run_multihost(ns: argparse.Namespace) -> None:
     driver = GameTrainingDriver(ns, logger=PhotonLogger(
         os.path.join(ns.output_dir,
                      f"game-training.p{ns.process_id}.log"), echo=False))
+    # graceful stop, gang-consistent: any member's local flag (signal,
+    # deadline, stop file) is allgathered at the worker's gang-
+    # synchronous safe points, so ALL members stop at the same
+    # coordinate and the collective snapshot stays coherent
+    stop = StopController(max_train_seconds=ns.max_train_seconds,
+                          stop_file=ns.stop_file)
+    stop.install_signal_handlers()
     # per-process observability: each gang member writes its own
     # trace.<process_index>.json / metrics.<process_index>.jsonl; a
     # supervisor-relaunched worker preserves the crashed incarnation's
@@ -979,7 +1008,8 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             # memmap files (the worker appends one subdir per coordinate)
             blocks_dir=(os.path.join(ns.random_effect_blocks_dir,
                                      f"p{ns.process_id}")
-                        if ns.random_effect_blocks_dir else None))
+                        if ns.random_effect_blocks_dir else None),
+            stop=stop)
 
         # one npz per process: fixed coefficients + per-coordinate tables
         arrays = {
@@ -1004,6 +1034,19 @@ def _run_multihost(ns: argparse.Namespace) -> None:
               f"re_coordinates={','.join(sorted(result['random_effect']))} "
               f"rows={result['rows_global']} "
               f"objective={result['objective']:.6f}", flush=True)
+    except PreemptionRequested as e:
+        # gang-consensus stop: every member raises at the same safe
+        # point after the collective snapshot; each exits 75 so the
+        # per-host supervisors requeue the whole gang
+        if obs_run is not None:
+            obs_run.set_exit_status("preempted",
+                                    reason=f"{e.reason} step={e.step}")
+        raise preempted_exit(e, log=driver.logger.warn) from None
+    except KeyboardInterrupt:
+        if obs_run is not None:
+            obs_run.set_exit_status("abort", reason="KeyboardInterrupt")
+        raise clean_abort(KeyboardInterrupt("interrupted by operator"),
+                          log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"multi-host GAME training failed: {e}")
         if obs_run is not None:
@@ -1078,10 +1121,30 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             return _run_supervised(ns, argv)
         return _run_multihost(ns)
     driver = GameTrainingDriver(ns)
-    from photon_ml_tpu.cli import clean_abort, clean_abort_types
+    from photon_ml_tpu.cli import (
+        clean_abort,
+        clean_abort_types,
+        preempted_exit,
+    )
     from photon_ml_tpu.obs.run import start_observed_run_from_flags
+    from photon_ml_tpu.utils.preempt import (
+        PreemptionRequested,
+        StopController,
+    )
 
-    obs_run = start_observed_run_from_flags(ns, warn=driver.logger.warn)
+    # graceful stop: SIGTERM/SIGINT latch the flag (a second delivery
+    # forces), --max-train-seconds starts counting NOW (ingest + compile
+    # are inside the budget), --stop-file is polled at commit barriers
+    stop = StopController(max_train_seconds=ns.max_train_seconds,
+                          stop_file=ns.stop_file)
+    stop.install_signal_handlers()
+    driver.stop = stop
+    # under a supervisor (tools/photon_supervise.py or the multi-host
+    # re-exec), a relaunched incarnation rotates the previous one's
+    # telemetry to .prev instead of truncating the evidence
+    obs_run = start_observed_run_from_flags(
+        ns, warn=driver.logger.warn,
+        preserve_existing=bool(os.environ.get(_SUPERVISED_ENV)))
     try:
         driver.run()
     except clean_abort_types() as e:
@@ -1093,6 +1156,22 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             obs_run.set_exit_status("abort",
                                     reason=f"{type(e).__name__}: {e}")
         raise clean_abort(e, log=driver.logger.error) from None
+    except PreemptionRequested as e:
+        # graceful stop honored at a commit barrier: the final snapshot
+        # is already on disk; drain telemetry with status "preempted"
+        # and exit 75 so a supervisor requeues us
+        if obs_run is not None:
+            obs_run.set_exit_status("preempted",
+                                    reason=f"{e.reason} step={e.step}")
+        raise preempted_exit(e, log=driver.logger.warn) from None
+    except KeyboardInterrupt:
+        # a forced interrupt (second Ctrl-C, or one delivered outside
+        # the graceful-stop window) still ends with the clean-abort
+        # discipline: run_end emitted, telemetry drained, no traceback
+        if obs_run is not None:
+            obs_run.set_exit_status("abort", reason="KeyboardInterrupt")
+        raise clean_abort(KeyboardInterrupt("interrupted by operator"),
+                          log=driver.logger.error) from None
     except Exception as e:
         driver.logger.error(f"GAME training failed: {e}")
         if obs_run is not None:

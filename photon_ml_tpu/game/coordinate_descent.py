@@ -76,6 +76,7 @@ from photon_ml_tpu.utils.events import (
     RecoveryEvent,
 )
 from photon_ml_tpu.utils.faults import InjectedFault, fault_point
+from photon_ml_tpu.utils.preempt import PreemptionRequested
 from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
 
 Array = jnp.ndarray
@@ -410,6 +411,7 @@ def run_coordinate_descent(
     resume_snapshot: Optional[dict] = None,
     block_size: int = 1,
     pipeline_depth: int = 1,
+    stop=None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent over ``coordinates`` in dict order.
 
@@ -463,6 +465,18 @@ def run_coordinate_descent(
     canonically (ids order, from zero) after every update rather than
     maintained incrementally, so a resumed run sees float-identical
     partial scores to the uninterrupted one.
+
+    Graceful stop: ``stop`` is any object with a ``should_stop() ->
+    str | None`` method (a :class:`~photon_ml_tpu.utils.preempt.
+    StopController` in the drivers). It is polled ONLY at raw block
+    boundaries — the existing commit/snapshot barriers — so a stop can
+    never tear a block or race the pipeline. When it returns a reason,
+    the in-flight pipelined handle is resolved first (the same settle-
+    before-snapshot rule the checkpoint barrier follows), a final
+    snapshot lands at the barrier (when checkpointing is on), and
+    :class:`~photon_ml_tpu.utils.preempt.PreemptionRequested` is raised
+    carrying the exact resume position. Resuming from that snapshot is
+    bit-exact vs the uninterrupted run, exactly like crash resume.
     """
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -1092,6 +1106,22 @@ def run_coordinate_descent(
 
             pending: Optional[_InFlight] = None
             for raw_block in blocks:
+                if stop is not None:
+                    reason = stop.should_stop()
+                    if reason is not None:
+                        # Commit barrier: settle the in-flight pipelined
+                        # handle first (the snapshot must read committed
+                        # state, same rule as the checkpoint barrier),
+                        # write the final "about to run this block"
+                        # snapshot, and hand the exact resume position
+                        # to the driver. Never tears a block.
+                        if pending is not None:
+                            resolve_update(pending)
+                            pending = None
+                        if checkpoint_manager is not None:
+                            save_snapshot(it, raw_block[0][0])
+                        raise PreemptionRequested(reason, it,
+                                                  raw_block[0][0])
                 block = [(ci, cid) for ci, cid in raw_block
                          if cid not in quarantined]
                 if not block:

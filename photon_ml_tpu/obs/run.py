@@ -242,9 +242,10 @@ class ObservedRun:
 
     def set_exit_status(self, status: str, reason: str = "") -> None:
         """Record how the run is ending ("ok" default, "abort" on a
-        clean abort, "error" otherwise) — written as the ``run_end``
-        record at :meth:`finish` so ``tools/photon_status.py`` can tell
-        a finished run from an aborted one."""
+        clean abort, "preempted" on a graceful stop honored at a commit
+        barrier, "error" otherwise) — written as the ``run_end`` record
+        at :meth:`finish` so ``tools/photon_status.py`` can tell a
+        finished run from an aborted or requeue-pending one."""
         self._exit_status = status
         self._exit_reason = reason
 

@@ -37,7 +37,14 @@ def _distributed_initialize(coordinator: str, num_processes: int,
     The timeout kwargs moved/appeared across jax releases
     (``heartbeat_timeout_seconds`` does not exist in older ones); filter
     by the live signature so a worker fails on REAL cluster problems, not
-    on a TypeError before it ever joins."""
+    on a TypeError before it ever joins.
+
+    On releases whose public API has no heartbeat knob at all, fall back
+    to the coordination-service parameters on the internal state
+    initializer (detection latency ≈ interval × max_missing): otherwise
+    ``heartbeat_timeout`` is silently dropped and a dead gang member
+    takes the library default (~100 s) to surface on the survivors —
+    the supervisor's relaunch loop would sit idle that whole time."""
     import inspect
 
     import jax
@@ -47,13 +54,42 @@ def _distributed_initialize(coordinator: str, num_processes: int,
                   initialization_timeout=initialization_timeout,
                   heartbeat_timeout_seconds=heartbeat_timeout)
     params = inspect.signature(jax.distributed.initialize).parameters
+
+    def _connect():
+        jax.distributed.initialize(
+            **{k: v for k, v in kwargs.items() if k in params})
+
+    if "heartbeat_timeout_seconds" not in params:
+        try:
+            from jax._src import distributed as _dist
+            from jax._src import xla_bridge as _bridge
+
+            sparams = inspect.signature(
+                _dist.global_state.initialize).parameters
+            if ("service_heartbeat_interval_seconds" in sparams
+                    and "client_heartbeat_interval_seconds" in sparams
+                    and not _bridge.backends_are_initialized()):
+                interval = max(1, int(heartbeat_timeout) // 10)
+                missing = max(2, int(heartbeat_timeout) // interval)
+
+                def _connect():
+                    _dist.global_state.initialize(
+                        coordinator_address=coordinator,
+                        num_processes=num_processes,
+                        process_id=process_id,
+                        initialization_timeout=initialization_timeout,
+                        service_heartbeat_interval_seconds=interval,
+                        service_max_missing_heartbeats=missing,
+                        client_heartbeat_interval_seconds=interval,
+                        client_max_missing_heartbeats=missing)
+        except Exception:
+            pass
     # gang formation AND re-formation trace here: a supervisor-relaunched
     # worker re-enters this span on its way back into the gang, so the
     # trace shows how long each (re-)join blocked on the coordinator
     with trace.span("gang.form", process=process_id,
                     num_processes=num_processes):
-        jax.distributed.initialize(
-            **{k: v for k, v in kwargs.items() if k in params})
+        _connect()
 
 
 def _synthetic(rows: int, dim: int, seed: int):
@@ -244,6 +280,7 @@ def run_game_worker(
     blocks_dir=None,
     checkpoint_dir=None,
     checkpoint_every_coordinates: int = 0,
+    stop=None,
 ) -> dict:
     """One multi-host GAME training process: fixed + random effects CD.
 
@@ -285,6 +322,14 @@ def run_game_worker(
     supervisor restart resumes training mid-run instead of restarting
     from scratch. Only process 0 ever touches the directory; the other
     hosts need no shared filesystem.
+
+    ``stop`` (any object with ``should_stop() -> str | None``) makes the
+    gang preemptable: each member polls its LOCAL flag at the gang-
+    synchronous safe points (after each committed coordinate update) and
+    the flags are allgathered, so one member's SIGTERM/deadline/stop-file
+    stops EVERY member at the same coordinate — the collective snapshot
+    fires once, then all members raise
+    :class:`~photon_ml_tpu.utils.preempt.PreemptionRequested`.
     """
     import os
 
@@ -316,7 +361,8 @@ def run_game_worker(
             process_id, num_processes, train_paths,
             feature_shard_sections, index_maps, fixed_coordinate,
             random_coordinates, task, num_iterations, num_buckets,
-            blocks_dir, checkpoint_dir, checkpoint_every_coordinates)
+            blocks_dir, checkpoint_dir, checkpoint_every_coordinates,
+            stop=stop)
     finally:
         jax.distributed.shutdown()
 
@@ -325,7 +371,7 @@ def _game_worker_body(
         process_id, num_processes, train_paths, feature_shard_sections,
         index_maps, fixed_coordinate, random_coordinates, task,
         num_iterations, num_buckets, blocks_dir=None, checkpoint_dir=None,
-        checkpoint_every_coordinates=0):
+        checkpoint_every_coordinates=0, stop=None):
     """Post-initialize body of :func:`run_game_worker` (imports deferred
     until the distributed backend is live)."""
     import os
@@ -676,6 +722,29 @@ def _game_worker_body(
                 % checkpoint_every_coordinates == 0):
             save_snapshot(sweep, next_ci)
 
+    def check_gang_stop(sweep, next_ci):
+        # Gang-consensus preemption at a safe point (a committed update,
+        # the same places the snapshot cadence fires): every member
+        # allgathers its LOCAL stop flag, so one member's SIGTERM/
+        # deadline/stop-file stops the WHOLE gang at the same
+        # coordinate. The consensus snapshot is a collective (all
+        # members reshard; process 0 writes) and dedups against the
+        # cadence save that may have just fired at this step.
+        if stop is None:
+            return
+        from photon_ml_tpu.utils.preempt import PreemptionRequested
+
+        local = stop.should_stop()
+        flags = allgather_ragged(
+            np.asarray([1 if local is not None else 0], np.int32))
+        if not any(int(f[0]) for f in flags):
+            return
+        save_snapshot(sweep, next_ci)
+        if next_ci >= update_seq:
+            sweep, next_ci = sweep + 1, 0
+        raise PreemptionRequested(local or "gang:peer_stop",
+                                  sweep, next_ci)
+
     # ---- coordinate descent: fixed ⇄ random effects ----------------------
     # Offsets for each coordinate = base + Σ other coordinates' scores
     # (CoordinateDescent.scala:143-151's partial-score subtraction).
@@ -696,6 +765,7 @@ def _game_worker_body(
             scores_fixed = gather_global(fixed_margins(X_g,
                                                        jnp.asarray(w_fixed)))
             maybe_save(it, 1)
+            check_gang_stop(it, 1)
 
         # random-effect updates in sequence: entity-sharded distributed
         # solves (state stays a global sharded array between iterations)
@@ -723,6 +793,7 @@ def _game_worker_body(
                         np.float32)
                 regs[cid] = c["prob"].regularization_value(states[cid])
             maybe_save(it, ci + 1)
+            check_gang_stop(it, ci + 1)
 
         total = scores_fixed + sum(scores_re.values()) + off_g
         li = loss.loss(jnp.asarray(total), jnp.asarray(resp_g))

@@ -26,6 +26,12 @@ Modes:
 - ``partial``  — truncate the file/dir passed to the point to half its
                  size (a torn write)
 - ``kill``     — ``os._exit(arg)`` (default 17)
+- ``signal``   — ``os.kill(os.getpid(), SIGTERM)``: the preemption
+                 drill. Unlike ``kill`` the process is NOT scripted
+                 dead — the driver's graceful-stop handler latches its
+                 stop flag, ``fault_point`` returns, and training runs
+                 on to the next commit barrier where it snapshots and
+                 exits with the documented preempted code
 - ``io_error`` — raise ``OSError(EIO)`` (retryable I/O failure)
 - ``enospc``   — raise ``OSError(ENOSPC)`` (disk full)
 - ``flaky``    — probabilistic ``OSError(EIO)``: each VISIT to the point
@@ -68,7 +74,7 @@ ENV_STATE_DIR = "PHOTON_FAULTS_STATE_DIR"
 ENV_SEED = "PHOTON_FAULTS_SEED"
 
 MODES = ("raise", "nan", "delay", "slow", "corrupt", "partial", "kill",
-         "io_error", "enospc", "flaky")
+         "signal", "io_error", "enospc", "flaky")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,11 +96,11 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
     "cd.update": FaultPointInfo(
         "after each coordinate-descent coordinate update "
         "(game/coordinate_descent.py); tag <sweep>.<coordinate_index>",
-        modes=("raise", "nan", "delay", "kill")),
+        modes=("raise", "nan", "delay", "kill", "signal")),
     "cd.sweep": FaultPointInfo(
         "at the top of each CD sweep (single-process and multi-host "
         "loops); tag = sweep index",
-        modes=("delay", "kill")),
+        modes=("delay", "kill", "signal")),
     "optimizer.gradient": FaultPointInfo(
         "on the solver output of a GLM solve (optimize/problem.py)",
         modes=("raise", "nan")),
@@ -109,7 +115,8 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
     "ckpt.write_bytes": FaultPointInfo(
         "after the snapshot's array payload is written, before it is "
         "checksummed (utils/checkpoint.py)",
-        modes=("io_error", "enospc", "flaky", "partial", "kill"),
+        modes=("io_error", "enospc", "flaky", "partial", "kill",
+               "signal"),
         has_path=True),
     "io.shard_open": FaultPointInfo(
         "before an Avro shard's bytes are opened/read (io/avro.py "
@@ -322,6 +329,15 @@ class FaultRegistry:
                 time.sleep(spec.delay_seconds)
             elif spec.mode == "kill":
                 os._exit(spec.exit_code)
+            elif spec.mode == "signal":
+                # the preemption drill: deliver a real SIGTERM to
+                # ourselves. With a graceful-stop handler installed this
+                # latches the stop flag and RETURNS — training continues
+                # to its next commit barrier; without one, Python's
+                # default disposition terminates the process.
+                import signal as _signal
+
+                os.kill(os.getpid(), _signal.SIGTERM)
             elif spec.mode == "nan":
                 arrays = poison_arrays(arrays)
             elif spec.mode in ("corrupt", "partial"):

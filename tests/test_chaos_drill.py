@@ -42,6 +42,10 @@ def test_chaos_smoke_campaign(tmp_path):
     assert cells["io.avro_read=corrupt"]["outcome"].startswith("degraded")
     assert cells["scenario.corrupt_shard"]["passed"]  # ISSUE acceptance
     assert cells["cd.update=kill"]["outcome"] == "killed+resumed"
+    # graceful-stop cell: SIGTERM mid-update must exit 75 with a
+    # PHOTON_PREEMPTED line and resume bit-exact from its safe point
+    assert cells["cd.update=signal@per_update"]["outcome"] == \
+        "preempted+resumed"
     assert cells["io.index_map=io_error"]["outcome"] == "clean_abort"
     assert cells["obs.flush=io_error"]["outcome"] == "ok"
     # live-plane cell: telemetry I/O hard down leaves training exit-0

@@ -213,8 +213,8 @@ class TestLegacyDriver:
     def test_diagnostics_produced(self, tmp_path):
         train = str(tmp_path / "train.avro")
         validate = str(tmp_path / "validate.avro")
-        _make_binary_avro(train, n=900, d=3, seed=3)
-        _make_binary_avro(validate, n=200, d=3, seed=4)
+        _make_binary_avro(train, n=400, d=3, seed=3)
+        _make_binary_avro(validate, n=150, d=3, seed=4)
         out = str(tmp_path / "out")
         legacy_main([
             "--training-data-directory", train,
@@ -222,7 +222,7 @@ class TestLegacyDriver:
             "--output-directory", out,
             "--task", "LOGISTIC_REGRESSION",
             "--regularization-weights", "1",
-            "--num-iterations", "15",
+            "--num-iterations", "8",
             "--diagnostic-mode", "ALL",
         ])
         html = open(os.path.join(out, "diagnostic-report.html")).read()

@@ -10,14 +10,15 @@ subprocess under each armed (point, mode) cell via ``PHOTON_FAULTS``,
 and asserts the invariant matrix:
 
 1. **Documented exit semantics** — the process ends rc 0 (possibly
-   degraded), rc 3 with a ``PHOTON_ABORT`` line (clean abort), or the
-   injected kill's exit code. NEVER a stack-trace crash.
+   degraded), rc 3 with a ``PHOTON_ABORT`` line (clean abort), rc 75
+   with a ``PHOTON_PREEMPTED`` line (graceful stop), or the injected
+   kill's exit code. NEVER a stack-trace crash.
 2. **Restorable checkpoint directory** — after every cell,
    ``CheckpointManager.restore()`` either returns a snapshot or raises
    one of its documented exceptions; stale ``.tmp`` litter is gone.
-3. **Bit-exact resume** — after every ``kill`` cell, a relaunch
-   completes and its final objective equals the fault-free reference
-   run's, float-for-float (the resume-anywhere contract).
+3. **Bit-exact resume** — after every ``kill`` or ``signal`` cell, a
+   relaunch completes and its final objective equals the fault-free
+   reference run's, float-for-float (the resume-anywhere contract).
 4. **Surviving observability** — ``metrics.jsonl`` / ``spans.jsonl``
    parse line-complete even after a mid-write kill, and
    ``run_manifest.json`` exists.
@@ -59,6 +60,7 @@ if _REPO not in sys.path:
 
 KILL_EXIT = 19
 CLEAN_ABORT_EXIT = 3
+PREEMPTED_EXIT = 75  # photon_ml_tpu.cli.PREEMPTED_EXIT (EX_TEMPFAIL)
 N_SHARDS = 4
 
 
@@ -175,19 +177,22 @@ def driver_args(data_dir: str, fs_dir: str, out_dir: str, ckpt_dir: str,
 # Cell matrix
 # ---------------------------------------------------------------------------
 
-#: expected ∈ {"ok", "degraded", "abort", "ok_or_abort", "killed"}.
+#: expected ∈ {"ok", "degraded", "abort", "ok_or_abort", "killed",
+#: "preempted"}.
 #: "degraded" = rc 0 AND metrics.json records data_coverage < 1.
+#: "preempted" = rc 75 + PHOTON_PREEMPTED line; resume is bit-exact.
 CellDef = dict
 
 
 def build_cells(smoke: bool) -> list[CellDef]:
     def cell(point, mode, spec, expected, smoke_cell=False,
              pre_run=False, note="", bit_exact=False,
-             expect_drops=False):
+             expect_drops=False, variant="", extra_args=None):
         return {"point": point, "mode": mode, "spec": spec,
                 "expected": expected, "smoke": smoke_cell,
                 "pre_run": pre_run, "note": note,
-                "bit_exact": bit_exact, "expect_drops": expect_drops}
+                "bit_exact": bit_exact, "expect_drops": expect_drops,
+                "variant": variant, "extra_args": extra_args or []}
 
     cells = [
         # --- I/O layer: retry → quarantine → coverage budget ----------
@@ -224,6 +229,10 @@ def build_cells(smoke: bool) -> list[CellDef]:
         cell("ckpt.write_bytes", "kill",
              f"ckpt.write_bytes=kill:1:{KILL_EXIT}", "killed",
              note="killed mid-write: stale .tmp cleaned on relaunch"),
+        cell("ckpt.write_bytes", "signal",
+             "ckpt.write_bytes=signal:1", "preempted",
+             note="SIGTERM lands DURING a checkpoint write: the write "
+                  "finishes, the run stops at the next barrier"),
         cell("ckpt.save", "raise", "ckpt.save=raise:1", "abort",
              note="post-write fault before rename fails the save "
                   "outright (documented drill semantics)"),
@@ -245,6 +254,15 @@ def build_cells(smoke: bool) -> list[CellDef]:
              "killed", smoke_cell=True,
              note="killed mid-sweep: resume is bit-exact"),
         cell("cd.update", "delay", "cd.update=delay:1:0.2", "ok"),
+        cell("cd.update", "signal", "cd.update@0.1=signal:1",
+             "preempted", smoke_cell=True, variant="per_update",
+             note="SIGTERM mid-update: latched, honored at the next "
+                  "block barrier, resume bit-exact"),
+        cell("cd.update", "signal", "cd.update@0.0=signal:1",
+             "preempted", variant="mid_block",
+             extra_args=["--cd-block-size", "2"],
+             note="SIGTERM inside a 2-wide block: the WHOLE block "
+                  "commits before the stop (barrier-only polling)"),
         cell("cd.sweep", "delay", "cd.sweep=delay:1:0.2", "ok"),
         cell("cd.sweep", "kill", f"cd.sweep@1=kill:1:{KILL_EXIT}",
              "killed"),
@@ -383,8 +401,11 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
     """One (point, mode) cell: arm via PHOTON_FAULTS, run the driver,
     assert the invariant matrix."""
     name = f"{c['point']}={c['mode']}"
+    if c.get("variant"):
+        name += f"@{c['variant']}"
     cell_dir = os.path.join(
-        workdir, "cells", name.replace("=", "_").replace(".", "_"))
+        workdir, "cells",
+        name.replace("=", "_").replace(".", "_").replace("@", "_"))
     shutil.rmtree(cell_dir, ignore_errors=True)
     os.makedirs(cell_dir)
     # every cell gets its OWN copy of the input: corrupt/partial modes
@@ -395,8 +416,24 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
     ckpt = os.path.join(cell_dir, "ckpt")
     tracked = os.path.join(cell_dir, "trace")
     args = driver_args(data_dir, fixture["fs_dir"], out, ckpt, tracked)
+    args += c.get("extra_args") or []
     failures: list[str] = []
     t0 = time.monotonic()
+
+    if c.get("extra_args"):
+        # extra flags (e.g. --cd-block-size) change the training math,
+        # so the shared fault-free reference no longer anchors the
+        # bit-exact check — this cell runs its own
+        ref_out = os.path.join(cell_dir, "ref_out")
+        ref = _run_driver(driver_args(
+            data_dir, fixture["fs_dir"], ref_out,
+            os.path.join(cell_dir, "ref_ckpt"),
+            os.path.join(cell_dir, "ref_trace")) + c["extra_args"])
+        if ref.returncode != 0:
+            failures.append(f"cell reference run failed "
+                            f"rc={ref.returncode}:\n{ref.stderr[-1000:]}")
+        else:
+            _, reference_objective = _final_objective(ref_out)
 
     if c["pre_run"]:  # seed checkpoints for restore-path cells
         pre = _run_driver(args)
@@ -435,6 +472,32 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
                         f"resume NOT bit-exact: final objective {obj!r} "
                         f"vs reference {reference_objective!r}")
         outcome = "killed+resumed"
+    elif expected == "preempted":
+        if rc != PREEMPTED_EXIT:
+            failures.append(f"expected graceful preemption "
+                            f"rc={PREEMPTED_EXIT}, got rc={rc}:\n"
+                            f"{proc.stderr[-1000:]}")
+        elif "PHOTON_PREEMPTED" not in proc.stderr:
+            failures.append(f"rc={PREEMPTED_EXIT} without a "
+                            f"PHOTON_PREEMPTED line:\n"
+                            f"{proc.stderr[-1000:]}")
+        else:
+            # same resume-anywhere contract as an injected kill, but
+            # from the SAFE-POINT snapshot the stop path took itself
+            resume = _run_driver(args)
+            _check_no_traceback(resume, failures)
+            if resume.returncode != 0:
+                failures.append(
+                    f"resume after preemption failed "
+                    f"rc={resume.returncode}:\n{resume.stderr[-1000:]}")
+            else:
+                _, obj = _final_objective(out)
+                if obj != reference_objective:
+                    failures.append(
+                        f"preempted resume NOT bit-exact: final "
+                        f"objective {obj!r} vs reference "
+                        f"{reference_objective!r}")
+        outcome = "preempted+resumed"
     elif expected == "abort":
         if rc != CLEAN_ABORT_EXIT or "PHOTON_ABORT" not in proc.stderr:
             failures.append(
@@ -607,10 +670,11 @@ def run_campaign(workdir: str, smoke: bool,
                           if r.get("outcome") != "skipped"]),
         "cells_failed": len(failed),
         "invariants": [
-            "documented exit semantics (0 / 3+PHOTON_ABORT / kill code; "
-            "never a stack-trace crash)",
+            "documented exit semantics (0 / 3+PHOTON_ABORT / "
+            "75+PHOTON_PREEMPTED / kill code; never a stack-trace "
+            "crash)",
             "checkpoint dir restorable after every cell (no stale .tmp)",
-            "bit-exact resume after every kill cell",
+            "bit-exact resume after every kill or signal cell",
             "trace/metrics streams parse line-complete after any cell",
             "corrupt shards quarantine with recorded coverage",
             "a dead/flaky/laggy telemetry consumer leaves training "

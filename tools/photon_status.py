@@ -24,6 +24,14 @@ contract):
 - ``2`` — stalled: a process's latest heartbeat is flagged ``stalled``
 - ``3`` — aborted: a ``run_end`` record with status abort/error
 - ``4`` — no telemetry found (wrong dir, nothing connected in time)
+- ``5`` — preempted: a ``run_end`` record with status ``preempted``
+  (graceful stop at a commit barrier; a relaunch resumes — this is
+  the "requeue me" state ``tools/photon_supervise.py`` reacts to)
+
+``--gang`` adds the gang-level aggregate over a merged multi-host run
+dir: min/max per-process sweep position and ``sweep_skew`` (max−min —
+0 for a healthy gang-synchronous run; a growing skew means a process
+is reading stale telemetry or a member died mid-sweep).
 
 Usage::
 
@@ -48,6 +56,7 @@ _TELEMETRY_RE = re.compile(r"^telemetry(?:\.(\d+))?\.jsonl$")
 _SPANS_RE = re.compile(r"^spans(?:\.(\d+))?\.jsonl$")
 
 EXIT_HEALTHY, EXIT_STALLED, EXIT_ABORTED, EXIT_NO_DATA = 0, 2, 3, 4
+EXIT_PREEMPTED = 5
 
 
 # ---------------------------------------------------------------------------
@@ -76,6 +85,12 @@ class RunDirTailer:
         offset = self._offsets.get(path, 0)
         try:
             with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() < offset:
+                    # the file SHRANK under us: a relaunched incarnation
+                    # truncated/rotated it — start over at 0 rather than
+                    # silently never reading the new stream
+                    offset = 0
                 fh.seek(offset)
                 chunk = fh.read()
         except OSError:
@@ -249,6 +264,13 @@ def compute_status(records: list[dict]) -> dict:
         p = proc(rec.get("process_index", 0))
         if kind == "run_manifest":
             p["manifest"] = True
+        elif kind == "run_restart":
+            # a supervisor relaunch appended to the same metrics stream:
+            # everything that follows belongs to a NEW incarnation, so
+            # the previous run_end / stalled-heartbeat verdicts no
+            # longer describe the live process
+            p["run_end"] = None
+            p["heartbeat"] = None
         elif kind == "span":
             p["spans_seen"] += 1
             labels = rec.get("labels") or {}
@@ -277,18 +299,22 @@ def compute_status(records: list[dict]) -> dict:
             p["totals"].update(rec.get("metric_totals") or {})
 
     out_procs = {}
-    agg = {"updates": 0, "max_sweep": None}
+    agg = {"updates": 0, "max_sweep": None, "min_sweep": None}
     worst = "no_data"
-    rank = {"no_data": 0, "finished": 1, "running": 2, "stalled": 3,
-            "aborted": 4}
+    # preempted ranks between running and stalled: it means "requeue
+    # me" (the run is healthy but needs a relaunch), not a failure —
+    # but any stalled/aborted member still dominates the verdict
+    rank = {"no_data": 0, "finished": 1, "running": 2, "preempted": 3,
+            "stalled": 4, "aborted": 5}
     for i, p in sorted(procs.items()):
         totals = dict(p["totals"])
         totals.update(p.pop("_snap", {}))
         hb = p["heartbeat"]
         end = p["run_end"]
         if end is not None:
-            state = ("finished" if end.get("status") == "ok"
-                     else "aborted")
+            state = {"ok": "finished",
+                     "preempted": "preempted"}.get(end.get("status"),
+                                                   "aborted")
         elif hb is not None and hb.get("stalled"):
             state = "stalled"
         elif hb is not None or p["spans_seen"] or p["manifest"]:
@@ -320,15 +346,19 @@ def compute_status(records: list[dict]) -> dict:
                         if end else None),
         }
         agg["updates"] += updates
-        if p["sweep"] is not None and (agg["max_sweep"] is None
-                                       or p["sweep"] > agg["max_sweep"]):
-            agg["max_sweep"] = p["sweep"]
+        if p["sweep"] is not None:
+            if (agg["max_sweep"] is None
+                    or p["sweep"] > agg["max_sweep"]):
+                agg["max_sweep"] = p["sweep"]
+            if (agg["min_sweep"] is None
+                    or p["sweep"] < agg["min_sweep"]):
+                agg["min_sweep"] = p["sweep"]
         if rank[state] > rank[worst]:
             worst = state
     exit_code = {
         "no_data": EXIT_NO_DATA, "finished": EXIT_HEALTHY,
-        "running": EXIT_HEALTHY, "stalled": EXIT_STALLED,
-        "aborted": EXIT_ABORTED,
+        "running": EXIT_HEALTHY, "preempted": EXIT_PREEMPTED,
+        "stalled": EXIT_STALLED, "aborted": EXIT_ABORTED,
     }[worst]
     return {
         "kind": "run_status",
@@ -336,8 +366,41 @@ def compute_status(records: list[dict]) -> dict:
         "exit_code": exit_code,
         "sweep": agg["max_sweep"],
         "updates": agg["updates"],
+        # gang-level aggregate (--gang view; trivially degenerate for a
+        # single-process run): per-process sweep spread. sweep_skew is
+        # max−min — 0 when the gang is marching in lockstep
+        "gang": {
+            "processes": len(out_procs),
+            "min_sweep": agg["min_sweep"],
+            "max_sweep": agg["max_sweep"],
+            "sweep_skew": (agg["max_sweep"] - agg["min_sweep"]
+                           if agg["max_sweep"] is not None
+                           and agg["min_sweep"] is not None else None),
+        },
         "processes": out_procs,
     }
+
+
+def format_gang(status: dict, source: str) -> str:
+    """The --gang view: one aggregate line over the merged multi-host
+    run dir — where the slowest and fastest members are and how far
+    apart (sweep_skew; a gang-synchronous run holds it at 0)."""
+    g = status["gang"]
+    lines = [f"photon-top --gang — {source}: "
+             f"{status['status'].upper()}",
+             f"  processes : {g['processes']}",
+             f"  min sweep : "
+             f"{g['min_sweep'] if g['min_sweep'] is not None else '—'}",
+             f"  max sweep : "
+             f"{g['max_sweep'] if g['max_sweep'] is not None else '—'}",
+             f"  sweep_skew: "
+             f"{g['sweep_skew'] if g['sweep_skew'] is not None else '—'}"]
+    per = {i: (p["sweep"], p["state"])
+           for i, p in sorted(status["processes"].items())}
+    lines.append("  per-proc  : " + ", ".join(
+        f"p{i}={s if s is not None else '—'}({st})"
+        for i, (s, st) in per.items()))
+    return "\n".join(lines)
 
 
 def format_status(status: dict, source: str) -> str:
@@ -378,7 +441,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="live run status from the telemetry plane "
                     "(exit 0 healthy / 2 stalled / 3 aborted / "
-                    "4 no telemetry)")
+                    "4 no telemetry / 5 preempted)")
     src = p.add_mutually_exclusive_group(required=True)
     src.add_argument("--run-dir",
                      help="the run's --trace-dir: tail its metrics/"
@@ -394,6 +457,10 @@ def main(argv=None) -> int:
                    help="re-render every 2 s until the run ends")
     p.add_argument("--json", action="store_true",
                    help="print the status document as JSON")
+    p.add_argument("--gang", action="store_true",
+                   help="gang-level aggregate view: min/max per-process "
+                        "sweep and sweep_skew over a merged multi-host "
+                        "run dir")
     ns = p.parse_args(argv)
 
     if ns.run_dir:
@@ -424,10 +491,12 @@ def main(argv=None) -> int:
             if ns.watch and not ns.json:
                 print("\x1b[2J\x1b[H", end="")  # clear, home
             print(json.dumps(status, indent=1) if ns.json
-                  else format_status(status, source))
+                  else (format_gang(status, source) if ns.gang
+                        else format_status(status, source)))
             if not ns.watch:
                 break
-            if status["status"] in ("finished", "aborted") or (
+            if status["status"] in ("finished", "aborted",
+                                    "preempted") or (
                     ended is not None and ended.is_set()):
                 break
             time.sleep(2.0)
