@@ -455,11 +455,17 @@ def _instrumented_warm_pass(run_fn) -> dict:
     ``train_secs_export_live`` / ``trace_export_overhead_pct`` (same
     contract with a connected --telemetry-endpoint consumer)."""
     from photon_ml_tpu.game import coordinate_descent as cd_mod
+    from photon_ml_tpu.obs import compile as obs_compile
     from photon_ml_tpu.obs import trace as obs_trace
     from photon_ml_tpu.obs.metrics import REGISTRY as obs_registry
     from photon_ml_tpu.utils import sync_telemetry
 
     retraces_start = obs_registry.counter("retraces").total()
+    # device-plane contract (when the --device-telemetry compile layer is
+    # armed, as bench_glmix does for the whole bench): a WARM pass
+    # compiles nothing — any compiles-counter delta here is a retrace
+    compiles_start = (obs_registry.counter("compiles").total()
+                      if obs_compile.is_armed() else None)
     cd_mod.reset_hot_loop_stats()
     sync_telemetry.reset_host_fetches()
     t0 = time.perf_counter()
@@ -491,6 +497,14 @@ def _instrumented_warm_pass(run_fn) -> dict:
     host_fetch_sites = sync_telemetry.host_fetches_by_site()
     retraces = int(obs_registry.counter("retraces").total()
                    - retraces_start)
+    retrace_count_warm = None
+    if compiles_start is not None:
+        retrace_count_warm = int(obs_registry.counter("compiles").total()
+                                 - compiles_start)
+        assert retrace_count_warm == 0, (
+            f"warm pass recompiled {retrace_count_warm} instrumented jit "
+            f"site(s): the compile-layer signature cache regressed "
+            f"(see the xla.retrace records for which argument changed)")
 
     obs_trace.enable()
     t0 = time.perf_counter()
@@ -566,6 +580,7 @@ def _instrumented_warm_pass(run_fn) -> dict:
         "cd_overlap_fraction": cd_overlap_fraction,
         "host_fetch_sites": host_fetch_sites,
         "retraces": retraces,
+        "retrace_count_warm": retrace_count_warm,
         "train_secs_traced": train_secs_traced,
         "trace_overhead_pct": (100.0 * (train_secs_traced - train_secs_warm)
                                / train_secs_warm),
@@ -652,11 +667,22 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
     labels_j = jnp.asarray(data.responses, jnp.float32)
     weights_j = jnp.asarray(data.weights, jnp.float32)
     offsets_j = jnp.asarray(data.offsets, jnp.float32)
+    # arm the --device-telemetry compile layer for the whole glmix bench:
+    # the cold pass harvests its per-site lower().compile() bill
+    # (compile_secs_cold) and the warm probe asserts the zero-warm-retrace
+    # contract against the same compiles counter
+    from photon_ml_tpu.obs import compile as obs_compile
+    from photon_ml_tpu.obs.metrics import REGISTRY as obs_registry
+
+    obs_compile.arm()
+    compile_secs_start = obs_registry.counter("compile_secs").total()
     t0 = time.perf_counter()
     result = run_coordinate_descent(
         coords, num_iterations=2, task=TaskType.LOGISTIC_REGRESSION,
         labels=labels_j, weights=weights_j, offsets=offsets_j)
     train_secs = time.perf_counter() - t0
+    compile_secs_cold = float(obs_registry.counter("compile_secs").total()
+                              - compile_secs_start)
     sweep_secs = [round(h.seconds, 2) for h in result.states]
 
     # Compile vs steady-state attribution: re-run the identical training
@@ -868,6 +894,7 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
             weights=weights_j, offsets=offsets_j, block_size=bs)
         ladder[str(bs)] = round(time.perf_counter() - t0, 2)
     _progress(f"glmix straggler-config block-size ladder: {ladder}")
+    obs_compile.disarm()
 
     return {
         "n_samples": n, "n_users": len(data.id_vocabs["userId"]),
@@ -881,6 +908,12 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
         # correction epilogue per sweep instead of two)
         "train_secs_warm_block2": round(train_secs_warm_block2, 2),
         "compile_overhead_secs": round(train_secs - train_secs_warm, 2),
+        # the cold pass's device-plane compile bill (sum of the
+        # compile_secs{site} counter over the instrumented jit sites) and
+        # the warm pass's compiles-counter delta (asserted 0: a warm
+        # retrace is a compile-cache regression)
+        "compile_secs_cold": round(compile_secs_cold, 2),
+        "retrace_count_warm": probe["retrace_count_warm"],
         "per_update_secs": sweep_secs,
         "per_update_secs_warm": sweep_secs_warm,
         # one-round-trip contract telemetry (warm pass): blocking

@@ -98,6 +98,11 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "live to this consumer (host:port, "
                         "unix:/path.sock, or file:/path.jsonl) — same "
                         "contract as the training driver")
+    p.add_argument("--device-telemetry", action="store_true",
+                   help="with --trace-dir: arm the device plane "
+                        "(xla.compile spans, retrace-cause records, "
+                        "hbm_bytes gauges, peak_hbm_bytes on run_end) — "
+                        "same contract as the training driver")
     ns = p.parse_args(argv)
     from photon_ml_tpu.cli.game_training_driver import (
         _check_telemetry_flags,

@@ -372,6 +372,14 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "are dropped (counted on telemetry_dropped) — "
                         "the hot loop never blocks on telemetry. "
                         "tools/photon_status.py is the bundled consumer")
+    p.add_argument("--device-telemetry", action="store_true",
+                   help="with --trace-dir: arm the DEVICE plane — "
+                        "xla.compile spans with cost_analysis flops/"
+                        "bytes, retrace-cause records (which argument "
+                        "changed shape/dtype/static value), heartbeat-"
+                        "cadence hbm_bytes{device,kind} gauges, per-"
+                        "coordinate HBM watermarks at the sweep drain, "
+                        "and peak_hbm_bytes on the run_end record")
     ns = p.parse_args(argv)
     _check_telemetry_flags(p, ns)
     return ns
@@ -381,6 +389,9 @@ def _check_telemetry_flags(p: argparse.ArgumentParser,
                            ns: argparse.Namespace) -> None:
     """Fail flag misuse at parse time with argparse's one-line usage
     error (exit 2), not a ValueError traceback from the obs wiring."""
+    if getattr(ns, "device_telemetry", False) and not ns.trace_dir:
+        p.error("--device-telemetry requires --trace-dir (compile spans "
+                "and hbm gauges ride the run's span spill + heartbeat)")
     if not getattr(ns, "telemetry_endpoint", None):
         return
     if not ns.trace_dir:

@@ -67,7 +67,8 @@ from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optimize.config import TASK_LOSS_NAME, TaskType
-from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs import compile as obs_compile
+from photon_ml_tpu.obs import devicemem, trace
 from photon_ml_tpu.obs.metrics import REGISTRY
 from photon_ml_tpu.utils.events import (
     CoordinateQuarantinedEvent,
@@ -131,6 +132,9 @@ def _sample_live_bytes(sweep: int) -> None:
     REGISTRY.gauge("hbm_live_bytes").set(total_bytes, site="cd.sweep_drain")
     with trace.span("cd.hbm_sample", sweep=sweep, live_bytes=total_bytes):
         pass
+    # --device-telemetry: attribute the sweep's per-coordinate commit
+    # watermarks at the same boundary (no-op unless armed)
+    devicemem.drain_coordinate_watermarks(sweep)
 
 
 @dataclasses.dataclass
@@ -545,7 +549,10 @@ def run_coordinate_descent(
         everywhere (shared with the fused epilogue), so a resume that
         rebuilds the total from restored scores reproduces the
         uninterrupted run's floats exactly."""
-        return canonical_total_fn(tuple(score_map[c] for c in ids))
+        return obs_compile.call(
+            "cd.canonical_total", canonical_total_fn,
+            (tuple(score_map[c] for c in ids),),
+            arg_names=("score_list",))
 
     if restored_scores is not None:
         # Mid-sweep resume: scores come back verbatim from the snapshot
@@ -710,9 +717,13 @@ def run_coordinate_descent(
                 leaves = tuple(jnp.asarray(leaf) for _, cid in block
                                for leaf in _state_leaves(cands[cid]))
                 (new_total, objective_d, train_loss_d, _reg_total_d,
-                 finite_d, state_finite_d) = epilogue(
-                    score_list, reg_list, leaves, labels, weights,
-                    offsets)  # (:199-205)
+                 finite_d, state_finite_d) = obs_compile.call(
+                    "cd.epilogue", epilogue,
+                    (score_list, reg_list, leaves, labels, weights,
+                     offsets),
+                    arg_names=("score_list", "reg_list", "state_leaves",
+                               "labels", "weights",
+                               "offsets"))  # (:199-205)
         except Exception:
             if len(block) > 1:
                 for _, cid in block:
@@ -816,6 +827,10 @@ def run_coordinate_descent(
             states[cid] = p.cands[cid]
             scores[cid] = p.new_scores[cid]
             reg_cache[cid] = p.new_regs[cid]
+            # --device-telemetry: per-coordinate HBM watermark at the
+            # moment this coordinate's buffers land (no-op unless armed;
+            # metadata-only — never a device sync)
+            devicemem.note_coordinate(cid)
         # canonical (ids order from zero), computed INSIDE the fused
         # epilogue — never incrementally drifted: resume parity
         total = p.new_total

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import DenseBatch
 from photon_ml_tpu.game.dataset import RandomEffectDataset
+from photon_ml_tpu.obs import compile as obs_compile
 from photon_ml_tpu.obs import trace
 from photon_ml_tpu.obs.metrics import REGISTRY
 from photon_ml_tpu.ops.aggregators import GLMObjective
@@ -323,9 +324,17 @@ def _dispatch_fit(X, labels, offsets, weights, initial, obj, l1, solver,
     if key not in _SEEN_DISPATCH_KEYS:
         _SEEN_DISPATCH_KEYS.add(key)
         REGISTRY.counter("retraces").inc(site="re.dispatch")
-    return fn(X, labels, offsets, weights, initial, obj, l1, solver,
-              max_iter, tolerance, boundary_convergence, resume,
-              return_carry)
+    # statics by position in _fit_blocks_impl's signature (the _STATIC
+    # names): solver=7, max_iter=8, tolerance=9, boundary_convergence=10,
+    # return_carry=12 — obs.compile strips them for the AOT fastpath
+    return obs_compile.call(
+        "re.fit_blocks", fn,
+        (X, labels, offsets, weights, initial, obj, l1, solver,
+         max_iter, tolerance, boundary_convergence, resume, return_carry),
+        static_argnums=(7, 8, 9, 10, 12),
+        arg_names=("X", "labels", "offsets", "weights", "initial", "obj",
+                   "l1", "solver", "max_iter", "tolerance",
+                   "boundary_convergence", "resume", "return_carry"))
 
 
 def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,

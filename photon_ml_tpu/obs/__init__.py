@@ -20,10 +20,21 @@ log-only event bus):
   to a local socket (or file-tail) consumer while the run trains.
 - ``obs.run`` — the drivers' ``--trace-dir`` integration: run manifest,
   live heartbeat stream, final trace/metrics flush, and the
-  ``--telemetry-endpoint`` sink wiring.
+  ``--telemetry-endpoint`` / ``--device-telemetry`` wiring.
+- ``obs.compile`` — the device plane's compile/retrace attribution:
+  site-labeled AOT compiles (``xla.compile`` spans with
+  ``cost_analysis()`` flops/bytes) and retrace-cause records naming
+  the argument whose shape/dtype/static value changed.
+- ``obs.devicemem`` — HBM accounting: heartbeat-cadence
+  ``hbm_bytes{device, kind}`` gauges, per-coordinate watermarks at the
+  CD sweep drain, run-wide ``peak_hbm_bytes`` on the run_end record.
+- ``obs.otlp`` — the standard-protocol exit: NDJSON telemetry →
+  OTLP/HTTP JSON traces + metrics (``tools/otlp_bridge.py`` is the
+  CLI), versioned against ``telemetry_proto``.
 """
 
-from photon_ml_tpu.obs import trace  # noqa: F401
+from photon_ml_tpu.obs import compile  # noqa: F401,A004
+from photon_ml_tpu.obs import devicemem, trace  # noqa: F401
 from photon_ml_tpu.obs.bridge import MetricsEventListener  # noqa: F401
 from photon_ml_tpu.obs.export import (  # noqa: F401
     TELEMETRY_PROTO,

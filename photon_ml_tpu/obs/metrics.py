@@ -200,16 +200,27 @@ class MetricsRegistry:
             out.extend(m.records())
         return out
 
-    def totals(self) -> dict[str, float]:
-        """``{name: label-summed total}`` for counters and gauges — the
-        compact per-heartbeat snapshot the live telemetry stream (and
-        ``tools/photon_status.py``) rides on. Histograms are skipped:
-        their full records only ship in the exit snapshot."""
+    def totals(self) -> dict:
+        """``{name: label-summed total}`` for counters and gauges plus
+        ``{name: {"count", "sum"}}`` for histograms — the compact
+        per-heartbeat snapshot the live telemetry stream (and
+        ``tools/photon_status.py``) rides on. The histogram entry keeps
+        a distribution like ``re_chunk_active_lanes`` visible live
+        (count and running sum; full bucket records still only ship in
+        the exit snapshot). Scalar consumers key on scalar names, so
+        the dict-valued entries never collide with them."""
         with self._lock:
             metrics = list(self._metrics.values())
-        return {m.name: m.total() for m in sorted(metrics,
-                                                  key=lambda m: m.name)
-                if isinstance(m, Counter)}
+        out: dict = {}
+        for m in sorted(metrics, key=lambda m: m.name):
+            if isinstance(m, Counter):
+                out[m.name] = m.total()
+            elif isinstance(m, Histogram):
+                records = m.records()
+                out[m.name] = {
+                    "count": sum(r["count"] for r in records),
+                    "sum": sum(r["sum"] for r in records)}
+        return out
 
     def reset(self) -> None:
         """Zero every metric (bench/test isolation; registrations stay)."""

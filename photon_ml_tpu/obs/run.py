@@ -131,9 +131,29 @@ class ObservedRun:
                  warn: Optional[Callable[[str], None]] = None,
                  registry: Optional[MetricsRegistry] = None,
                  preserve_existing: bool = False,
-                 telemetry_endpoint: Optional[str] = None):
+                 telemetry_endpoint: Optional[str] = None,
+                 device_telemetry: bool = False):
         self.trace_dir = trace_dir
         self._registry = registry or REGISTRY
+        # --device-telemetry: arm the device plane (compile/retrace
+        # attribution + HBM accounting). Imported lazily — the armed
+        # modules touch jax only inside armed calls, so an un-flagged
+        # run (and a bare multi-host worker pre-gang) never pays for it.
+        self._device_telemetry = device_telemetry
+        self._devicemem = None
+        self._sample_on_beat = False
+        if device_telemetry:
+            from photon_ml_tpu.obs import compile as obs_compile
+            from photon_ml_tpu.obs import devicemem
+
+            obs_compile.arm(registry=self._registry)
+            devicemem.arm(registry=self._registry)
+            self._devicemem = devicemem
+            # a multi-host worker must not probe devices before the
+            # gang forms (the probe would initialize the local backend
+            # and break jax.distributed.initialize) — its heartbeats
+            # skip sampling; the finish() sample still stamps the peak
+            self._sample_on_beat = num_processes == 1
         self._process_index = process_index
         self._exit_status = "ok"
         self._exit_reason = ""
@@ -210,6 +230,15 @@ class ObservedRun:
         discarded once the write succeeds — a transient full disk keeps
         them pending (capped at the tracer's buffer bound) for the next
         beat instead of losing the interval."""
+        if self._sample_on_beat:
+            # heartbeat-cadence device-memory sample BEFORE the metric
+            # totals are read, so every heartbeat carries fresh
+            # hbm_bytes gauges (contained: sampling must never take the
+            # heartbeat down with it)
+            try:
+                self._devicemem.sample()
+            except Exception:
+                pass
         with self._spill_lock:
             drained = self.tracer.drain()
             if self.sink is not None:
@@ -271,6 +300,12 @@ class ObservedRun:
                                f"{e!r} — continuing")
         if self.sink is not None:
             self.sink.close()
+        if self._device_telemetry:
+            from photon_ml_tpu.obs import compile as obs_compile
+
+            obs_compile.disarm()
+            if self._devicemem is not None:
+                self._devicemem.disarm()
         if trace.get_tracer() is self.tracer:
             trace.disable()
 
@@ -323,6 +358,15 @@ class ObservedRun:
                   # and a short run's last heartbeat can predate the
                   # tail of the work (photon-top reads these)
                   "metric_totals": self._registry.totals()}
+        if self._devicemem is not None:
+            # one last sample (the gang — if any — is formed or gone by
+            # now), then the run-wide HBM peak on the terminal record:
+            # the capacity-planning number a finished run is asked for
+            try:
+                self._devicemem.sample()
+            except Exception:
+                pass
+            record["peak_hbm_bytes"] = self._devicemem.peak_bytes()
         self._export_record(record)
 
         def write():
@@ -346,6 +390,7 @@ def start_observed_run_from_flags(ns, process_index: int = 0,
     flags carry ``--trace-dir`` (returns the ObservedRun to finish(), or
     None) — the one adapter both GAME drivers share."""
     endpoint = getattr(ns, "telemetry_endpoint", None)
+    device_telemetry = bool(getattr(ns, "device_telemetry", False))
     if not getattr(ns, "trace_dir", None):
         if endpoint:
             # the sink rides the ObservedRun's tracer/heartbeat/spill
@@ -354,6 +399,12 @@ def start_observed_run_from_flags(ns, process_index: int = 0,
             raise ValueError(
                 "--telemetry-endpoint requires --trace-dir (the live "
                 "stream is fed by the run's span spill + heartbeat)")
+        if device_telemetry:
+            # same contract: the device plane's spans/gauges ride the
+            # trace dir's spill + heartbeat stream
+            raise ValueError(
+                "--device-telemetry requires --trace-dir (compile spans "
+                "and hbm gauges ride the run's span spill + heartbeat)")
         return None
     return start_observed_run(
         ns.trace_dir, process_index=process_index,
@@ -361,4 +412,5 @@ def start_observed_run_from_flags(ns, process_index: int = 0,
         heartbeat_seconds=ns.trace_heartbeat_seconds,
         stall_seconds=ns.trace_stall_seconds, warn=warn,
         preserve_existing=preserve_existing,
-        telemetry_endpoint=endpoint)
+        telemetry_endpoint=endpoint,
+        device_telemetry=device_telemetry)

@@ -294,6 +294,13 @@ def minimize_lbfgs(
     previous objective) — the lane-compaction driver's chunk restarts
     use this to stay bit-identical to a single dispatch.
     """
-    return _minimize_lbfgs_impl(value_and_grad_fn, x0, data, max_iter, m,
-                                tolerance, box, track_iterates,
-                                resume, return_carry)
+    from photon_ml_tpu.obs import compile as obs_compile
+
+    return obs_compile.call(
+        "optimizer.lbfgs", _minimize_lbfgs_impl,
+        (value_and_grad_fn, x0, data, max_iter, m, tolerance, box,
+         track_iterates, resume, return_carry),
+        static_argnums=(0, 3, 4, 5, 7, 9),
+        arg_names=("value_and_grad_fn", "x0", "data", "max_iter", "m",
+                   "tolerance", "box", "track_iterates", "resume",
+                   "return_carry"))

@@ -252,6 +252,13 @@ def minimize_owlqn(
     ``resume``/``return_carry`` continue a chunked solve bit-identically
     (see :func:`minimize_lbfgs` — the carry shape is shared).
     """
-    return _minimize_owlqn_impl(value_and_grad_fn, x0, data, max_iter, m,
-                                tolerance, l1, box, track_iterates,
-                                resume, return_carry)
+    from photon_ml_tpu.obs import compile as obs_compile
+
+    return obs_compile.call(
+        "optimizer.owlqn", _minimize_owlqn_impl,
+        (value_and_grad_fn, x0, data, max_iter, m, tolerance, l1, box,
+         track_iterates, resume, return_carry),
+        static_argnums=(0, 3, 4, 5, 8, 10),
+        arg_names=("value_and_grad_fn", "x0", "data", "max_iter", "m",
+                   "tolerance", "l1", "box", "track_iterates", "resume",
+                   "return_carry"))

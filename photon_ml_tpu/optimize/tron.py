@@ -317,6 +317,13 @@ def minimize_tron(
     ``resume``/``return_carry`` continue a chunked solve bit-identically
     (see :class:`TRONResume`).
     """
-    return _minimize_tron_impl(value_and_grad_fn, hvp_fn, x0, data, max_iter,
-                               tolerance, max_failures, box, track_iterates,
-                               resume, return_carry)
+    from photon_ml_tpu.obs import compile as obs_compile
+
+    return obs_compile.call(
+        "optimizer.tron", _minimize_tron_impl,
+        (value_and_grad_fn, hvp_fn, x0, data, max_iter, tolerance,
+         max_failures, box, track_iterates, resume, return_carry),
+        static_argnums=(0, 1, 4, 5, 6, 8, 10),
+        arg_names=("value_and_grad_fn", "hvp_fn", "x0", "data", "max_iter",
+                   "tolerance", "max_failures", "box", "track_iterates",
+                   "resume", "return_carry"))

@@ -144,6 +144,13 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
         "is dropped and counted on telemetry_dropped, never blocks "
         "training",
         modes=("io_error", "slow", "flaky")),
+    "obs.otlp": FaultPointInfo(
+        "in the OTLP bridge before each HTTP POST of a converted "
+        "trace/metric batch to the collector (obs/otlp.py, driven by "
+        "tools/otlp_bridge.py); a failed POST is dropped and counted "
+        "on telemetry_dropped{kind=otlp} — the bridge (and the run it "
+        "watches) always exits clean",
+        modes=("io_error", "slow", "flaky")),
     "worker.start": FaultPointInfo(
         "in a multi-host worker right after jax.distributed.initialize "
         "(parallel/multihost.py); tag = process id",

@@ -1,0 +1,348 @@
+"""OTLP bridge: golden-fixture conversion, proto versioning, contained
+collector failures — plus the machine-readable schema-stability pins
+(``trace_report --json``, ``MetricsRegistry.totals()``,
+``photon_status`` gang columns) the bridge's consumers depend on.
+
+The conversion is deterministic by construction (hash-derived ids,
+manifest-derived timestamps), so the golden in
+``tests/goldens/otlp_golden.json`` is an exact-equality check: any
+change to the emitted OTLP shape must bump
+``OTLP_CONVERSION_VERSION`` and regenerate the golden (see
+``_regen_golden`` below).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from photon_ml_tpu.obs.export import TELEMETRY_PROTO
+from photon_ml_tpu.obs.metrics import MetricsRegistry
+from photon_ml_tpu.obs.otlp import (
+    OTLP_CONVERSION_VERSION,
+    UnsupportedProtoError,
+    load_run_dir,
+    post_otlp,
+    records_to_otlp,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "goldens", "otlp_golden.json")
+
+#: Nothing listens on the discard port: every POST is connection-refused
+#: immediately — the canonical dead collector.
+DEAD_COLLECTOR = "http://127.0.0.1:9"
+
+
+def _fixture_records() -> list:
+    """A deterministic single-process run: manifest, nested spans on two
+    threads, a heartbeat superseded by a run_end (with a histogram total
+    and an HBM peak), and exit-snapshot metric lines of all three kinds."""
+    return [
+        {"kind": "run_manifest", "process_index": 0,
+         "time": "2026-01-02T03:04:05", "telemetry_proto": TELEMETRY_PROTO,
+         "git_describe": "v1.2-7-gabc1234", "jax_version": "0.4.37",
+         "backend": "cpu"},
+        # tid 1: cd.sweep contains cd.update and a zero-duration
+        # xla.compile marker
+        {"kind": "span", "process_index": 0, "name": "cd.sweep",
+         "tid": 1, "ts_us": 0.0, "dur_us": 1000.0, "labels": {"sweep": 0}},
+        {"kind": "span", "process_index": 0, "name": "cd.update",
+         "tid": 1, "ts_us": 100.0, "dur_us": 200.0,
+         "labels": {"sweep": 0, "coordinate": "fixed"}},
+        {"kind": "span", "process_index": 0, "name": "xla.compile",
+         "tid": 1, "ts_us": 400.0, "dur_us": 0.0,
+         "labels": {"site": "cd.epilogue", "secs": 0.25,
+                    "flops": 1234.0, "bytes_accessed": 5678.0}},
+        # tid 2: an unrelated root span — must NOT be parented under tid 1
+        {"kind": "span", "process_index": 0, "name": "ingest.read",
+         "tid": 2, "ts_us": 50.0, "dur_us": 100.0,
+         "labels": {"shard": "part-0"}},
+        {"kind": "heartbeat", "process_index": 0, "uptime_s": 1.0,
+         "metric_totals": {"host_fetches": 4}},
+        {"kind": "run_end", "process_index": 0, "status": "ok",
+         "metric_totals": {"host_fetches": 8,
+                           "re_chunk_active_lanes": {"count": 3,
+                                                     "sum": 12.0}},
+         "peak_hbm_bytes": 4096},
+        {"kind": "counter", "process_index": 0, "name": "compiles",
+         "labels": {"site": "cd.epilogue"}, "value": 2},
+        {"kind": "gauge", "process_index": 0, "name": "xla_flops",
+         "labels": {"site": "cd.epilogue"}, "value": 1234.0},
+        {"kind": "histogram", "process_index": 0, "name": "update_ms",
+         "labels": {"site": "cd.update"}, "count": 3, "sum": 6.0,
+         "min": 1.0, "max": 3.0, "buckets": {"le_2": 2, "le_inf": 3}},
+    ]
+
+
+def _write_run_dir(path: str, records=None) -> str:
+    """Materialize the fixture as an on-disk ``--trace-dir`` layout."""
+    os.makedirs(path, exist_ok=True)
+    records = _fixture_records() if records is None else records
+    spans, lines, manifest = [], [], None
+    for rec in records:
+        if rec["kind"] == "run_manifest":
+            manifest = rec
+        elif rec["kind"] == "span":
+            spans.append({k: v for k, v in rec.items()
+                          if k not in ("kind", "process_index")})
+        else:
+            lines.append({k: v for k, v in rec.items()
+                          if k != "process_index"})
+    with open(os.path.join(path, "run_manifest.json"), "w") as fh:
+        json.dump({k: v for k, v in manifest.items()
+                   if k != "process_index"}, fh)
+    with open(os.path.join(path, "spans.jsonl"), "w") as fh:
+        for rec in spans:
+            fh.write(json.dumps(rec) + "\n")
+    with open(os.path.join(path, "metrics.jsonl"), "w") as fh:
+        for rec in lines:
+            fh.write(json.dumps(rec) + "\n")
+    return path
+
+
+def _span_index(docs: dict) -> dict:
+    out = {}
+    for rs in docs["traces"]["resourceSpans"]:
+        for ss in rs["scopeSpans"]:
+            for span in ss["spans"]:
+                out[span["name"]] = span
+    return out
+
+
+def _metric_index(docs: dict) -> dict:
+    out = {}
+    for rm in docs["metrics"]["resourceMetrics"]:
+        for sm in rm["scopeMetrics"]:
+            for m in sm["metrics"]:
+                out.setdefault(m["name"], []).append(m)
+    return out
+
+
+def _regen_golden():  # pragma: no cover - maintenance helper
+    """Regenerate the golden after an INTENTIONAL shape change:
+    ``python -c "import test_otlp; test_otlp._regen_golden()"`` from
+    ``tests/`` (and bump OTLP_CONVERSION_VERSION)."""
+    os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+    with open(GOLDEN, "w") as fh:
+        json.dump(records_to_otlp(_fixture_records()), fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+class TestConversion:
+    def test_matches_golden_fixture(self):
+        with open(GOLDEN) as fh:
+            golden = json.load(fh)
+        assert records_to_otlp(_fixture_records()) == golden, (
+            "OTLP conversion drifted from tests/goldens/otlp_golden.json"
+            " — if the shape change is intentional, bump "
+            "OTLP_CONVERSION_VERSION and regenerate via "
+            "test_otlp._regen_golden()")
+
+    def test_conversion_is_deterministic(self):
+        a = records_to_otlp(_fixture_records())
+        b = records_to_otlp(_fixture_records())
+        assert a == b
+        assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                           sort_keys=True)
+
+    def test_scope_carries_both_protocol_versions(self):
+        docs = records_to_otlp(_fixture_records())
+        scope = docs["traces"]["resourceSpans"][0]["scopeSpans"][0]["scope"]
+        assert scope["version"] == \
+            f"{TELEMETRY_PROTO}.{OTLP_CONVERSION_VERSION}"
+
+    def test_parenting_reconstructed_from_containment(self):
+        spans = _span_index(records_to_otlp(_fixture_records()))
+        sweep, update = spans["cd.sweep"], spans["cd.update"]
+        compile_span, ingest = spans["xla.compile"], spans["ingest.read"]
+        assert sweep["parentSpanId"] == ""
+        assert update["parentSpanId"] == sweep["spanId"]
+        assert compile_span["parentSpanId"] == sweep["spanId"]
+        # different thread: temporally inside cd.sweep but NOT its child
+        assert ingest["parentSpanId"] == ""
+        # one trace id across the run
+        assert len({s["traceId"] for s in spans.values()}) == 1
+
+    def test_run_end_totals_outrank_heartbeat(self):
+        metrics = _metric_index(records_to_otlp(_fixture_records()))
+        fetches = metrics["host_fetches"][0]["sum"]["dataPoints"][0]
+        assert fetches["asDouble"] == 8.0  # run_end's 8, not heartbeat's 4
+        assert "peak_hbm_bytes" in metrics
+        lanes = metrics["re_chunk_active_lanes"][0]["histogram"]
+        assert lanes["dataPoints"][0]["count"] == "3"
+        assert lanes["dataPoints"][0]["sum"] == 12.0
+
+    def test_snapshot_records_map_by_kind(self):
+        metrics = _metric_index(records_to_otlp(_fixture_records()))
+        assert "sum" in metrics["compiles"][0]          # counter
+        assert "gauge" in metrics["xla_flops"][0]       # gauge
+        hist = metrics["update_ms"][0]["histogram"]["dataPoints"][0]
+        assert (hist["count"], hist["sum"]) == ("3", 6.0)
+        assert (hist["min"], hist["max"]) == (1.0, 3.0)
+
+    def test_unsupported_proto_refused(self):
+        records = _fixture_records()
+        records[0] = dict(records[0], telemetry_proto=99)
+        with pytest.raises(UnsupportedProtoError, match="99"):
+            records_to_otlp(records)
+
+
+class TestLoadRunDir:
+    def test_round_trips_the_fixture(self, tmp_path):
+        run_dir = _write_run_dir(str(tmp_path / "run"))
+        loaded = records_to_otlp(load_run_dir(run_dir))
+        assert loaded == records_to_otlp(_fixture_records())
+
+    def test_torn_tail_lines_skipped(self, tmp_path):
+        run_dir = _write_run_dir(str(tmp_path / "run"))
+        with open(os.path.join(run_dir, "spans.jsonl"), "a") as fh:
+            fh.write('{"name": "cd.update", "ts_us": 99')  # killed mid-write
+        assert records_to_otlp(load_run_dir(run_dir)) == \
+            records_to_otlp(_fixture_records())
+
+    def test_missing_dir_is_empty(self, tmp_path):
+        assert load_run_dir(str(tmp_path / "nope")) == []
+
+
+class TestPostContainment:
+    def test_dead_collector_drops_and_counts(self):
+        registry = MetricsRegistry()
+        docs = records_to_otlp(_fixture_records())
+        out = post_otlp(docs, DEAD_COLLECTOR, timeout=2.0,
+                        registry=registry)
+        assert out == {"posted": 0, "dropped": 2}
+        assert registry.counter("telemetry_dropped").value(
+            kind="otlp") == 2
+
+
+class TestBridgeCli:
+    def _bridge(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "otlp_bridge.py"),
+             *args],
+            capture_output=True, text=True, timeout=120)
+
+    def test_out_document_round_trips(self, tmp_path):
+        run_dir = _write_run_dir(str(tmp_path / "run"))
+        out_path = str(tmp_path / "otlp.json")
+        proc = self._bridge("--run-dir", run_dir, "--out", out_path)
+        assert proc.returncode == 0, proc.stderr
+        with open(out_path) as fh:
+            assert json.load(fh) == records_to_otlp(load_run_dir(run_dir))
+
+    def test_dead_collector_exits_clean(self, tmp_path):
+        run_dir = _write_run_dir(str(tmp_path / "run"))
+        proc = self._bridge("--run-dir", run_dir,
+                            "--collector", DEAD_COLLECTOR)
+        assert proc.returncode == 0, proc.stderr
+        assert "dropped=2" in proc.stderr
+
+    def test_unsupported_proto_exits_2(self, tmp_path):
+        records = _fixture_records()
+        records[0] = dict(records[0], telemetry_proto=99)
+        run_dir = _write_run_dir(str(tmp_path / "run"), records)
+        proc = self._bridge("--run-dir", run_dir,
+                            "--out", str(tmp_path / "otlp.json"))
+        assert proc.returncode == 2
+        assert "telemetry_proto" in proc.stderr
+
+
+class TestTotalsHistograms:
+    def test_totals_reports_count_and_sum(self):
+        registry = MetricsRegistry()
+        registry.counter("host_fetches").inc(4)
+        h = registry.histogram("update_ms")
+        h.observe(1.0, site="a")
+        h.observe(2.0, site="a")
+        h.observe(5.0, site="b")
+        totals = registry.totals()
+        assert totals["host_fetches"] == 4
+        assert totals["update_ms"] == {"count": 3, "sum": 8.0}
+
+
+class TestReportSchemaStability:
+    """``trace_report --json`` is consumed by trace_diff and scripted
+    perf gates: its top-level shape is an API. Pin it exactly."""
+
+    def _trace(self, tmp_path, with_device=False):
+        events = [
+            {"name": "cd.sweep", "cat": "photon", "ph": "X", "ts": 0.0,
+             "dur": 1000.0, "pid": 0, "tid": 1, "args": {"sweep": 0}},
+            {"name": "cd.update", "cat": "photon", "ph": "X", "ts": 100.0,
+             "dur": 200.0, "pid": 0, "tid": 1,
+             "args": {"sweep": 0, "coordinate": "fixed"}},
+        ]
+        if with_device:
+            events.append(
+                {"name": "xla.compile", "cat": "photon", "ph": "X",
+                 "ts": 400.0, "dur": 0.0, "pid": 0, "tid": 1,
+                 "args": {"site": "cd.epilogue", "secs": 0.25,
+                          "flops": 1234.0, "bytes_accessed": 5678.0}})
+        path = str(tmp_path / "trace.json")
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": events}, fh)
+        return path
+
+    def _report(self, *args):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"), *args],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        return json.loads(proc.stdout)
+
+    def test_base_json_keys_pinned(self, tmp_path):
+        doc = self._report(self._trace(tmp_path), "--json")
+        assert set(doc) == {"kind", "processes", "span_count", "spans",
+                            "sweep_attribution"}
+        assert doc["kind"] == "trace_report"
+        assert doc["processes"] == [0]
+        assert doc["span_count"] == 2
+        for entry in doc["spans"].values():
+            assert set(entry) == {"count", "total_us", "self_us"}
+        for row in doc["sweep_attribution"]:
+            assert set(row) == {"sweep", "coordinate", "us"}
+
+    def test_device_key_is_additive_and_opt_in(self, tmp_path):
+        trace = self._trace(tmp_path, with_device=True)
+        base = self._report(trace, "--json")
+        assert "device" not in base
+        doc = self._report(trace, "--json", "--device")
+        assert set(doc) == {"kind", "processes", "span_count", "spans",
+                            "sweep_attribution", "device"}
+        (site,) = [r for r in doc["device"]
+                   if r["site"] == "cd.epilogue"]
+        assert site["compiles"] == 1
+        assert site["flops"] == 1234.0
+
+
+class TestStatusGangColumns:
+    def test_hbm_and_drop_columns(self):
+        tools = os.path.join(REPO, "tools")
+        sys.path.insert(0, tools)
+        try:
+            import photon_status
+        finally:
+            sys.path.remove(tools)
+        records = [
+            {"kind": "run_manifest", "process_index": 0},
+            {"kind": "heartbeat", "process_index": 0, "uptime_s": 1.0,
+             "metric_totals": {"hbm_live_bytes": 3 * 1024 ** 2,
+                               "telemetry_dropped": 5}},
+            {"kind": "run_manifest", "process_index": 1},
+            {"kind": "run_end", "process_index": 1, "status": "ok",
+             "metric_totals": {}, "peak_hbm_bytes": 4096},
+        ]
+        status = photon_status.compute_status(records)
+        p0, p1 = status["processes"][0], status["processes"][1]
+        assert p0["hbm_live_bytes"] == 3 * 1024 ** 2
+        assert p0["telemetry_dropped"] == 5
+        assert p1["peak_hbm_bytes"] == 4096
+        text = photon_status.format_gang(status, "test")
+        assert "hbm_live_bytes" in text
+        assert "3.0MiB" in text
+        assert "telemetry_dropped" in text

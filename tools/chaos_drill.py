@@ -187,12 +187,14 @@ CellDef = dict
 def build_cells(smoke: bool) -> list[CellDef]:
     def cell(point, mode, spec, expected, smoke_cell=False,
              pre_run=False, note="", bit_exact=False,
-             expect_drops=False, variant="", extra_args=None):
+             expect_drops=False, variant="", extra_args=None,
+             bridge=False):
         return {"point": point, "mode": mode, "spec": spec,
                 "expected": expected, "smoke": smoke_cell,
                 "pre_run": pre_run, "note": note,
                 "bit_exact": bit_exact, "expect_drops": expect_drops,
-                "variant": variant, "extra_args": extra_args or []}
+                "variant": variant, "extra_args": extra_args or [],
+                "bridge": bridge}
 
     cells = [
         # --- I/O layer: retry → quarantine → coverage budget ----------
@@ -290,6 +292,23 @@ def build_cells(smoke: bool) -> list[CellDef]:
              bit_exact=True,
              note="seeded flaky telemetry I/O: retried or dropped, "
                   "never fatal"),
+        # --- OTLP bridge: the fault point fires in the BRIDGE process
+        # --- (training runs fault-free); the bridge posts to a dead
+        # --- collector with the fault armed on top and must still exit
+        # --- 0 with the batches dropped+counted, the training result
+        # --- bit-exact either way ------------------------------------
+        cell("obs.otlp", "io_error", "obs.otlp=io_error:99", "ok",
+             smoke_cell=True, bridge=True, bit_exact=True,
+             note="OTLP POST path hard down: batches dropped, bridge "
+                  "exits 0, training untouched"),
+        cell("obs.otlp", "flaky", "obs.otlp=flaky:999:0.5", "ok",
+             bridge=True, bit_exact=True,
+             note="seeded flaky collector I/O on top of a dead "
+                  "collector: still dropped, still exit 0"),
+        cell("obs.otlp", "slow", "obs.otlp=slow:20:0.05", "ok",
+             bridge=True, bit_exact=True,
+             note="laggy collector path: the bridge absorbs the "
+                  "latency itself"),
     ]
     if smoke:
         cells = [c for c in cells if c["smoke"]]
@@ -441,6 +460,10 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
             failures.append(f"pre-run failed rc={pre.returncode}:\n"
                             f"{pre.stderr[-1000:]}")
 
+    if c.get("bridge"):
+        return _run_bridge_cell(c, name, args, tracked, out,
+                                reference_objective, ckpt, failures, t0)
+
     state_dir = os.path.join(cell_dir, "fault_state")
     proc = _run_driver(args, extra_env={
         "PHOTON_FAULTS": c["spec"],
@@ -549,6 +572,61 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
     _check_trace_survives(tracked, failures)
 
     return {"cell": name, "spec": c["spec"], "expected": expected,
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def _run_bridge_cell(c: CellDef, name: str, args: list[str],
+                     tracked: str, out: str, reference_objective,
+                     ckpt: str, failures: list[str], t0: float) -> dict:
+    """An ``obs.otlp`` cell: the fault point lives in the BRIDGE
+    process, not the driver. Train fault-free, then run
+    ``tools/otlp_bridge.py`` over the run dir with the fault armed AND
+    a dead collector, and assert: bridge rc 0 with its batches
+    dropped+counted, training rc 0 and bit-exact."""
+    proc = _run_driver(args)
+    rc = proc.returncode
+    _check_no_traceback(proc, failures)
+    if rc != 0:
+        failures.append(f"fault-free training run under bridge cell "
+                        f"must exit 0, got rc={rc}:\n"
+                        f"{proc.stderr[-1500:]}")
+    elif c.get("bit_exact"):
+        _, obj = _final_objective(out)
+        if obj != reference_objective:
+            failures.append(
+                f"training result NOT bit-exact under {name}: final "
+                f"objective {obj!r} vs reference "
+                f"{reference_objective!r}")
+
+    env = dict(os.environ)
+    env.update({"PHOTON_FAULTS": c["spec"], "PHOTON_FAULTS_SEED": "42"})
+    bridge = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "otlp_bridge.py"),
+         "--run-dir", tracked,
+         # port 9 (discard) is closed on any sane host: the dead
+         # collector every POST must survive
+         "--collector", "http://127.0.0.1:9"],
+        env=env, cwd=_REPO, text=True, capture_output=True, timeout=180)
+    outcome = "bridge_survived"
+    if bridge.returncode != 0:
+        failures.append(
+            f"bridge must exit 0 under {name} + dead collector, got "
+            f"rc={bridge.returncode}:\n{bridge.stderr[-1500:]}")
+    else:
+        m = [w for w in bridge.stderr.split() if w.startswith("dropped=")]
+        dropped = int(m[-1].split("=", 1)[1]) if m else None
+        if not dropped:
+            failures.append(
+                f"bridge under a dead collector must report dropped "
+                f"batches, stderr: {bridge.stderr[-400:]!r}")
+        else:
+            outcome += f"+dropped({dropped})"
+
+    _check_checkpoint_restorable(ckpt, failures)
+    _check_trace_survives(tracked, failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
             "rc": rc, "outcome": outcome, "note": c["note"],
             "seconds": round(time.monotonic() - t0, 1),
             "failures": failures, "passed": not failures}
@@ -680,6 +758,9 @@ def run_campaign(workdir: str, smoke: bool,
             "a dead/flaky/laggy telemetry consumer leaves training "
             "exit-0 and bit-exact, with only telemetry_dropped as "
             "evidence (obs.export cells)",
+            "a dead collector leaves the OTLP bridge exit-0 with its "
+            "batches dropped+counted, and the run it watches exit-0 "
+            "and bit-exact (obs.otlp cells)",
         ],
         "cells": results,
     }

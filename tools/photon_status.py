@@ -336,6 +336,8 @@ def compute_status(records: list[dict]) -> dict:
             "quarantined_coordinates": totals.get("quarantines", 0),
             "quarantined_shards": totals.get("quarantined_shards", 0),
             "telemetry_dropped": totals.get("telemetry_dropped", 0),
+            "hbm_live_bytes": totals.get("hbm_live_bytes"),
+            "peak_hbm_bytes": (end or {}).get("peak_hbm_bytes"),
             "stalls": totals.get("stalls", 0),
             "data_coverage": totals.get("data_coverage"),
             "stalled": bool(hb and hb.get("stalled")),
@@ -400,7 +402,28 @@ def format_gang(status: dict, source: str) -> str:
     lines.append("  per-proc  : " + ", ".join(
         f"p{i}={s if s is not None else '—'}({st})"
         for i, (s, st) in per.items()))
+    # per-process device-memory + drop columns: a member leaking HBM
+    # (or silently shedding telemetry) shows up here before it shows
+    # up as skew or a stall
+    header = (f"  {'proc':>6} {'hbm_live_bytes':>15} "
+              f"{'telemetry_dropped':>18}")
+    lines.append(header)
+    for i, p in sorted(status["processes"].items()):
+        hbm = p.get("hbm_live_bytes")
+        lines.append(
+            f"  {'p%d' % i:>6} "
+            f"{_fmt_bytes(hbm) if hbm is not None else '—':>15} "
+            f"{p.get('telemetry_dropped', 0):>18.0f}")
     return "\n".join(lines)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}TiB"
 
 
 def format_status(status: dict, source: str) -> str:

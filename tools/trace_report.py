@@ -14,7 +14,11 @@ Reads a ``trace.json`` (or ``trace.<process_index>.json``, or a
 
 ``--process N`` restricts a merged multi-process document to one track;
 ``--json`` emits the same stats machine-readably (the format
-``tools/trace_diff.py`` composes with).
+``tools/trace_diff.py`` composes with). ``--device`` switches to the
+device-plane view: per compile site, ``xla.compile`` span labels
+(compiles, compile ms, ``cost_analysis()`` flops/bytes) joined with the
+runtime span's self-time into a roofline-style achieved GF/s / GB/s
+column, plus retrace counts and the last retrace cause.
 
 Exit codes: 0 = report printed, 2 = unreadable/empty/invalid trace.
 
@@ -94,6 +98,103 @@ def sweep_attribution(events: list[dict]) -> dict[tuple, float]:
     return dict(out)
 
 
+#: compile site -> the runtime span whose self-time its executables
+#: spend (the --device join key). Sites without a mapping still report
+#: their compile cost, just without a utilization column.
+_SITE_RUNTIME_SPAN = {
+    "optimizer.lbfgs": "optimizer.solve",
+    "optimizer.owlqn": "optimizer.solve",
+    "optimizer.tron": "optimizer.solve",
+    "re.fit_blocks": "re.solve",
+    "cd.epilogue": "cd.epilogue_fetch",
+    "cd.canonical_total": "cd.epilogue_fetch",
+}
+
+
+def device_report(events: list[dict]) -> list[dict]:
+    """The --device view: per compile site, the ``xla.compile`` span
+    labels (compiles, compile seconds, cost_analysis flops/bytes)
+    joined with the mapped runtime span's count and self-time — a
+    roofline-style achieved-rate column (``gflops_per_sec`` /
+    ``gbytes_per_sec`` over the span's self time) plus the site's
+    retrace count and last recorded retrace cause."""
+    sites: dict[str, dict] = {}
+    for e in events:
+        args = e.get("args") or {}
+        site = args.get("site")
+        if site is None:
+            continue
+        if e["name"] == "xla.compile":
+            row = sites.setdefault(site, {
+                "site": site, "compiles": 0, "compile_ms": 0.0,
+                "flops": None, "bytes_accessed": None, "retraces": 0,
+                "last_retrace": None})
+            row["compiles"] += 1
+            row["compile_ms"] += float(args.get("secs", 0.0)) * 1e3
+            if args.get("flops") is not None:
+                row["flops"] = float(args["flops"])
+            if args.get("bytes_accessed") is not None:
+                row["bytes_accessed"] = float(args["bytes_accessed"])
+        elif e["name"] == "xla.retrace":
+            row = sites.setdefault(site, {
+                "site": site, "compiles": 0, "compile_ms": 0.0,
+                "flops": None, "bytes_accessed": None, "retraces": 0,
+                "last_retrace": None})
+            row["retraces"] += 1
+            row["last_retrace"] = {
+                "arg": args.get("arg"), "field": args.get("field"),
+                "old": args.get("old"), "new": args.get("new")}
+    if not sites:
+        return []
+    stats = self_times(events)
+    for site, row in sites.items():
+        span = _SITE_RUNTIME_SPAN.get(site)
+        s = stats.get(span) if span else None
+        row["runtime_span"] = span
+        row["span_count"] = s["count"] if s else None
+        row["span_self_ms"] = round(s["self_us"] / 1e3, 3) if s else None
+        row["gflops_per_sec"] = row["gbytes_per_sec"] = None
+        if s and s["self_us"] > 0:
+            secs = s["self_us"] / 1e6
+            if row["flops"] is not None:
+                row["gflops_per_sec"] = round(
+                    row["flops"] * s["count"] / secs / 1e9, 3)
+            if row["bytes_accessed"] is not None:
+                row["gbytes_per_sec"] = round(
+                    row["bytes_accessed"] * s["count"] / secs / 1e9, 3)
+        row["compile_ms"] = round(row["compile_ms"], 3)
+    return sorted(sites.values(), key=lambda r: r["site"])
+
+
+def format_device_report(events: list[dict]) -> str:
+    rows = device_report(events)
+    if not rows:
+        return ("no device-plane spans in this trace — run with "
+                "--device-telemetry to record xla.compile/xla.retrace")
+    lines = ["device plane (xla.compile ⋈ runtime span self-time):",
+             f"{'site':<20} {'compiles':>8} {'compile_ms':>11} "
+             f"{'retraces':>8} {'runtime_span':<18} {'self_ms':>9} "
+             f"{'GF/s':>8} {'GB/s':>8}"]
+    lines.append("-" * 97)
+    for r in rows:
+        lines.append(
+            f"{r['site']:<20} {r['compiles']:>8} "
+            f"{r['compile_ms']:>11.2f} {r['retraces']:>8} "
+            f"{str(r['runtime_span'] or '—'):<18} "
+            f"{r['span_self_ms'] if r['span_self_ms'] is not None else '—':>9} "
+            f"{r['gflops_per_sec'] if r['gflops_per_sec'] is not None else '—':>8} "
+            f"{r['gbytes_per_sec'] if r['gbytes_per_sec'] is not None else '—':>8}")
+    causes = [(r["site"], r["last_retrace"]) for r in rows
+              if r["last_retrace"]]
+    if causes:
+        lines.append("")
+        lines.append("last retrace cause per site:")
+        for site, c in causes:
+            lines.append(f"  {site}: {c['arg']} {c['field']} changed "
+                         f"{c['old']} -> {c['new']}")
+    return "\n".join(lines)
+
+
 def format_report(events: list[dict], top: int) -> str:
     lines = []
     stats = self_times(events)
@@ -167,6 +268,12 @@ def main(argv=None) -> int:
                         "this process's track (pid)")
     p.add_argument("--json", action="store_true",
                    help="emit the stats as JSON instead of the table")
+    p.add_argument("--device", action="store_true",
+                   help="device-plane view: join xla.compile "
+                        "cost-analysis labels (flops/bytes) with runtime "
+                        "span self-time for a roofline-style achieved "
+                        "rate per compile site (needs a trace recorded "
+                        "with --device-telemetry)")
     ns = p.parse_args(argv)
     try:
         events = load_events(ns.trace)
@@ -184,7 +291,14 @@ def main(argv=None) -> int:
               f"events{where}", file=sys.stderr)
         return 2
     if ns.json:
-        print(json.dumps(json_report(events, ns.top), indent=1))
+        doc = json_report(events, ns.top)
+        if ns.device:
+            # additive key: the base schema (pinned by the stability
+            # test) is unchanged unless --device is asked for
+            doc["device"] = device_report(events)
+        print(json.dumps(doc, indent=1))
+    elif ns.device:
+        print(format_device_report(events))
     else:
         print(format_report(events, ns.top))
     return 0
