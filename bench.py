@@ -61,6 +61,18 @@ if (__name__ == "__main__"
     os.execv(sys.executable, [sys.executable, *sys.orig_argv[1:]])
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
+# The mesh-sharded RE A/B in bench_glmix needs >= 4 devices; a CPU
+# fallback exposes one host device unless forced. Harmless on chip: the
+# flag only multiplies the *cpu* platform's device count, and ops stay
+# on device 0 unless explicitly sharded. An operator's own
+# XLA_FLAGS setting of the knob wins. Set before any jax backend
+# initializes (jax clients are created lazily at first use).
+if ("--xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+
 import numpy as np
 
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -869,6 +881,57 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
               f"(chunks {compact_stats['chunks']}, active lanes "
               f"{compact_stats['lane_counts']})")
 
+    # Mesh-sharded A/B on the same straggler config: partition the entity
+    # axis over a 4-device (1 data x 4 entity) mesh — real chips when the
+    # backend has them, the forced host devices on CPU fallbacks — and
+    # re-run the compacted straggler solve with per-shard lane
+    # compaction. Direct comparison point: solve_straggler_compacted
+    # (same config, same zipf skew, one device). The dataset is rebuilt
+    # with entity_axis_size=4 so every bucket's lane count divides the
+    # mesh; the padding fraction and rolling per-shard lane counts land
+    # in the record so shard-imbalance waste is auditable.
+    re_solve_secs_sharded = None
+    re_shard_padding_frac = None
+    re_shard_lane_counts = None
+    # default-backend devices only: mixing a cpu mesh with on-chip
+    # dataset arrays would bounce every dispatch through host transfers
+    # (cpu fallbacks always have 4 — forced at module top)
+    shard_devs = jax.devices()
+    if len(shard_devs) >= 4:
+        from photon_ml_tpu.parallel.mesh import make_mesh, set_default_mesh
+
+        re_ds_shard = build_random_effect_dataset(
+            data, re_cfg, num_buckets=num_buckets, entity_axis_size=4)
+        sharded_prob = _dc.replace(compacted_prob, entity_shards=4)
+        set_default_mesh(make_mesh(num_data=1, num_entity=4,
+                                   devices=list(shard_devs[:4])))
+        try:
+            off_s = re_ds_shard.offsets_with(scores)
+            coefs_s, *_ = sharded_prob.run(re_ds_shard, off_s)  # warm
+            jax.block_until_ready(coefs_s)
+            re_mod.reset_solve_stats()
+            t0 = time.perf_counter()
+            coefs_s, *_ = sharded_prob.run(re_ds_shard, off_s)
+            jax.block_until_ready(coefs_s)
+            re_solve_secs_sharded = time.perf_counter() - t0
+            padded = re_mod.SOLVE_STATS["shard_padded_lanes"]
+            if padded:
+                re_shard_padding_frac = round(
+                    1.0 - re_mod.SOLVE_STATS["shard_real_lanes"] / padded,
+                    4)
+            re_shard_lane_counts = list(
+                re_mod.SOLVE_STATS["shard_lane_counts"])
+        finally:
+            set_default_mesh(None)
+        _progress(f"glmix RE straggler solve mesh-sharded(4) "
+                  f"{re_solve_secs_sharded:.2f}s vs single-device "
+                  f"compacted {solve_compacted_secs:.2f}s (padding frac "
+                  f"{re_shard_padding_frac}, per-shard active lanes "
+                  f"{re_shard_lane_counts})")
+    else:
+        _progress("glmix RE mesh-sharded A/B skipped: <4 devices on the "
+                  "default backend (re_solve_secs_sharded stays null)")
+
     # Block-size ladder on the straggler config: one warm CD sweep per
     # --cd-block-size in (1, 2, 4) over (fixed, straggler per-user). A
     # block solves its coordinates concurrently against the stale
@@ -964,6 +1027,16 @@ def bench_glmix(n=1_000_209, n_users=6040, n_movies=3706, d_global=64,
             # sheds converged lanes per chunk
             "solve_straggler_plain": round(solve_straggler_secs, 3),
             "solve_straggler_compacted": round(solve_compacted_secs, 3),
+            # same compacted straggler config over a (1 data x 4 entity)
+            # mesh; null when no platform offers 4 devices
+            "re_solve_secs_sharded": (
+                round(re_solve_secs_sharded, 3)
+                if re_solve_secs_sharded is not None else None),
+            # pad-slot waste of the per-shard pow2 lane padding
+            # (1 - real/padded over every sharded dispatch)
+            "re_shard_padding_frac": re_shard_padding_frac,
+            # rolling max-over-shards active-lane widths per chunk
+            "re_shard_lane_counts": re_shard_lane_counts,
             "scatter_scores": round(scatter_secs, 3),
             # per-update fused-epilogue cost, amortized over the warm run
             "epilogue": (round(hot["epilogue_wait_secs"]

@@ -65,6 +65,7 @@ from photon_ml_tpu.game.dataset import (
 )
 from photon_ml_tpu.game.random_effect import (
     AUTO_COMPACTION_CHUNK,
+    AUTO_ENTITY_SHARDS,
     RandomEffectOptimizationProblem,
 )
 from photon_ml_tpu.io.data_format import (
@@ -154,6 +155,15 @@ def _parse_compaction_chunk(s: str) -> int:
     return int(s)
 
 
+def _parse_entity_shards(s: str) -> int:
+    """``--re-entity-shards`` value: an int, or ``auto`` → every local
+    device on the entity axis (kept an int so the run-manifest flags stay
+    scalar)."""
+    if s.strip().lower() == "auto":
+        return AUTO_ENTITY_SHARDS
+    return int(s)
+
+
 def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p = argparse.ArgumentParser(prog="game-training",
                                 description="GAME training on TPU")
@@ -198,6 +208,17 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "chunk-size controller pick and re-tune between "
                         "solves from the observed per-chunk active-lane "
                         "decay (the re_chunk_active_lanes signal)")
+    p.add_argument("--re-entity-shards",
+                   type=_parse_entity_shards, default=1,
+                   help="partition random-effect entity blocks over this "
+                        "many mesh entity shards (shard_map over the mesh "
+                        "entity axis: per-shard lane compaction, on-device "
+                        "psum score exchange) and shard the fixed-effect "
+                        "weight update across the remaining data-axis "
+                        "replicas. 'auto' = all local devices. Counts "
+                        "that do not divide the device count fall back to "
+                        "the largest divisor (logged); 1 (default) is the "
+                        "unsharded path, bit-identical to before")
     p.add_argument("--cd-block-size", type=int, default=1,
                    help="solve this many coordinates per sweep "
                         "CONCURRENTLY against a stale device-resident "
@@ -445,6 +466,9 @@ class GameTrainingDriver:
         self.train_ingest = None  # IngestPolicy of the training load
         self.validate_ingest = None
         self._events = None  # driver-wide event bus, built on first use
+        # resolved --re-entity-shards: the GRANTED mesh entity-axis size
+        # (run() resolves 'auto'/non-dividing counts against the devices)
+        self._entity_shards = 1
 
     # -- pipeline ----------------------------------------------------------
 
@@ -562,7 +586,11 @@ class GameTrainingDriver:
                     dataset=ds,
                     problem=GLMOptimizationProblem(
                         config=opt_cfg, task=self.task,
-                        compute_variances=compute_variance))
+                        compute_variances=compute_variance,
+                        # with entity sharding on, the data-axis replicas
+                        # also split the optimizer state / weight update
+                        # (engages only when the data axis is > 1)
+                        shard_weight_update=self._entity_shards > 1))
             elif cid in self.random_data_configs and cid in factored_cfgs:
                 data_cfg = self.random_data_configs[cid]
                 re_cfg, latent_cfg, mf_cfg = factored_cfgs[cid]
@@ -594,17 +622,20 @@ class GameTrainingDriver:
                         raw_dim=self.train_data.shard_dim(
                             data_cfg.feature_shard_id),
                         num_buckets=num_buckets,
+                        entity_axis_size=self._entity_shards,
                         blocks_dir=os.path.join(
                             self.ns.random_effect_blocks_dir, cid))
                 else:
                     ds = build_random_effect_dataset(
                         self.train_data, data_cfg,
-                        num_buckets=num_buckets)
+                        num_buckets=num_buckets,
+                        entity_axis_size=self._entity_shards)
                 coords[cid] = RandomEffectCoordinate(
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
                         config=opt_cfg, task=self.task,
-                        lane_compaction_chunk=self._lane_chunk()))
+                        lane_compaction_chunk=self._lane_chunk(),
+                        entity_shards=self._entity_shards))
             else:
                 raise ValueError(
                     f"coordinate {cid!r} in updating sequence has no data "
@@ -747,9 +778,27 @@ class GameTrainingDriver:
                 raise FileExistsError(
                     f"output dir {ns.output_dir} is not empty")
         os.makedirs(ns.output_dir, exist_ok=True)
-        # Multi-chip: all devices on the data axis; fixed-effect solves go
-        # through the shard_map backend (see GLMOptimizationProblem.run).
-        setup_default_mesh()
+        # Multi-chip: --re-entity-shards devices on the entity axis (auto =
+        # all of them), the rest on the data axis; fixed-effect solves go
+        # through the shard_map backend (see GLMOptimizationProblem.run),
+        # random-effect blocks shard over the entity axis.
+        import jax as _jax
+
+        requested = int(getattr(ns, "re_entity_shards", 1))
+        if requested == AUTO_ENTITY_SHARDS:
+            requested = max(1, len(_jax.devices()))
+        mesh = setup_default_mesh(num_entity=requested)
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
+
+        self._entity_shards = (int(mesh.shape.get(ENTITY_AXIS, 1))
+                               if mesh is not None else 1)
+        from photon_ml_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.gauge("re_entity_shards").set(self._entity_shards)
+        if self._entity_shards > 1:
+            self.logger.info(
+                f"mesh-sharded GAME: {self._entity_shards} entity shards "
+                f"(requested {requested})")
         with timed_phase("prepareFeatureMaps", self.logger):
             self.prepare_feature_maps()
         with timed_phase("prepareGameDataSet", self.logger):
@@ -876,6 +925,11 @@ def _check_multihost_args(ns: argparse.Namespace) -> None:
             "lanes with per-chunk host round-trips; the multi-host solve "
             "keeps its entity axis mesh-sharded and runs the "
             "single-dispatch path)")
+    if getattr(ns, "re_entity_shards", 1) != 1:  # 1 is "off"; auto counts
+        unsupported.append(
+            "--re-entity-shards (the multi-host worker already shards its "
+            "entity axis over the global mesh via GSPMD; the explicit "
+            "shard_map path is wired into the single-process driver only)")
     if ns.cd_block_size != 1:
         unsupported.append(
             "--cd-block-size (the multi-host worker runs its own "
@@ -1150,6 +1204,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                           stop_file=ns.stop_file)
     stop.install_signal_handlers()
     driver.stop = stop
+    # resolve --re-entity-shards before the manifest is written so it
+    # records the GRANTED entity-axis size, not the 'auto' sentinel;
+    # run() re-derives the same value when it builds the mesh
+    from photon_ml_tpu.parallel.mesh import largest_entity_divisor
+    import jax as _jax
+
+    _ndev = len(_jax.devices())
+    _req = int(getattr(ns, "re_entity_shards", 1))
+    if _req == AUTO_ENTITY_SHARDS:
+        _req = max(1, _ndev)
+    ns.re_entity_shards = largest_entity_divisor(_ndev, _req)
     # under a supervisor (tools/photon_supervise.py or the multi-host
     # re-exec), a relaunched incarnation rotates the previous one's
     # telemetry to .prev instead of truncating the evidence

@@ -130,6 +130,23 @@ def _sample_live_bytes(sweep: int) -> None:
     except Exception:  # pragma: no cover - backend without live_arrays
         return
     REGISTRY.gauge("hbm_live_bytes").set(total_bytes, site="cd.sweep_drain")
+    # mesh-sharded runs: attribute live bytes to each DEVICE holding a
+    # shard (addressable_shards metadata — still no device sync), so a
+    # lopsided entity partition shows up as a lopsided per-shard gauge
+    try:
+        per_device: dict = {}
+        for a in jax.live_arrays():
+            shards = getattr(a, "addressable_shards", None) or []
+            if len(shards) > 1:
+                for s in shards:
+                    d = s.device.id
+                    per_device[d] = (per_device.get(d, 0)
+                                     + int(getattr(s.data, "nbytes", 0)
+                                           or 0))
+        for d, b in sorted(per_device.items()):
+            REGISTRY.gauge("re_shard_hbm_live_bytes").set(b, shard=str(d))
+    except Exception:  # pragma: no cover - backend without shard metadata
+        pass
     with trace.span("cd.hbm_sample", sweep=sweep, live_bytes=total_bytes):
         pass
     # --device-telemetry: attribute the sweep's per-coordinate commit

@@ -24,14 +24,17 @@ path costing only wasted FLOPs, never wrong results.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from photon_ml_tpu.data.batch import DenseBatch
 from photon_ml_tpu.game.dataset import RandomEffectDataset
@@ -54,8 +57,12 @@ from photon_ml_tpu.optimize.config import (
 from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs
 from photon_ml_tpu.optimize.owlqn import minimize_owlqn
 from photon_ml_tpu.optimize.tron import minimize_tron
+from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, get_default_mesh
+from photon_ml_tpu.utils.faults import fault_point
 
 Array = jnp.ndarray
+
+logger = logging.getLogger(__name__)
 
 # Per-entity convergence codes (RandomEffectOptimizationTracker.
 # countsByConvergence analog; names match ConvergenceReason values).
@@ -85,19 +92,33 @@ def _hvp(w, v, payload):
 # ``solve_secs`` is time blocked on chunk dispatch + the one unconverged-mask
 # fetch per chunk, ``compact_secs`` is active-lane gather/re-pack time,
 # ``lane_counts`` the still-active lane count entering each compacted chunk.
+# The ``shard_*`` keys account the mesh-sharded path: real vs power-of-two
+# padded lanes per sharded dispatch (their ratio is bench.py's
+# ``re_shard_padding_frac``) and a rolling window of per-shard active-lane
+# counts (the load-balance signal).
 SOLVE_STATS = {"dispatches": 0, "chunks": 0, "solve_secs": 0.0,
-               "compact_secs": 0.0, "lane_counts": []}
+               "compact_secs": 0.0, "lane_counts": [],
+               "shard_real_lanes": 0, "shard_padded_lanes": 0,
+               "shard_lane_counts": []}
 
 
 def reset_solve_stats() -> None:
     SOLVE_STATS.update({"dispatches": 0, "chunks": 0, "solve_secs": 0.0,
-                        "compact_secs": 0.0, "lane_counts": []})
+                        "compact_secs": 0.0, "lane_counts": [],
+                        "shard_real_lanes": 0, "shard_padded_lanes": 0,
+                        "shard_lane_counts": []})
 
 
 #: ``lane_compaction_chunk`` sentinel (driver flag value ``auto``): the
 #: chunk size is chosen — and re-tuned between solves — by
 #: :class:`ChunkAutoTuner` from the observed per-chunk active-lane decay.
 AUTO_COMPACTION_CHUNK = -1
+
+#: ``--re-entity-shards`` sentinel (flag value ``auto``): put EVERY local
+#: device on the mesh entity axis (the driver resolves this to the device
+#: count before building the mesh; kept an int so run-manifest flags stay
+#: scalar).
+AUTO_ENTITY_SHARDS = -1
 
 
 def _pow2_at_most(x: int) -> int:
@@ -432,6 +453,284 @@ def _fit_blocks_compacted(X, labels, offsets, weights, x0, obj, l1,
     return state.results()
 
 
+# ---------------------------------------------------------------------------
+# Mesh-sharded dispatch: the entity axis of a bucket is split over the mesh
+# ENTITY_AXIS (parallel/mesh.py) via shard_map — every device runs the SAME
+# vmapped solver kernel on its local lane slice, with ZERO collectives inside
+# the solve loop (entity subproblems are independent; the reference's Spark
+# embarrassing parallelism made explicit). Only the score exchange reduces
+# across shards, with an on-device psum (see _sharded_score_fn).
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=64)
+def _sharded_fit_fn(mesh, solver, max_iter, tolerance,
+                    boundary_convergence, return_carry):
+    """shard_map + jit of the block solve for a FULL (unpadded) dispatch:
+    lane-leading arrays split over the entity axis, obj/l1 replicated.
+    Cached per (mesh, statics) so repeat dispatches reuse the executable
+    instead of re-tracing a fresh closure per call."""
+    from photon_ml_tpu.parallel.distributed import _shard_map
+
+    lane = P(ENTITY_AXIS)
+
+    def impl(X, labels, offsets, weights, initial, obj, l1):
+        return _fit_blocks_impl(X, labels, offsets, weights, initial, obj,
+                                l1, solver, max_iter, tolerance,
+                                boundary_convergence, None, return_carry)
+
+    fit = _shard_map(impl, mesh,
+                     in_specs=(lane, lane, lane, lane, lane, P(), P()),
+                     out_specs=tuple([lane] * (5 if return_carry else 4)))
+    return jax.jit(fit)
+
+
+@lru_cache(maxsize=64)
+def _sharded_resume_fit_fn(mesh, solver, max_iter, tolerance,
+                           boundary_convergence, return_carry):
+    """shard_map + jit of a RESUMED compacted dispatch. The still-active
+    lane gather happens ON DEVICE inside the sharded program: each shard
+    receives its ``[1, L]`` row of local data ids / carry positions,
+    gathers its own lanes from its resident slice of the full block and
+    the previous chunk's carry, and resumes — the host never re-packs
+    data tensors, it only computes the tiny id arrays from the one
+    unconverged-mask fetch per chunk."""
+    from photon_ml_tpu.parallel.distributed import _shard_map
+
+    lane = P(ENTITY_AXIS)
+
+    def impl(X, labels, offsets, weights, idx_data, idx_carry, obj, l1,
+             carry):
+        idx_d = idx_data.reshape(-1)
+        idx_c = idx_carry.reshape(-1)
+        res = jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, idx_c, axis=0), carry)
+        return _fit_blocks_impl(
+            jnp.take(X, idx_d, axis=0), jnp.take(labels, idx_d, axis=0),
+            jnp.take(offsets, idx_d, axis=0),
+            jnp.take(weights, idx_d, axis=0),
+            res.x, obj, l1, solver, max_iter, tolerance,
+            boundary_convergence, res, return_carry)
+
+    fit = _shard_map(
+        impl, mesh,
+        in_specs=(lane, lane, lane, lane, lane, lane, P(), P(), lane),
+        out_specs=tuple([lane] * (5 if return_carry else 4)))
+    return jax.jit(fit)
+
+
+def _note_shard_dispatch(kind: str, fn, X, extra=()) -> None:
+    SOLVE_STATS["dispatches"] += 1
+    key = (kind, id(fn), tuple(X.shape), str(X.dtype)) + tuple(extra)
+    if key not in _SEEN_DISPATCH_KEYS:
+        _SEEN_DISPATCH_KEYS.add(key)
+        REGISTRY.counter("retraces").inc(site="re.shard_dispatch")
+
+
+def _dispatch_fit_sharded(mesh, X, labels, offsets, weights, initial, obj,
+                          l1, solver, max_iter, tolerance,
+                          boundary_convergence: bool = False,
+                          return_carry: bool = False):
+    fn = _sharded_fit_fn(mesh, solver, max_iter, float(tolerance),
+                         boundary_convergence, return_carry)
+    _note_shard_dispatch("shard", fn, X)
+    # a full dispatch has no pad lanes: real == padded
+    SOLVE_STATS["shard_real_lanes"] += int(X.shape[0])
+    SOLVE_STATS["shard_padded_lanes"] += int(X.shape[0])
+    return obs_compile.call(
+        "re.shard_fit_blocks", fn,
+        (X, labels, offsets, weights, initial, obj, l1),
+        arg_names=("X", "labels", "offsets", "weights", "initial", "obj",
+                   "l1"))
+
+
+def _dispatch_fit_sharded_resume(mesh, X, labels, offsets, weights,
+                                 idx_data, idx_carry, obj, l1, carry,
+                                 solver, max_iter, tolerance,
+                                 boundary_convergence: bool,
+                                 return_carry: bool):
+    fn = _sharded_resume_fit_fn(mesh, solver, max_iter, float(tolerance),
+                                boundary_convergence, return_carry)
+    _note_shard_dispatch("shard_resume", fn, X,
+                         extra=(tuple(idx_data.shape),))
+    return obs_compile.call(
+        "re.shard_fit_blocks", fn,
+        (X, labels, offsets, weights, idx_data, idx_carry, obj, l1, carry),
+        arg_names=("X", "labels", "offsets", "weights", "idx_data",
+                   "idx_carry", "obj", "l1", "carry"))
+
+
+def _fit_blocks_compacted_sharded(mesh, shards: int, X, labels, offsets,
+                                  weights, x0, obj, l1, solver,
+                                  max_iter, tolerance, chunk: int,
+                                  lane_seq: Optional[list] = None):
+    """Sharded variant of :func:`_fit_blocks_compacted`: lane compaction
+    with PER-SHARD power-of-two padding. A lane's home shard never changes
+    (global id // lanes_per_shard), so after each chunk the host partitions
+    the still-active ids by owner, pads every shard's list to one shared
+    power-of-two width L (a ragged per-shard width would be a different
+    program shape per shard), and dispatches ``[K, L]`` local-id arrays —
+    the data/carry gathers run on device inside the sharded program.
+
+    Pad slots duplicate one of the shard's own carried lanes; a shard with
+    NO active lanes re-resolves one of its converged lanes, which is an
+    exact no-op (resuming a converged carry fails the loop predicate
+    immediately and writes back the value it already holds). Results are
+    folded with :meth:`LaneCompactionState.absorb_padded`, which masks pad
+    slots out of the iteration scatter-add. Host cost per chunk is
+    unchanged from the unsharded loop: ONE unconverged-mask fetch."""
+    K = shards
+    e = int(X.shape[0])
+    e_shard = e // K
+    state = LaneCompactionState.initial(x0, x0.dtype)
+    idx: Optional[np.ndarray] = None  # flat [K*L] global ids (host)
+    mask: Optional[np.ndarray] = None  # flat [K*L] real-slot flags (host)
+    carry = None
+    cur_idx = None  # ([K, L] local data ids, [K, L] carry positions)
+    prev_width = e_shard  # lanes-per-shard width of the previous dispatch
+    prev_global = np.arange(e, dtype=np.int32).reshape(K, e_shard)
+    spent = 0
+    chunk_index = 0
+    while True:
+        budget = min(chunk, max_iter - spent)
+        final_chunk = spent + budget >= max_iter
+        active_lanes = e if idx is None else int(mask.sum())
+        if lane_seq is not None:
+            lane_seq.append(active_lanes)
+        t0 = time.perf_counter()
+        with trace.span("re.shard_chunk", chunk=chunk_index,
+                        active_lanes=active_lanes, budget=budget,
+                        shards=K):
+            if idx is None:
+                out = _dispatch_fit_sharded(
+                    mesh, X, labels, offsets, weights, x0, obj, l1,
+                    solver, budget, tolerance,
+                    boundary_convergence=not final_chunk,
+                    return_carry=not final_chunk)
+            else:
+                out = _dispatch_fit_sharded_resume(
+                    mesh, X, labels, offsets, weights, cur_idx[0],
+                    cur_idx[1], obj, l1, carry, solver, budget, tolerance,
+                    boundary_convergence=not final_chunk,
+                    return_carry=not final_chunk)
+            if final_chunk:
+                c, it, v, k = out
+                new_carry = None
+            else:
+                c, it, v, k, new_carry = out
+            if idx is None:
+                still, still_local = state.absorb(None, c, it, v, k,
+                                                  CONV_MAX_ITERATIONS)
+            else:
+                still, still_local = state.absorb_padded(
+                    idx, mask, c, it, v, k, CONV_MAX_ITERATIONS)
+        REGISTRY.histogram("re_chunk_active_lanes").observe(active_lanes)
+        SOLVE_STATS["solve_secs"] += time.perf_counter() - t0
+        SOLVE_STATS["chunks"] += 1
+        chunk_index += 1
+        spent += budget
+        if spent >= max_iter or len(still) == 0:
+            break
+        t0 = time.perf_counter()
+        carry = new_carry
+        owner = still_local // prev_width
+        counts = np.bincount(owner, minlength=K)
+        L = padded_lane_count(int(counts.max()), floor=min(8, e_shard))
+        rows_global = np.empty((K, L), np.int32)
+        rows_carry = np.zeros((K, L), np.int32)
+        rows_mask = np.zeros((K, L), bool)
+        for s in range(K):
+            sel = owner == s
+            g_ids = still[sel]
+            l_pos = (still_local[sel] % prev_width).astype(np.int32)
+            n = len(g_ids)
+            if n:
+                fill_g, fill_c = g_ids[0], l_pos[0]
+            else:
+                fill_g, fill_c = prev_global[s, 0], 0
+            rows_global[s] = fill_g
+            rows_carry[s] = fill_c
+            rows_global[s, :n] = g_ids
+            rows_carry[s, :n] = l_pos
+            rows_mask[s, :n] = True
+        idx = rows_global.reshape(-1)
+        mask = rows_mask.reshape(-1)
+        cur_idx = (rows_global
+                   - np.arange(K, dtype=np.int32)[:, None] * e_shard,
+                   rows_carry)
+        prev_global = rows_global
+        prev_width = L
+        SOLVE_STATS["compact_secs"] += time.perf_counter() - t0
+        SOLVE_STATS["shard_real_lanes"] += int(counts.sum())
+        SOLVE_STATS["shard_padded_lanes"] += K * L
+        SOLVE_STATS["shard_lane_counts"] = (
+            SOLVE_STATS["shard_lane_counts"][-15:] + [counts.tolist()])
+        SOLVE_STATS["lane_counts"] = (
+            SOLVE_STATS["lane_counts"][-63:] + [int(len(still))])
+    return state.results()
+
+
+#: fallback reasons already logged (one warning per distinct cause, not
+#: one per sweep — the sharded path is hit every CD sweep)
+_SHARD_FALLBACK_WARNED: set = set()
+
+
+def _resolve_entity_shards(entity_shards: int, num_lanes: int):
+    """(mesh, K) when the mesh-sharded path engages for a block of
+    ``num_lanes`` entity lanes, else (None, 1) — with one logged warning
+    per distinct fallback cause. K is the DEFAULT mesh's entity-axis
+    extent (the driver sizes both from the same flag; a mesh granted
+    fewer shards than requested already warned in setup_default_mesh)."""
+    if entity_shards <= 1:
+        return None, 1
+    mesh = get_default_mesh()
+    K = int(mesh.shape.get(ENTITY_AXIS, 1)) if mesh is not None else 1
+    if K <= 1:
+        reason = ("no-mesh", entity_shards)
+        if reason not in _SHARD_FALLBACK_WARNED:
+            _SHARD_FALLBACK_WARNED.add(reason)
+            logger.warning(
+                "re-entity-shards=%d requested but no default mesh with an "
+                "entity axis > 1 is installed; running unsharded",
+                entity_shards)
+        return None, 1
+    if num_lanes % K != 0:
+        reason = ("ragged", num_lanes, K)
+        if reason not in _SHARD_FALLBACK_WARNED:
+            _SHARD_FALLBACK_WARNED.add(reason)
+            logger.warning(
+                "entity block of %d lanes does not divide %d entity "
+                "shards; running this block unsharded (build the dataset "
+                "with entity_axis_size=%d to pad it)", num_lanes, K, K)
+        return None, 1
+    return mesh, K
+
+
+@lru_cache(maxsize=64)
+def _sharded_score_fn(mesh, num_samples):
+    """shard_map + jit of the active-score exchange: each shard scores its
+    resident entity lanes and scatters into a full-length sample-axis
+    partial, reduced ON DEVICE with a psum over the entity axis — the
+    replicated result feeds the CD fused epilogue directly, no host-side
+    assemble and no new device→host syncs."""
+    from photon_ml_tpu.parallel.distributed import _shard_map
+
+    lane = P(ENTITY_AXIS)
+
+    def impl(X, coefs, row_ids, weights):
+        margins = jnp.einsum("end,ed->en", X, coefs,
+                             preferred_element_type=jnp.float32)
+        margins = jnp.where(weights > 0, margins, 0.0)
+        flat = jax.ops.segment_sum(
+            margins.reshape(-1), row_ids.reshape(-1).astype(jnp.int32),
+            num_segments=num_samples + 1)
+        return lax.psum(flat[:num_samples], ENTITY_AXIS)
+
+    fit = _shard_map(impl, mesh, in_specs=(lane, lane, lane, lane),
+                     out_specs=P())
+    return jax.jit(fit)
+
+
 @dataclasses.dataclass(frozen=True)
 class RandomEffectOptimizationProblem:
     """Per-entity GLM problems for one random-effect coordinate.
@@ -452,6 +751,15 @@ class RandomEffectOptimizationProblem:
     # problem's own ChunkAutoTuner pick — and re-tune between solves —
     # from the observed per-chunk active-lane decay.
     lane_compaction_chunk: int = 0
+    # > 1 engages the mesh-sharded dispatch (driver flag
+    # --re-entity-shards): entity lanes split over the default mesh's
+    # ENTITY_AXIS via shard_map, per-shard lane compaction, on-device
+    # psum score exchange. Engages only when a default mesh with a
+    # matching entity axis is installed AND the block's lane count
+    # divides it (build_random_effect_dataset(entity_axis_size=K) pads
+    # for this); otherwise one logged warning and the unsharded path.
+    # 1 (the default) IS the unsharded path — bit-identical to before.
+    entity_shards: int = 1
     # per-coordinate controller state (the problem instance lives
     # across sweeps, so auto-mode feedback persists; identical configs
     # on different coordinates still tune independently)
@@ -468,15 +776,45 @@ class RandomEffectOptimizationProblem:
         )
 
     def _fit(self, X, labels, offsets, weights, x0, obj, l1_arr,
-             solver: str, donate: bool):
+             solver: str, donate: bool, fault_tag: Optional[str] = None):
         """One entity block through the solver — compacted in iteration
         chunks when ``lane_compaction_chunk`` engages (auto-tuned when
-        it is AUTO_COMPACTION_CHUNK), one dispatch otherwise."""
+        it is AUTO_COMPACTION_CHUNK), one dispatch otherwise. With
+        ``entity_shards`` > 1 and a matching default mesh, the block
+        dispatches mesh-sharded instead (``donate`` is ignored there:
+        the sharded program gathers on device from the caller's
+        buffers, which therefore stay live)."""
         cfg = self.config
         chunk = self.lane_compaction_chunk
         auto = chunk == AUTO_COMPACTION_CHUNK
         if auto:
             chunk = self.chunk_tuner.chunk_for(solver, cfg.max_iterations)
+        mesh, shards = _resolve_entity_shards(self.entity_shards,
+                                              int(X.shape[0]))
+        if shards > 1:
+            e = int(X.shape[0])
+            with trace.span("re.shard_solve", solver=solver, shards=shards,
+                            lanes=e):
+                if 0 < chunk < cfg.max_iterations and e // shards > 1:
+                    lane_seq = [] if auto else None
+                    out = _fit_blocks_compacted_sharded(
+                        mesh, shards, X, labels, offsets, weights, x0,
+                        obj, l1_arr, solver, cfg.max_iterations,
+                        float(cfg.tolerance), chunk, lane_seq=lane_seq)
+                    if auto:
+                        self.chunk_tuner.update(solver, cfg.max_iterations,
+                                                lane_seq)
+                else:
+                    out = _dispatch_fit_sharded(
+                        mesh, X, labels, offsets, weights, x0, obj,
+                        l1_arr, solver, cfg.max_iterations,
+                        float(cfg.tolerance))
+            # host-level chaos site (never traced): a drill here proves a
+            # fault INSIDE a sharded solve rides the existing CD recovery
+            # ladder — see utils/faults.FAULT_POINTS["re.shard_dispatch"]
+            poisoned = fault_point("re.shard_dispatch", tag=fault_tag,
+                                   arrays=out[0])
+            return (poisoned,) + tuple(out[1:])
         if 0 < chunk < cfg.max_iterations and int(X.shape[0]) > 1:
             lane_seq: Optional[list] = [] if auto else None
             out = _fit_blocks_compacted(
@@ -541,7 +879,8 @@ class RandomEffectOptimizationProblem:
             return self._fit(
                 dataset.X, dataset.labels, offsets, dataset.weights, x0,
                 self.objective(), jnp.full(d, l1, x0.dtype), solver,
-                donate and offsets is not dataset.base_offsets)
+                donate and offsets is not dataset.base_offsets,
+                fault_tag="0")
 
     def _run_bucketed(self, dataset, offsets, initial, solver: str,
                       l1: float, donate: bool = False):
@@ -573,7 +912,7 @@ class RandomEffectOptimizationProblem:
         # out of the bucket loop (it used to re-convert per bucket/sweep)
         initial_acc = None if initial is None else jnp.asarray(initial, acc)
         outs = []
-        for bucket, off_b in zip(dataset.buckets, offsets):
+        for bi, (bucket, off_b) in enumerate(zip(dataset.buckets, offsets)):
             e_b, _, d_b = bucket.X.shape
             nr, start = bucket.num_real, bucket.entity_start
             off_b = jnp.asarray(off_b, acc)
@@ -585,7 +924,8 @@ class RandomEffectOptimizationProblem:
                                ((0, e_b - nr), (0, 0)))
             outs.append(self._fit(
                 bucket.X, bucket.labels, off_b, bucket.weights, x0_b,
-                obj, jnp.full(d_b, l1, acc), solver, donate))
+                obj, jnp.full(d_b, l1, acc), solver, donate,
+                fault_tag=str(bi)))
         # bucket-major concatenation IS the global entity order; pad each
         # bucket's D_b out to the global reduced_dim
         coefs = jnp.concatenate([
@@ -657,12 +997,28 @@ def score_passive(passive_X: Array, passive_entity: Array, coefs: Array,
         num_segments=num_samples + 1)[:num_samples]
 
 
-def score_random_effect(dataset: RandomEffectDataset, coefs: Array) -> Array:
+def score_random_effect(dataset: RandomEffectDataset, coefs: Array,
+                        entity_shards: int = 1) -> Array:
     """Full sample-axis score vector (active + passive) for this coordinate.
 
     ``coefs`` is the compact global block ``[num_entities, reduced_dim]``;
     bucketed datasets score per bucket (row sets are disjoint, so the
-    per-bucket scatters sum without overlap)."""
+    per-bucket scatters sum without overlap). With ``entity_shards`` > 1
+    (and the same engagement conditions as the sharded solve), each
+    block's scoring runs shard-local and the per-shard partial score
+    vectors reduce with an on-device psum over the entity axis — the
+    replicated result feeds the CD fused epilogue with zero added host
+    syncs. Shard-count 1 is the unchanged single-program path."""
+
+    def _score_block(X, c_b, row_ids, weights):
+        mesh, K = _resolve_entity_shards(entity_shards, int(X.shape[0]))
+        if K > 1:
+            with trace.span("re.shard_score", shards=K,
+                            lanes=int(X.shape[0])):
+                return _sharded_score_fn(mesh, int(dataset.num_samples))(
+                    X, c_b, row_ids, weights)
+        return score_active(X, c_b, row_ids, weights, dataset.num_samples)
+
     if dataset.buckets is not None:
         s = jnp.zeros(dataset.num_samples, jnp.float32)
         for bucket in dataset.buckets:
@@ -670,11 +1026,10 @@ def score_random_effect(dataset: RandomEffectDataset, coefs: Array) -> Array:
             nr, start = bucket.num_real, bucket.entity_start
             c_b = jnp.zeros((e_b, d_b), coefs.dtype)
             c_b = c_b.at[:nr].set(coefs[start:start + nr, :d_b])
-            s = s + score_active(bucket.X, c_b, bucket.row_ids,
-                                 bucket.weights, dataset.num_samples)
+            s = s + _score_block(bucket.X, c_b, bucket.row_ids,
+                                 bucket.weights)
     else:
-        s = score_active(dataset.X, coefs, dataset.row_ids, dataset.weights,
-                         dataset.num_samples)
+        s = _score_block(dataset.X, coefs, dataset.row_ids, dataset.weights)
     if dataset.num_passive:
         s = s + score_passive(dataset.passive_X, dataset.passive_entity,
                               coefs, dataset.passive_row_ids,

@@ -21,6 +21,7 @@ from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 Array = jnp.ndarray
 
@@ -67,7 +68,8 @@ def solver_x0(acc_dtype, shape, initial: Optional[Array]) -> Array:
     return initial.astype(jnp.promote_types(acc_dtype, initial.dtype))
 
 
-def finite_step(accepted: Array, f: Array, g: Array) -> Array:
+def finite_step(accepted: Array, f: Array, g: Array,
+                axis_name: Optional[str] = None) -> Array:
     """Combine a step-acceptance flag with a non-finite guard.
 
     A NaN/Inf objective or gradient must never enter the accepted solver
@@ -75,8 +77,17 @@ def finite_step(accepted: Array, f: Array, g: Array) -> Array:
     good iterate instead of poisoning the whole carry (and, under vmap,
     every entity lane reduced with it). Every solver body routes its
     accept flag through here.
+
+    ``axis_name``: when the weight update is sharded over a mesh axis,
+    ``g`` is a shard and the finite verdict must be replica-uniform (one
+    replica's while_loop stopping early while another continues would
+    desynchronize the collectives inside the loop body) — the local
+    verdict is all-reduced over the axis.
     """
-    return accepted & jnp.isfinite(f) & jnp.all(jnp.isfinite(g))
+    fin = jnp.isfinite(f) & jnp.all(jnp.isfinite(g))
+    if axis_name is not None:
+        fin = lax.psum(jnp.int32(~fin), axis_name) == 0
+    return accepted & fin
 
 
 def project_box(x: Array, box: Optional[BoxConstraints]) -> Array:
@@ -284,6 +295,39 @@ class LaneCompactionState:
         record_host_fetch(site="re.compact_mask")
         local = np.nonzero(unconverged)[0].astype(np.int32)
         return idx[unconverged], local
+
+    def absorb_padded(self, idx: np.ndarray, mask: np.ndarray, c: Array,
+                      it: Array, v: Array, k: Array,
+                      max_iterations_code: int
+                      ) -> tuple[np.ndarray, np.ndarray]:
+        """Mesh-sharded-chunk variant of :meth:`absorb`: the dispatch lanes
+        arrive in per-shard padded layout (flat ``[K * L]``), where a pad
+        slot duplicates a real lane of the SAME shard — identical data,
+        carry and anchors mean an identical solve, so the duplicate
+        ``.set`` writes are value-equal and benign. ``idx`` maps every
+        flat slot to its global lane id and ``mask`` flags the real
+        slots; iteration counts from pad slots are zeroed before the
+        scatter-add so duplicates never double-count. Returns
+        ``(global_ids, flat_positions)`` of the real lanes that hit the
+        budget, exactly like :meth:`absorb`. Still exactly ONE blocking
+        device→host fetch (the unconverged mask)."""
+        import jax
+
+        from photon_ml_tpu.utils.sync_telemetry import record_host_fetch
+
+        idx_dev = jax.device_put(idx)
+        mask_dev = jax.device_put(mask)
+        self.coefs = self.coefs.at[idx_dev].set(c)
+        self.iterations = self.iterations.at[idx_dev].add(
+            jnp.where(mask_dev, it, 0))
+        self.values = self.values.at[idx_dev].set(v)
+        self.codes = self.codes.at[idx_dev].set(k)
+        unconverged = np.asarray(
+            jax.device_get(k == max_iterations_code))
+        record_host_fetch(site="re.compact_mask")
+        real = mask & unconverged
+        local = np.nonzero(real)[0].astype(np.int32)
+        return idx[real], local
 
     def results(self) -> tuple[Array, Array, Array, Array]:
         return self.coefs, self.iterations, self.values, self.codes

@@ -81,17 +81,40 @@ class LBFGSResume(NamedTuple):
     g0n: Array  # original-dispatch anchor ‖g₀‖
 
 
+def axis_dot(axis_name: Optional[str]):
+    """d-vector dot product, all-reduced over ``axis_name`` when the
+    vectors are shards of a mesh-partitioned weight update (arXiv
+    2004.13336): each replica holds a slice of x/g/S/Y, so every inner
+    product in the solver must psum its local partial."""
+    if axis_name is None:
+        return jnp.dot
+    return lambda a, b: lax.psum(jnp.dot(a, b), axis_name)
+
+
+def axis_norm(axis_name: Optional[str]):
+    """d-vector 2-norm, all-reduced over ``axis_name`` (see axis_dot)."""
+    if axis_name is None:
+        return jnp.linalg.norm
+    return lambda a: jnp.sqrt(lax.psum(jnp.sum(a * a), axis_name))
+
+
 def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
-                       head: Array) -> Array:
-    """Two-loop recursion over a masked circular history buffer."""
+                       head: Array,
+                       axis_name: Optional[str] = None) -> Array:
+    """Two-loop recursion over a masked circular history buffer.
+
+    With ``axis_name`` set, g/S/Y are per-replica shards and every inner
+    product is psum'd — the recursion then produces this replica's shard
+    of the exact full-dimension direction."""
     m = S.shape[0]
+    vdot = axis_dot(axis_name)
 
     # Order slots newest -> oldest: head-1, head-2, ...
     idx = (head - 1 - jnp.arange(m)) % m
 
     def first_loop(carry, i):
         q = carry
-        a_i = jnp.where(valid[i], rho[i] * jnp.dot(S[i], q), 0.0)
+        a_i = jnp.where(valid[i], rho[i] * vdot(S[i], q), 0.0)
         q = q - a_i * Y[i]
         return q, a_i
 
@@ -99,15 +122,15 @@ def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
 
     # Initial Hessian scaling gamma = s.y / y.y from the newest valid pair.
     newest = (head - 1) % m
-    sy = jnp.dot(S[newest], Y[newest])
-    yy = jnp.dot(Y[newest], Y[newest])
+    sy = vdot(S[newest], Y[newest])
+    yy = vdot(Y[newest], Y[newest])
     gamma = jnp.where(valid[newest] & (yy > 0), sy / jnp.maximum(yy, 1e-300), 1.0)
     r = gamma * q
 
     def second_loop(carry, ia):
         r = carry
         i, a_i = ia
-        beta = jnp.where(valid[i], rho[i] * jnp.dot(Y[i], r), 0.0)
+        beta = jnp.where(valid[i], rho[i] * vdot(Y[i], r), 0.0)
         r = r + S[i] * (a_i - beta)
         return r, None
 
@@ -116,7 +139,7 @@ def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
     return -r
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 9))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 9, 10))
 def _minimize_lbfgs_impl(
     value_and_grad_fn,
     x0: Array,
@@ -128,6 +151,7 @@ def _minimize_lbfgs_impl(
     track_iterates: bool = False,
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
+    update_axis_name: Optional[str] = None,
 ):
     # ``data`` is a traced pytree (the batch): one compiled kernel per
     # function object serves every batch of the same shape — critical for the
@@ -140,12 +164,23 @@ def _minimize_lbfgs_impl(
     # search is bit-identical to the uninterrupted loop's at the same
     # global iteration (only ``it``/the history buffer restart at 0 —
     # they are chunk-local bookkeeping).
+    # ``update_axis_name``: x0/g are per-replica shards of the weight
+    # vector; every d-vector reduction is psum'd so the sharded solve is
+    # the exact full-dimension recursion (arXiv 2004.13336). Box
+    # projection and iterate tracking would need full vectors per step —
+    # unsupported in sharded-update mode (callers fall back).
+    if update_axis_name is not None and (box is not None or track_iterates):
+        raise ValueError(
+            "sharded weight update supports neither box constraints nor "
+            "track_iterates")
+    vdot = axis_dot(update_axis_name)
+    vnorm = axis_norm(update_axis_name)
     d = x0.shape[0]
     dtype = x0.dtype
     if resume is None:
         f_start, g_start = value_and_grad_fn(x0, data)
         anchor_f0 = f_start
-        anchor_g0n = jnp.linalg.norm(g_start)
+        anchor_g0n = vnorm(g_start)
         x_start = x0
         prev_f0 = f_start + jnp.asarray(jnp.inf, dtype)
         S0 = jnp.zeros((m, d), dtype)
@@ -163,7 +198,7 @@ def _minimize_lbfgs_impl(
     values = jnp.full(max_iter + 1, jnp.nan, dtype)
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype)
     values = values.at[0].set(f_start)
-    grad_norms = grad_norms.at[0].set(jnp.linalg.norm(g_start))
+    grad_norms = grad_norms.at[0].set(vnorm(g_start))
     iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x_start)
                  if track_iterates else None)
 
@@ -177,24 +212,25 @@ def _minimize_lbfgs_impl(
 
     def cond(c: _LBFGSCarry) -> Array:
         return should_continue(
-            c.it, c.f, c.prev_f, jnp.linalg.norm(c.g),
+            c.it, c.f, c.prev_f, vnorm(c.g),
             anchor_f0, anchor_g0n,
             max_iter, tolerance, c.made_progress,
             resumed=resume is not None,
         )
 
     def body(c: _LBFGSCarry) -> _LBFGSCarry:
-        direction = two_loop_direction(c.g, c.S, c.Y, c.rho, c.valid, c.head)
-        dphi0 = jnp.dot(c.g, direction)
+        direction = two_loop_direction(c.g, c.S, c.Y, c.rho, c.valid, c.head,
+                                       update_axis_name)
+        dphi0 = vdot(c.g, direction)
         # Safeguard: fall back to steepest descent if not a descent direction.
         bad = dphi0 >= 0.0
         direction = jnp.where(bad, -c.g, direction)
-        dphi0 = jnp.where(bad, -jnp.dot(c.g, c.g), dphi0)
+        dphi0 = jnp.where(bad, -vdot(c.g, c.g), dphi0)
 
         def phi(a):
             x_a = c.x + a * direction
             f_a, g_a = value_and_grad_fn(x_a, data)
-            return f_a, jnp.dot(g_a, direction), g_a
+            return f_a, vdot(g_a, direction), g_a
 
         # Breeze convention: first iteration starts at 1/||d||, then 1.0.
         # A chunk-resumed solve is never at its true first iteration —
@@ -202,7 +238,7 @@ def _minimize_lbfgs_impl(
         if resume is None:
             init_alpha = jnp.where(
                 c.it == 0,
-                1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
+                1.0 / jnp.maximum(vnorm(direction), 1.0),
                 jnp.asarray(1.0, dtype),
             )
         else:
@@ -222,11 +258,11 @@ def _minimize_lbfgs_impl(
 
         # A step into a non-finite region is never accepted: the solver
         # stops at the last good iterate (ObjectiveNotImproving).
-        ok = finite_step(ls.ok, f_new, g_new)
+        ok = finite_step(ls.ok, f_new, g_new, update_axis_name)
 
         s = x_new - c.x
         y = g_new - c.g
-        sy = jnp.dot(s, y)
+        sy = vdot(s, y)
         store = ok & (sy > 1e-10)
 
         S = jnp.where(store, c.S.at[c.head].set(s), c.S)
@@ -239,7 +275,7 @@ def _minimize_lbfgs_impl(
         it_new = c.it + 1
         values = c.values.at[it_new].set(jnp.where(ok, f_new, c.f))
         grad_norms = c.grad_norms.at[it_new].set(
-            jnp.linalg.norm(jnp.where(ok, g_new, c.g)))
+            vnorm(jnp.where(ok, g_new, c.g)))
         x_acc = jnp.where(ok, x_new, c.x)
         iterates = (c.iterates.at[it_new].set(x_acc)
                     if track_iterates else None)
@@ -278,6 +314,7 @@ def minimize_lbfgs(
     track_iterates: bool = False,
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
+    update_axis_name: Optional[str] = None,
 ):
     """Minimize ``f(x, data)`` from ``x0``; returns (x, RunHistory, made_progress).
 
@@ -299,8 +336,8 @@ def minimize_lbfgs(
     return obs_compile.call(
         "optimizer.lbfgs", _minimize_lbfgs_impl,
         (value_and_grad_fn, x0, data, max_iter, m, tolerance, box,
-         track_iterates, resume, return_carry),
-        static_argnums=(0, 3, 4, 5, 7, 9),
+         track_iterates, resume, return_carry, update_axis_name),
+        static_argnums=(0, 3, 4, 5, 7, 9, 10),
         arg_names=("value_and_grad_fn", "x0", "data", "max_iter", "m",
                    "tolerance", "box", "track_iterates", "resume",
-                   "return_carry"))
+                   "return_carry", "update_axis_name"))

@@ -34,7 +34,12 @@ from photon_ml_tpu.optimize.common import (
     project_box,
     should_continue,
 )
-from photon_ml_tpu.optimize.lbfgs import LBFGSResume, two_loop_direction
+from photon_ml_tpu.optimize.lbfgs import (
+    LBFGSResume,
+    axis_dot,
+    axis_norm,
+    two_loop_direction,
+)
 
 Array = jnp.ndarray
 
@@ -70,7 +75,7 @@ class _OWLQNCarry(NamedTuple):
     iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8, 10))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8, 10, 11))
 def _minimize_owlqn_impl(
     value_and_grad_fn,
     x0: Array,
@@ -83,14 +88,27 @@ def _minimize_owlqn_impl(
     track_iterates: bool = False,
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
+    update_axis_name: Optional[str] = None,
 ):
+    # Sharded weight update (see lbfgs): x0/g/l1 are per-replica shards,
+    # every d-vector reduction (including the L1 penalty sum) is psum'd.
+    # Orthant projections and the pseudo-gradient stay elementwise.
+    if update_axis_name is not None and (box is not None or track_iterates):
+        raise ValueError(
+            "sharded weight update supports neither box constraints nor "
+            "track_iterates")
+    vdot = axis_dot(update_axis_name)
+    vnorm = axis_norm(update_axis_name)
     d = x0.shape[0]
     dtype = x0.dtype
     l1 = jnp.broadcast_to(jnp.asarray(l1, dtype), (d,))
 
     def full_objective(x):
         f, g = value_and_grad_fn(x, data)
-        return f + jnp.sum(l1 * jnp.abs(x)), g
+        penalty = jnp.sum(l1 * jnp.abs(x))
+        if update_axis_name is not None:
+            penalty = lax.psum(penalty, update_axis_name)
+        return f + penalty, g
 
     # ``resume`` continues a previous chunk's solve verbatim: carry
     # (iterate, SMOOTH-gradient curvature pairs, prev F) plus the ORIGINAL
@@ -99,7 +117,7 @@ def _minimize_owlqn_impl(
     if resume is None:
         f_start, g_start = full_objective(x0)
         anchor_f0 = f_start
-        anchor_g0n = jnp.linalg.norm(pseudo_gradient(x0, g_start, l1))
+        anchor_g0n = vnorm(pseudo_gradient(x0, g_start, l1))
         x_start = x0
         prev_f0 = f_start + jnp.asarray(jnp.inf, dtype)
         S0 = jnp.zeros((m, d), dtype)
@@ -117,7 +135,7 @@ def _minimize_owlqn_impl(
     pg_start = pseudo_gradient(x_start, g_start, l1)
     values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f_start)
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(
-        jnp.linalg.norm(pg_start))
+        vnorm(pg_start))
     iterates0 = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x_start)
                  if track_iterates else None)
 
@@ -132,7 +150,7 @@ def _minimize_owlqn_impl(
     def cond(c: _OWLQNCarry) -> Array:
         pg = pseudo_gradient(c.x, c.g, l1)
         return should_continue(
-            c.it, c.f, c.prev_f, jnp.linalg.norm(pg),
+            c.it, c.f, c.prev_f, vnorm(pg),
             anchor_f0, anchor_g0n,
             max_iter, tolerance, c.made_progress,
             resumed=resume is not None,
@@ -140,7 +158,8 @@ def _minimize_owlqn_impl(
 
     def body(c: _OWLQNCarry) -> _OWLQNCarry:
         pg = pseudo_gradient(c.x, c.g, l1)
-        direction = two_loop_direction(pg, c.S, c.Y, c.rho, c.valid, c.head)
+        direction = two_loop_direction(pg, c.S, c.Y, c.rho, c.valid, c.head,
+                                       update_axis_name)
         # Project direction onto the orthant of -pg (keep only components
         # that actually descend along the pseudo-gradient).
         direction = jnp.where(direction * pg < 0.0, direction, 0.0)
@@ -162,7 +181,7 @@ def _minimize_owlqn_impl(
         if resume is None:
             init_alpha = jnp.where(
                 c.it == 0,
-                1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0),
+                1.0 / jnp.maximum(vnorm(direction), 1.0),
                 jnp.asarray(1.0, dtype),
             )
         else:
@@ -177,7 +196,7 @@ def _minimize_owlqn_impl(
             a, _, _, _, k, _ = state
             x_a = project_trial(c.x + a * direction)
             f_a, g_a = full_objective(x_a)
-            accepted = f_a <= c.f + _LS_C1 * jnp.dot(pg, x_a - c.x)
+            accepted = f_a <= c.f + _LS_C1 * vdot(pg, x_a - c.x)
             a_next = jnp.where(accepted, a, a * 0.5)
             return a_next, f_a, g_a, x_a, k + 1, accepted
 
@@ -186,11 +205,11 @@ def _minimize_owlqn_impl(
             (init_alpha, c.f, c.g, c.x, jnp.int32(0), jnp.bool_(False)),
         )
         # Non-finite trial values never enter the carry (divergence guard).
-        accepted = finite_step(accepted, f_new, g_new)
+        accepted = finite_step(accepted, f_new, g_new, update_axis_name)
 
         s = x_new - c.x
         y = g_new - c.g  # smooth gradient difference
-        sy = jnp.dot(s, y)
+        sy = vdot(s, y)
         store = accepted & (sy > 1e-10)
 
         S = jnp.where(store, c.S.at[c.head].set(s), c.S)
@@ -203,7 +222,7 @@ def _minimize_owlqn_impl(
         it_new = c.it + 1
         pg_new = pseudo_gradient(x_new, g_new, l1)
         values = c.values.at[it_new].set(jnp.where(accepted, f_new, c.f))
-        grad_norms = c.grad_norms.at[it_new].set(jnp.linalg.norm(
+        grad_norms = c.grad_norms.at[it_new].set(vnorm(
             jnp.where(accepted, pg_new, pg)))
         x_acc = jnp.where(accepted, x_new, c.x)
         iterates = (c.iterates.at[it_new].set(x_acc)
@@ -244,6 +263,7 @@ def minimize_owlqn(
     track_iterates: bool = False,
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
+    update_axis_name: Optional[str] = None,
 ):
     """Minimize f(x, data) + l1 ||x||_1; returns (x, RunHistory, made_progress).
 
@@ -257,8 +277,8 @@ def minimize_owlqn(
     return obs_compile.call(
         "optimizer.owlqn", _minimize_owlqn_impl,
         (value_and_grad_fn, x0, data, max_iter, m, tolerance, l1, box,
-         track_iterates, resume, return_carry),
-        static_argnums=(0, 3, 4, 5, 8, 10),
+         track_iterates, resume, return_carry, update_axis_name),
+        static_argnums=(0, 3, 4, 5, 8, 10, 11),
         arg_names=("value_and_grad_fn", "x0", "data", "max_iter", "m",
                    "tolerance", "l1", "box", "track_iterates", "resume",
-                   "return_carry"))
+                   "return_carry", "update_axis_name"))

@@ -81,6 +81,14 @@ class GLMOptimizationProblem:
     # Record per-iteration coefficient snapshots in the result (the
     # reference's ModelTracker.models, consumed by --validate-per-iteration).
     track_iterates: bool = False
+    # Shard the optimizer state + coefficient update over the mesh data
+    # axis (arXiv 2004.13336): each replica updates only its coefficient
+    # shard and all-gathers the result, instead of every replica running
+    # the full-dimension update redundantly. Only engages on the
+    # shard_map backend with a >1 data axis; incompatible with box
+    # constraints and track_iterates (falls back to the replicated
+    # update there).
+    shard_weight_update: bool = False
 
     def __post_init__(self):
         if (self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
@@ -104,34 +112,48 @@ class GLMOptimizationProblem:
 
     # -- solve ---------------------------------------------------------------
 
-    def solve(self, obj: GLMObjective, batch: Batch, x0: Array):
+    def solve(self, obj: GLMObjective, batch: Batch, x0: Array,
+              update_axis_name: Optional[str] = None,
+              vg_fn=None, hvp_fn=None, l1_mask: Optional[Array] = None):
         """Optimizer dispatch → (x, RunHistory, progressed). Pure-jax: safe
         to call under jit/shard_map (parallel/distributed.py wraps it with
-        a per-shard batch and a psum-ing objective)."""
+        a per-shard batch and a psum-ing objective).
+
+        ``update_axis_name``/``vg_fn``/``hvp_fn``/``l1_mask``: the sharded
+        weight-update backend (parallel/distributed._sharded callers)
+        passes a per-replica ``x0`` shard, gather/slice-wrapped objective
+        callables, and a pre-sliced L1 mask; every d-vector reduction
+        inside the solver then psums over the axis."""
         cfg = self.config
         payload = (obj, batch)
+        vg = _objective_vg if vg_fn is None else vg_fn
+        hvp = _objective_hvp if hvp_fn is None else hvp_fn
+        mask = self.l1_mask if l1_mask is None else l1_mask
         dim = x0.shape[-1]
         l1 = cfg.regularization_context.l1_weight(cfg.regularization_weight)
         use_owlqn = (cfg.optimizer_type == OptimizerType.LBFGS and l1 > 0.0)
 
         if use_owlqn:
             l1_arr = jnp.full(dim, l1, x0.dtype)
-            if self.l1_mask is not None:
-                l1_arr = l1_arr * self.l1_mask.astype(x0.dtype)
+            if mask is not None:
+                l1_arr = l1_arr * mask.astype(x0.dtype)
             return minimize_owlqn(
-                _objective_vg, x0, payload, l1=l1_arr,
+                vg, x0, payload, l1=l1_arr,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
-                box=self.box, track_iterates=self.track_iterates)
+                box=self.box, track_iterates=self.track_iterates,
+                update_axis_name=update_axis_name)
         if cfg.optimizer_type == OptimizerType.LBFGS:
             return minimize_lbfgs(
-                _objective_vg, x0, payload,
+                vg, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
-                box=self.box, track_iterates=self.track_iterates)
+                box=self.box, track_iterates=self.track_iterates,
+                update_axis_name=update_axis_name)
         if cfg.optimizer_type == OptimizerType.TRON:
             return minimize_tron(
-                _objective_vg, _objective_hvp, x0, payload,
+                vg, hvp, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
-                box=self.box, track_iterates=self.track_iterates)
+                box=self.box, track_iterates=self.track_iterates,
+                update_axis_name=update_axis_name)
         raise ValueError(f"unknown optimizer {cfg.optimizer_type}")
 
     def publish(self, x: Array, history, progressed,

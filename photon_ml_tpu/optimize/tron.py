@@ -36,6 +36,7 @@ from photon_ml_tpu.optimize.common import (
     project_box,
     should_continue,
 )
+from photon_ml_tpu.optimize.lbfgs import axis_dot, axis_norm
 
 Array = jnp.ndarray
 
@@ -57,38 +58,43 @@ class _CGState(NamedTuple):
     r_tr: Array
 
 
-def _truncated_cg(hvp, gradient: Array, delta: Array) -> tuple[Array, Array, Array]:
+def _truncated_cg(hvp, gradient: Array, delta: Array,
+                  axis_name: Optional[str] = None) -> tuple[Array, Array, Array]:
     """Approximately solve H s = -g within ||s|| <= delta.
 
     Returns (cg_iterations, step, residual). ``hvp(v)`` computes H v.
+    With ``axis_name`` set, gradient/step are per-replica shards and every
+    inner product is psum'd (see lbfgs.axis_dot).
     """
-    tol = 0.1 * jnp.linalg.norm(gradient)
+    vdot = axis_dot(axis_name)
+    vnorm = axis_norm(axis_name)
+    tol = 0.1 * vnorm(gradient)
     r0 = -gradient
 
     init = _CGState(
         it=jnp.int32(0), done=jnp.bool_(False),
         step=jnp.zeros_like(gradient), residual=r0, direction=r0,
-        r_tr=jnp.dot(r0, r0),
+        r_tr=vdot(r0, r0),
     )
 
     def cond(s: _CGState) -> Array:
         return (s.it < MAX_CG_ITERATIONS) & ~s.done
 
     def body(s: _CGState) -> _CGState:
-        converged = jnp.linalg.norm(s.residual) <= tol
+        converged = vnorm(s.residual) <= tol
 
         def advance(s: _CGState) -> _CGState:
             hd = hvp(s.direction)
-            alpha = s.r_tr / jnp.dot(s.direction, hd)
+            alpha = s.r_tr / vdot(s.direction, hd)
             step = s.step + alpha * s.direction
-            outside = jnp.linalg.norm(step) > delta
+            outside = vnorm(step) > delta
 
             def hit_boundary(_):
                 # Back up to the region boundary: solve ||step0 + t d|| = delta
                 step0 = s.step
-                std = jnp.dot(step0, s.direction)
-                sts = jnp.dot(step0, step0)
-                dtd = jnp.dot(s.direction, s.direction)
+                std = vdot(step0, s.direction)
+                sts = vdot(step0, step0)
+                dtd = vdot(s.direction, s.direction)
                 dsq = delta * delta
                 rad = jnp.sqrt(std * std + dtd * (dsq - sts))
                 t = jnp.where(std >= 0.0, (dsq - sts) / (std + rad),
@@ -100,7 +106,7 @@ def _truncated_cg(hvp, gradient: Array, delta: Array) -> tuple[Array, Array, Arr
 
             def interior(_):
                 residual = s.residual - alpha * hd
-                r_new = jnp.dot(residual, residual)
+                r_new = vdot(residual, residual)
                 beta = r_new / s.r_tr
                 direction = residual + beta * s.direction
                 return s._replace(it=s.it + 1, step=step, residual=residual,
@@ -146,7 +152,7 @@ class TRONResume(NamedTuple):
     g0n: Array
 
 
-@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8, 10))
+@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8, 10, 11))
 def _minimize_tron_impl(
     value_and_grad_fn,
     hvp_fn,
@@ -159,12 +165,22 @@ def _minimize_tron_impl(
     track_iterates: bool = False,
     resume: Optional[TRONResume] = None,
     return_carry: bool = False,
+    update_axis_name: Optional[str] = None,
 ):
+    # Sharded weight update (see lbfgs): x0/g are per-replica shards, CG
+    # and region arithmetic psum every d-vector reduction. hvp_fn must
+    # accept/return shards (the caller's wrapper all-gathers v).
+    if update_axis_name is not None and (box is not None or track_iterates):
+        raise ValueError(
+            "sharded weight update supports neither box constraints nor "
+            "track_iterates")
+    vdot = axis_dot(update_axis_name)
+    vnorm = axis_norm(update_axis_name)
     dtype = x0.dtype
     if resume is None:
         f_start, g_start = value_and_grad_fn(x0, data)
         anchor_f0 = f_start
-        anchor_g0n = jnp.linalg.norm(g_start)
+        anchor_g0n = vnorm(g_start)
         x_start = x0
         prev_f0 = f_start + jnp.asarray(jnp.inf, dtype)
         delta0 = anchor_g0n
@@ -177,7 +193,7 @@ def _minimize_tron_impl(
 
     values = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(f_start)
     grad_norms = jnp.full(max_iter + 1, jnp.nan, dtype).at[0].set(
-        jnp.linalg.norm(g_start))
+        vnorm(g_start))
     iterates0 = (jnp.zeros((max_iter + 1,) + x_start.shape, dtype)
                  .at[0].set(x_start) if track_iterates else None)
 
@@ -190,7 +206,7 @@ def _minimize_tron_impl(
 
     def cond(c: _TRONCarry) -> Array:
         return should_continue(
-            c.it, c.f, c.prev_f, jnp.linalg.norm(c.g),
+            c.it, c.f, c.prev_f, vnorm(c.g),
             anchor_f0, anchor_g0n,
             max_iter, tolerance, c.made_progress,
             resumed=resume is not None,
@@ -198,11 +214,11 @@ def _minimize_tron_impl(
 
     def body(c: _TRONCarry) -> _TRONCarry:
         _, step, residual = _truncated_cg(
-            lambda v: hvp_fn(c.x, v, data), c.g, c.delta)
+            lambda v: hvp_fn(c.x, v, data), c.g, c.delta, update_axis_name)
 
         x_try = c.x + step
-        gs = jnp.dot(c.g, step)
-        predicted = -0.5 * (gs - jnp.dot(step, residual))
+        gs = vdot(c.g, step)
+        predicted = -0.5 * (gs - vdot(step, residual))
         f_try, g_try = value_and_grad_fn(x_try, data)
         # A non-finite trial objective is "infinitely bad" for the region
         # arithmetic: every where-comparison on a NaN is False, which
@@ -213,7 +229,7 @@ def _minimize_tron_impl(
         f_arith = jnp.where(jnp.isfinite(f_try), f_try,
                             jnp.asarray(jnp.inf, dtype))
         actual = c.f - f_arith
-        step_norm = jnp.linalg.norm(step)
+        step_norm = vnorm(step)
 
         # First iteration: tighten the initial region to the step scale.
         # A chunk-resumed solve carries its live region — never re-tighten.
@@ -249,7 +265,8 @@ def _minimize_tron_impl(
         # Non-finite trial values count as an improvement failure (the NaN
         # comparison already rejects f_try; the explicit guard also keeps a
         # NaN gradient out of the accepted state).
-        improved = finite_step(actual > _ETA0 * predicted, f_try, g_try)
+        improved = finite_step(actual > _ETA0 * predicted, f_try, g_try,
+                               update_axis_name)
         x_new = jnp.where(improved, project_box(x_try, box) if box is not None
                           else x_try, c.x)
         if box is not None:
@@ -267,7 +284,7 @@ def _minimize_tron_impl(
             improved, c.values.at[c.it + 1].set(f_try), c.values)
         grad_norms = jnp.where(
             improved,
-            c.grad_norms.at[c.it + 1].set(jnp.linalg.norm(g_try)), c.grad_norms)
+            c.grad_norms.at[c.it + 1].set(vnorm(g_try)), c.grad_norms)
         # unconditional write: when not improved, x_new == c.x and it does
         # not advance, so the slot is overwritten by the next accepted step
         # or sliced off by from_history — no whole-buffer select needed
@@ -307,6 +324,7 @@ def minimize_tron(
     track_iterates: bool = False,
     resume: Optional[TRONResume] = None,
     return_carry: bool = False,
+    update_axis_name: Optional[str] = None,
 ):
     """Trust-region Newton; returns (x, RunHistory, made_progress).
 
@@ -322,8 +340,9 @@ def minimize_tron(
     return obs_compile.call(
         "optimizer.tron", _minimize_tron_impl,
         (value_and_grad_fn, hvp_fn, x0, data, max_iter, tolerance,
-         max_failures, box, track_iterates, resume, return_carry),
-        static_argnums=(0, 1, 4, 5, 6, 8, 10),
+         max_failures, box, track_iterates, resume, return_carry,
+         update_axis_name),
+        static_argnums=(0, 1, 4, 5, 6, 8, 10, 11),
         arg_names=("value_and_grad_fn", "hvp_fn", "x0", "data", "max_iter",
                    "tolerance", "max_failures", "box", "track_iterates",
-                   "resume", "return_carry"))
+                   "resume", "return_carry", "update_axis_name"))

@@ -32,10 +32,12 @@ tests/test_mesh_routing.py's paired f64/f32 parity tests.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 try:  # moved across jax versions
@@ -74,6 +76,13 @@ def run_glm_shard_map(
     mesh ``data`` axis. Works for any row-major batch layout (DenseBatch,
     EllBatch — every array leaf has rows leading). Rows not divisible by
     the data-axis size are padded with zero-weight rows here.
+
+    With ``problem.shard_weight_update`` set, the optimizer state and the
+    coefficient update are additionally sharded over the SAME data axis
+    (arXiv 2004.13336): each replica all-gathers the iterate for the
+    objective evaluation, keeps only its gradient/coefficient shard, and
+    the converged shard is all-gathered once at the end — instead of
+    every replica running the full-dimension two-loop/CG redundantly.
     """
     n_shards = mesh.shape[DATA_AXIS]
     rows = batch.labels.shape[0]
@@ -85,12 +94,23 @@ def run_glm_shard_map(
     x0 = solver_x0(batch.acc_dtype, dim, initial)
     # psum-ing objective: every reduction crosses the data axis.
     obj = dataclasses.replace(problem.objective(), axis_name=DATA_AXIS)
-
-    def local_fit(shard, x0_rep):
-        x, history, progressed = problem.solve(obj, shard, x0_rep)
-        return x, history, progressed
-
     row_specs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), batch)
+
+    shard_update = problem.shard_weight_update
+    if shard_update and (problem.box is not None or problem.track_iterates):
+        logging.getLogger(__name__).warning(
+            "shard_weight_update is incompatible with box constraints / "
+            "track_iterates; falling back to the replicated update")
+        shard_update = False
+
+    if shard_update:
+        local_fit = _sharded_update_local_fit(problem, obj, dim, n_shards,
+                                              x0.dtype)
+    else:
+        def local_fit(shard, x0_rep):
+            x, history, progressed = problem.solve(obj, shard, x0_rep)
+            return x, history, progressed
+
     # grads are psum-identical on every device, but the replication checker
     # can't prove it through the while_loop — checking is disabled.
     fit = _shard_map(
@@ -103,3 +123,53 @@ def run_glm_shard_map(
     # Variances/publication run on the full (GSPMD-sharded) batch.
     return problem.publish(x, history, progressed, problem.objective(),
                            batch)
+
+
+def _sharded_update_local_fit(problem: GLMOptimizationProblem, obj,
+                              dim: int, n_shards: int, dtype):
+    """Build the per-replica body of a weight-update-sharded GLM fit.
+
+    The coefficient vector is zero-padded to a multiple of ``n_shards``
+    and split evenly; padded coordinates provably stay 0 (their gradient
+    is identically 0, and OWL-QN's pseudo-gradient at x=0, g=0, l1>=0 is
+    0), so padding never perturbs the solve. The solver itself runs with
+    ``update_axis_name`` set, psum-ing every d-vector reduction, which
+    makes the sharded recursion exactly the full-dimension one up to
+    reduction order.
+    """
+    d_pad = pad_rows_to_multiple(dim, n_shards)
+    shard_d = d_pad // n_shards
+
+    def gather_full(x_shard):
+        return lax.all_gather(x_shard, DATA_AXIS, tiled=True)[:dim]
+
+    def slice_own(full_vec):
+        start = lax.axis_index(DATA_AXIS) * shard_d
+        return lax.dynamic_slice(jnp.pad(full_vec, (0, d_pad - dim)),
+                                 (start,), (shard_d,))
+
+    def vg(x_shard, payload):
+        obj_p, data = payload
+        f, g = obj_p.calculate(gather_full(x_shard), data)
+        return f, slice_own(g)
+
+    def hvp(x_shard, v_shard, payload):
+        obj_p, data = payload
+        hv = obj_p.hessian_vector(gather_full(x_shard),
+                                  gather_full(v_shard), data)
+        return slice_own(hv)
+
+    full_mask = (jnp.asarray(problem.l1_mask).astype(dtype)
+                 if problem.l1_mask is not None else None)
+
+    def local_fit(shard, x0_rep):
+        l1_mask = slice_own(full_mask) if full_mask is not None else None
+        x_shard, history, progressed = problem.solve(
+            obj, shard, slice_own(x0_rep),
+            update_axis_name=DATA_AXIS, vg_fn=vg, hvp_fn=hvp,
+            l1_mask=l1_mask)
+        # the paper's step: all-gather the updated shard once per solve,
+        # not per iteration — the full vector only rematerializes here.
+        return gather_full(x_shard), history, progressed
+
+    return local_fit

@@ -23,6 +23,7 @@ the same code compiles unchanged for a v5e-16 slice.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
@@ -52,14 +53,37 @@ def get_default_mesh() -> Optional[Mesh]:
     return _default_mesh
 
 
+def largest_entity_divisor(num_devices: int, requested: int) -> int:
+    """Largest divisor of ``num_devices`` that is <= ``requested``.
+
+    The mesh must factor as data x entity over all devices, so an entity
+    axis that doesn't divide the device count can't be honored exactly;
+    this is the deterministic fallback (always >= 1)."""
+    k = max(1, min(int(requested), int(num_devices)))
+    while num_devices % k != 0:
+        k -= 1
+    return k
+
+
 def setup_default_mesh(num_entity: int = 1) -> Optional[Mesh]:
     """Driver bootstrap: build an all-devices (data x entity) mesh and make
     it the process default. Single-device processes get no mesh (every
-    sharding is a no-op there)."""
-    if len(jax.devices()) <= 1:
+    sharding is a no-op there).
+
+    A requested ``num_entity`` that doesn't evenly divide the device count
+    falls back to the largest divisor that does (with a logged warning)
+    instead of failing the run — the driver's ``--re-entity-shards auto``
+    contract."""
+    n = len(jax.devices())
+    if n <= 1:
         set_default_mesh(None)
         return None
-    mesh = make_mesh(num_entity=num_entity)
+    granted = largest_entity_divisor(n, num_entity)
+    if granted != num_entity:
+        logging.getLogger(__name__).warning(
+            "entity axis %d does not divide %d devices; falling back to "
+            "%d entity shards", num_entity, n, granted)
+    mesh = make_mesh(num_entity=granted)
     set_default_mesh(mesh)
     return mesh
 

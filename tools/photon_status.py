@@ -294,6 +294,15 @@ def compute_status(records: list[dict]) -> dict:
                 snap = p.setdefault("_snap", {})
                 snap[name] = snap.get(name, 0.0) \
                     + (rec.get("value", 0.0) or 0.0)
+                if name == "re_shard_hbm_live_bytes":
+                    # keep the per-device breakdown (labelled by shard)
+                    # alongside the summed total — the --gang view
+                    # renders it so one device ballooning inside a
+                    # mesh-sharded RE solve is visible per member
+                    shard = (rec.get("labels") or {}).get("shard")
+                    if shard is not None:
+                        p.setdefault("_shard_hbm", {})[str(shard)] = \
+                            rec.get("value", 0.0) or 0.0
         elif kind == "run_end":
             p["run_end"] = rec
             p["totals"].update(rec.get("metric_totals") or {})
@@ -338,6 +347,10 @@ def compute_status(records: list[dict]) -> dict:
             "telemetry_dropped": totals.get("telemetry_dropped", 0),
             "hbm_live_bytes": totals.get("hbm_live_bytes"),
             "peak_hbm_bytes": (end or {}).get("peak_hbm_bytes"),
+            "re_entity_shards": (
+                int(totals["re_entity_shards"])
+                if totals.get("re_entity_shards") is not None else None),
+            "re_shard_hbm_live_bytes": p.pop("_shard_hbm", None),
             "stalls": totals.get("stalls", 0),
             "data_coverage": totals.get("data_coverage"),
             "stalled": bool(hb and hb.get("stalled")),
@@ -406,14 +419,24 @@ def format_gang(status: dict, source: str) -> str:
     # (or silently shedding telemetry) shows up here before it shows
     # up as skew or a stall
     header = (f"  {'proc':>6} {'hbm_live_bytes':>15} "
-              f"{'telemetry_dropped':>18}")
+              f"{'re_shards':>9} {'telemetry_dropped':>18}")
     lines.append(header)
     for i, p in sorted(status["processes"].items()):
         hbm = p.get("hbm_live_bytes")
+        shards = p.get("re_entity_shards")
         lines.append(
             f"  {'p%d' % i:>6} "
             f"{_fmt_bytes(hbm) if hbm is not None else '—':>15} "
+            f"{shards if shards is not None else '—':>9} "
             f"{p.get('telemetry_dropped', 0):>18.0f}")
+        # per-device HBM under a mesh-sharded RE solve: a skewed row
+        # here means one shard's entity blocks (or its padding) are
+        # out-sized relative to its peers
+        per_shard = p.get("re_shard_hbm_live_bytes") or {}
+        for dev, b in sorted(per_shard.items(),
+                             key=lambda kv: _as_int_label(kv[0]) or 0):
+            lines.append(f"  {'':>6}   shard[{dev}] "
+                         f"{_fmt_bytes(b)}")
     return "\n".join(lines)
 
 
