@@ -7,23 +7,39 @@ dies silently if nondeterminism leaks into a jitted region, and the
 README ``PHOTON_FAULTS`` table drifts from the actual ``fault_point()``
 sites without anything noticing. These are *structural* properties of
 the source (DrJAX frames the whole stack as program transformations), so
-this subpackage checks them statically over the entire tree:
+this subpackage checks them statically over the entire tree. The
+analysis is whole-program: a package index resolves imports, module
+constants, classes (methods, attribute types, bases) and a fixpoint
+over return values, so method calls on objects built in other modules
+join the dataflow and mesh axes declared anywhere ground-truth the
+collectives checked everywhere.
 
 - **W1xx sync discipline** — blocking device→host conversions
   (``float``/``int``/``bool``/``.item()``/``np.asarray``/
   ``jax.device_get``) applied to jax-array-producing expressions outside
   the instrumented fetch sites (``utils/sync_telemetry.py`` discipline).
-- **W2xx jit purity / retrace hazards** — impure calls (time, random,
-  I/O, logging) and Python branching on traced values inside
-  ``jax.jit``/``pjit``-ed functions and package-local functions
-  reachable from them.
+- **W2xx jit purity / trace hazards** — impure calls (time, random,
+  I/O, logging), Python branching on traced values, and host-callback
+  ordering under resume (unordered ``io_callback``, impure
+  ``pure_callback``) inside ``jax.jit``/``pjit``-ed functions and
+  package-local functions reachable from them.
 - **W3xx donation safety** — an argument passed at a ``donate_argnums``
-  call site must not be read again afterwards in the same function.
+  call site must not be read again afterwards in the same function,
+  including by the next iteration of an enclosing loop.
 - **W4xx fault-point drift** — ``fault_point("name")`` sites and the
   README ``PHOTON_FAULTS`` table must agree in both directions.
 - **W5xx checkpoint-schema drift** — snapshot fields written at
   ``CheckpointManager.save`` sites must match the fields read back on
   the restore/resume paths.
+- **W6xx collective safety** — collective axis names must come from a
+  real defining site (``Mesh`` ctor / ``pmap(axis_name=...)`` /
+  ``*_AXIS`` constant); no collectives under replica- or
+  host-divergent control flow; ``shard_map`` spec tuples must match
+  the callee's arity; ``PartitionSpec`` axes must exist.
+- **W7xx retrace risk** — data-dependent shapes (``len``/``.shape``)
+  flowing into jitted calls, and — given ``--trace-evidence`` —
+  ``xla.retrace`` span records from a real run mapped back to the
+  dispatch sites that caused them.
 
 Entry points: :func:`photon_ml_tpu.analysis.runner.lint` (library) and
 ``tools/photonlint.py`` (CLI). Per-line suppressions use

@@ -30,6 +30,8 @@ from typing import Iterable
 # the CLI's --list-rules and the README table are generated from here).
 RULES: dict[str, str] = {
     "W001": "malformed photonlint suppression comment",
+    "W002": "photonlint suppression that suppresses nothing (stale "
+            "directive)",
     "W101": "float()/int()/bool() on a jax-array value forces a blocking "
             "device→host sync",
     "W102": ".item() on a jax-array value forces a blocking device→host "
@@ -38,10 +40,16 @@ RULES: dict[str, str] = {
             "device→host sync",
     "W104": "jax.device_get outside an instrumented fetch site (no "
             "record_host_fetch in the enclosing function)",
+    "W105": "deferred epilogue handle still unresolved at its second "
+            "subsequent dispatch — pipeline depth exceeds the recovery "
+            "contract",
     "W201": "impure call (time/random/np.random/I-O/logging) inside "
             "jit-traced code",
     "W202": "Python if/while branches on a traced value inside jit — "
             "retrace hazard / nondeterministic resume",
+    "W203": "host callback whose effects can replay out of order on "
+            "resume (unordered io_callback / impure pure_callback in "
+            "jit-reachable code)",
     "W301": "buffer donated via donate_argnums is read again later in "
             "the same function",
     "W401": "fault_point() site name missing from the README "
@@ -54,9 +62,23 @@ RULES: dict[str, str] = {
             "any checkpoint save site",
     "W502": "snapshot key written at a checkpoint save site but never "
             "read by any restore path",
+    "W601": "collective (psum/pmean/all_gather/...) over an axis name "
+            "that matches no enclosing shard_map/pmap axis or known "
+            "mesh axis",
+    "W602": "collective reachable under Python control flow that can "
+            "diverge across replicas — cross-device deadlock risk",
+    "W603": "shard_map in_specs/out_specs arity does not match the "
+            "callee's signature/returns",
+    "W604": "PartitionSpec names an axis that no mesh in the program "
+            "defines",
+    "W701": "jit-entry argument whose shape derives from a "
+            "data-dependent Python value without a padding/bucketing "
+            "helper — per-batch retrace risk",
+    "W702": "runtime xla.retrace evidence at a jit site with no static "
+            "finding (from --trace-evidence)",
 }
 
-FAMILIES = ("W0", "W1", "W2", "W3", "W4", "W5")
+FAMILIES = ("W0", "W1", "W2", "W3", "W4", "W5", "W6", "W7")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -208,17 +230,50 @@ def parse_suppressions(
 def apply_suppressions(
     findings: Iterable[Finding],
     by_file: dict[str, dict[int, list[tuple[str, str]]]],
-) -> tuple[list[Finding], list[Finding]]:
-    """Split findings into (kept, suppressed) using per-line directives."""
+) -> tuple[list[Finding], list[Finding], set[tuple[str, int, str]]]:
+    """Split findings into (kept, suppressed) using per-line directives.
+
+    Also returns the set of directives that actually fired, as
+    ``(path, line, rule_pattern)`` triples — the complement feeds W002
+    (stale-suppression) detection.
+    """
     kept: list[Finding] = []
     suppressed: list[Finding] = []
+    used: set[tuple[str, int, str]] = set()
     for f in findings:
         entries = by_file.get(f.path, {}).get(f.line, [])
-        if any(rule_matches(p, f.rule) for p, _ in entries):
+        hit = False
+        for p, _ in entries:
+            if rule_matches(p, f.rule):
+                used.add((f.path, f.line, p))
+                hit = True
+        if hit:
             suppressed.append(f)
         else:
             kept.append(f)
-    return kept, suppressed
+    return kept, suppressed, used
+
+
+def unused_suppressions(
+    by_file: dict[str, dict[int, list[tuple[str, str]]]],
+    used: set[tuple[str, int, str]],
+) -> list[Finding]:
+    """W002 findings for directives that suppressed nothing.
+
+    A directive is *used* when at least one finding on its target line
+    matched its pattern; everything else is dead weight that would hide
+    a future regression, so it surfaces as a finding of its own.
+    """
+    out: list[Finding] = []
+    for path, by_line in sorted(by_file.items()):
+        for line, entries in sorted(by_line.items()):
+            for pattern, _reason in entries:
+                if (path, line, pattern) not in used:
+                    out.append(Finding(
+                        "W002", path, line, 0,
+                        f"suppression allow-{pattern} suppresses "
+                        f"nothing — remove the stale directive"))
+    return out
 
 
 # -- baseline --------------------------------------------------------------

@@ -40,6 +40,16 @@ JITWRAP = "jitwrap"
 # Literal tuples/lists keep per-element tags — ("tuple", (tag, ...)) —
 # so unpacking `a, b = (host_thing, jax_thing)` doesn't smear JAX onto
 # both targets. Any other JAX-containing container collapses to JAX.
+# Instances of package classes carry ("inst", dotted_class): method
+# calls on them dispatch through the class index, so
+# ``coord.score(...)`` joins the dataflow cross-module.
+
+
+def inst_class(tag) -> Optional[str]:
+    """Dotted class name when ``tag`` is a package-class instance."""
+    if isinstance(tag, tuple) and len(tag) == 2 and tag[0] == "inst":
+        return tag[1]
+    return None
 
 
 def is_jax(tag) -> bool:
@@ -175,10 +185,14 @@ class _Interp:
             self._enter_function(s, env)
             env[s.name] = self._def_tag(s)
         elif isinstance(s, ast.ClassDef):
+            class_dotted = f"{self.mod.module_name}.{s.name}"
+            self_tag = ("inst", class_dotted) \
+                if class_dotted in self.index.classes else None
             for sub in s.body:
                 if isinstance(sub, (ast.FunctionDef,
                                     ast.AsyncFunctionDef)):
-                    self._enter_function(sub, dict(env))
+                    self._enter_function(sub, dict(env),
+                                         self_tag=self_tag)
             env[s.name] = None
         elif isinstance(s, (ast.Raise, ast.Assert, ast.Delete)):
             for child in ast.iter_child_nodes(s):
@@ -197,7 +211,8 @@ class _Interp:
         dotted = f"{self.mod.module_name}.{fdef.name}"
         return JAXFN if dotted in self.index.jax_fns else None
 
-    def _enter_function(self, fdef, closure_env: dict) -> None:
+    def _enter_function(self, fdef, closure_env: dict,
+                        self_tag=None) -> None:
         env = dict(closure_env)
         a = fdef.args
         params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
@@ -207,6 +222,12 @@ class _Interp:
             params.append(a.kwarg.arg)
         for p in params:
             env.pop(p, None)
+        pos = a.posonlyargs + a.args
+        static = any(isinstance(d, ast.Name)
+                     and d.id in ("staticmethod", "classmethod")
+                     for d in fdef.decorator_list)
+        if self_tag is not None and pos and not static:
+            env[pos[0].arg] = self_tag
         for p, tag in self.jit_param_tags.get(id(fdef), {}).items():
             env[p] = tag
         for d in fdef.args.defaults + fdef.args.kw_defaults:
@@ -255,6 +276,20 @@ class _Interp:
             base = self.expr(e.value, env)
             if is_jax(base) and e.attr in JAX_ATTRS:
                 return JAX
+            c = inst_class(base)
+            if c is not None:
+                ac = self.index.attr_class(c, e.attr)
+                if ac is not None:
+                    return ("inst", ac)
+                hit = self.index.resolve_method(c, e.attr)
+                if hit is not None:
+                    info, fdef = hit
+                    is_prop = any(
+                        isinstance(d, ast.Name) and d.id == "property"
+                        for d in fdef.decorator_list)
+                    if is_prop and f"{info.dotted}.{e.attr}" in \
+                            self.index.jax_methods:
+                        return JAX
             return None
         if isinstance(e, ast.BinOp):
             tags = (self.expr(e.left, env), self.expr(e.right, env))
@@ -362,6 +397,11 @@ class _Interp:
                 return JAXFN
             if d in self.index.jax_fns:
                 return JAX
+            if d in self.index.classes:
+                return ("inst", d)
+            if d == "dataclasses.replace" and e.args:
+                # replace() preserves the instance's (or pytree's) kind
+                return arg_tags[0]
             if d == "functools.partial" and e.args:
                 inner = self.mod.resolve(e.args[0])
                 if inner in JIT_WRAP_TARGETS:
@@ -387,6 +427,16 @@ class _Interp:
             base = self.tags.get(id(e.func.value))
             if is_jax(base):
                 return HOST if e.func.attr in HOST_METHODS else JAX
+            # method call on a package-class instance: dispatch through
+            # the class index (cross-module receiver-type inference)
+            c = inst_class(base)
+            if c is not None:
+                hit = self.index.resolve_method(c, e.func.attr)
+                if hit is not None:
+                    info, _fdef = hit
+                    if f"{info.dotted}.{e.func.attr}" in \
+                            self.index.jax_methods:
+                        return JAX
         if func_tag == JAXFN:
             return JAX
         if func_tag == JITWRAP:
@@ -395,6 +445,14 @@ class _Interp:
 
 
 JIT_WRAP_TARGETS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+_HOST_ANNOTATIONS = {"float", "int", "bool", "str"}
+
+
+def _host_annotated(fdef) -> bool:
+    ret = fdef.returns
+    return isinstance(ret, ast.Name) and ret.id in _HOST_ANNOTATIONS
 
 
 def _merge(a: dict, b: dict) -> dict:
@@ -410,26 +468,58 @@ def _merge(a: dict, b: dict) -> dict:
     return out
 
 
-def infer_jax_functions(index: PackageIndex, max_rounds: int = 3) -> None:
+def infer_jax_functions(index: PackageIndex, max_rounds: int = 4) -> None:
     """Fixpoint: a top-level package function whose (any) return value
     tags JAX is itself jax-returning — so ``float(metrics.peak_f1(...))``
     is visible as a sync even though ``peak_f1`` lives in another
-    module. Converges in a round or two on this package; bounded for
-    safety."""
+    module. Methods get the same treatment into ``index.jax_methods``
+    (keyed ``<defining class dotted>.<method>``), which is what lets
+    ``float(coord.score(...))`` fire W1xx through a receiver whose class
+    lives in a different module. Converges in a round or two on this
+    package; bounded for safety.
+
+    A ``-> float/int/bool/str`` return annotation is trusted as a host
+    scalar: such a function is a deliberate device→host accessor (the
+    sync lives — and is reviewed — inside it), so its *callers* are not
+    re-flagged for consuming the already-host result."""
+    from photon_ml_tpu.analysis.package import jit_wrapping_call
+
+    # jit/vmap/grad-decorated methods are jax-returning by construction
+    for info in index.classes.values():
+        for name, fdef in info.methods.items():
+            for dec in fdef.decorator_list:
+                d = info.mod.resolve(dec)
+                if d in JAXFN_MAKERS or \
+                        jit_wrapping_call(info.mod, dec) is not None:
+                    index.jax_methods.add(f"{info.dotted}.{name}")
     for _ in range(max_rounds):
         grew = False
         for mod in index.modules:
             flow = analyze_module(mod, index)
             for name, node in mod.toplevel_defs.items():
-                if not isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                dotted = f"{mod.module_name}.{name}"
-                if dotted in index.jax_fns:
-                    continue
-                if any(is_jax(t)
-                       for t in flow.fn_returns.get(id(node), [])):
-                    index.jax_fns.add(dotted)
-                    grew = True
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    dotted = f"{mod.module_name}.{name}"
+                    if dotted in index.jax_fns or \
+                            _host_annotated(node):
+                        continue
+                    if any(is_jax(t)
+                           for t in flow.fn_returns.get(id(node), [])):
+                        index.jax_fns.add(dotted)
+                        grew = True
+                elif isinstance(node, ast.ClassDef):
+                    info = index.classes.get(
+                        f"{mod.module_name}.{name}")
+                    if info is None:
+                        continue
+                    for mname, fdef in info.methods.items():
+                        key = f"{info.dotted}.{mname}"
+                        if key in index.jax_methods or \
+                                _host_annotated(fdef):
+                            continue
+                        if any(is_jax(t) for t in
+                               flow.fn_returns.get(id(fdef), [])):
+                            index.jax_methods.add(key)
+                            grew = True
         if not grew:
             return

@@ -260,6 +260,137 @@ def find_jit_bindings(mod: ModuleInfo) -> list[JitBinding]:
     return out
 
 
+# -- class index -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """One top-level class: its methods plus what its attributes hold.
+
+    ``attr_classes`` maps an attribute name to the dotted name of the
+    package class its value is known to be — from dataclass/field
+    annotations and from ``self.x = SomeClass(...)`` assignments in
+    ``__init__``. This is what lets the dataflow follow
+    ``coord.score(...)`` through ``self.opt.step(...)`` chains.
+    """
+
+    dotted: str
+    mod: ModuleInfo
+    node: ast.ClassDef
+    methods: dict[str, ast.AST]  # name -> FunctionDef
+    attr_classes: dict[str, str]  # attribute -> dotted package class
+    bases: list[str]  # resolved dotted base classes
+
+
+def _class_info(mod: ModuleInfo, node: ast.ClassDef,
+                class_names: set[str]) -> ClassInfo:
+    dotted = f"{mod.module_name}.{node.name}"
+    methods: dict[str, ast.AST] = {}
+    attr_classes: dict[str, str] = {}
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods[item.name] = item
+        elif isinstance(item, ast.AnnAssign) and \
+                isinstance(item.target, ast.Name):
+            # dataclass-style field: x: SomeClass
+            ann = mod.resolve(item.annotation)
+            if ann in class_names:
+                attr_classes[item.target.id] = ann
+    init = methods.get("__init__")
+    if init is not None:
+        for stmt in ast.walk(init):
+            target = value = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    stmt.value is not None:
+                target, value = stmt.target, stmt.value
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            if isinstance(value, ast.Call):
+                callee = mod.resolve(value.func)
+                if callee in class_names:
+                    attr_classes.setdefault(target.attr, callee)
+    bases = [b for b in (mod.resolve(b) for b in node.bases)
+             if b in class_names]
+    return ClassInfo(dotted=dotted, mod=mod, node=node, methods=methods,
+                     attr_classes=attr_classes, bases=bases)
+
+
+# -- mesh-axis universe ----------------------------------------------------
+
+# Calls that *define* a named device axis. Collectives never add to the
+# universe — otherwise a typo'd psum axis would define itself and W601
+# could not fire.
+_MESH_CTORS = {"jax.sharding.Mesh", "jax.experimental.maps.Mesh",
+               "jax.interpreters.pxla.Mesh", "Mesh"}
+
+
+def literal_in(mod: ModuleInfo, index: "PackageIndex", node: ast.AST):
+    """Like ``ModuleInfo.literal`` but resolves Name/Attribute chains
+    through the whole-package constant table and evaluates tuples/lists
+    elementwise — e.g. ``(DATA_AXIS, ENTITY_AXIS)`` where both names are
+    imported from another module. Returns None when unresolvable."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        elts = [literal_in(mod, index, e) for e in node.elts]
+        if any(e is None for e in elts):
+            return None
+        return tuple(elts)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        dotted = mod.resolve(node)
+        if dotted is not None:
+            value = index.resolve_constant(dotted)
+            if value is not None:
+                return value
+        if isinstance(node, ast.Name) and node.id in mod.constants:
+            return mod.literal(node)
+        return None
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return None
+
+
+def collect_mesh_axes(index: "PackageIndex") -> set[str]:
+    """Every axis name the program can legitimately collective over:
+    Mesh(..., axis_names) construction sites, ``jax.pmap(axis_name=...)``
+    definitions, and ``*_AXIS`` string module constants (the package's
+    naming convention for mesh axes)."""
+    axes: set[str] = set()
+    for mod in index.modules:
+        for name, value in mod.constants.items():
+            if name.endswith("_AXIS") and isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                axes.add(value.value)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.resolve(node.func)
+            if dotted is not None and (
+                    dotted in _MESH_CTORS or dotted.endswith(".Mesh")):
+                spec = None
+                if len(node.args) >= 2:
+                    spec = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        spec = kw.value
+                value = literal_in(mod, index, spec) \
+                    if spec is not None else None
+                if isinstance(value, str):
+                    axes.add(value)
+                elif isinstance(value, tuple):
+                    axes.update(v for v in value if isinstance(v, str))
+            elif dotted in ("jax.pmap", "pmap"):
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        value = literal_in(mod, index, kw.value)
+                        if isinstance(value, str):
+                            axes.add(value)
+    return axes
+
+
 # -- package index ---------------------------------------------------------
 
 
@@ -272,6 +403,62 @@ class PackageIndex:
     jit_bindings: list[JitBinding]
     jax_fns: set[str]  # dotted names known to return jax values
     call_graph: dict[str, set[str]]  # dotted fn -> called package fns
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    by_module_name: dict[str, ModuleInfo] = dataclasses.field(
+        default_factory=dict)
+    jax_methods: set[str] = dataclasses.field(default_factory=set)
+    mesh_axes: set[str] = dataclasses.field(default_factory=set)
+
+    def resolve_constant(self, dotted: str):
+        """Literal value of a fully-qualified module constant, following
+        the definition across modules (``pkg.parallel.mesh.ENTITY_AXIS``
+        → ``"entity"``). None when the module is outside the lint run or
+        the value is not a literal."""
+        if "." not in dotted:
+            return None
+        mod_name, attr = dotted.rsplit(".", 1)
+        mod = self.by_module_name.get(mod_name)
+        if mod is None or attr not in mod.constants:
+            return None
+        return mod.literal(mod.constants[attr])
+
+    def resolve_method(
+        self, class_dotted: str, method: str
+    ) -> Optional[tuple[ClassInfo, ast.AST]]:
+        """Look ``method`` up on a class, walking package base classes."""
+        seen: set[str] = set()
+        stack = [class_dotted]
+        while stack:
+            current = stack.pop(0)
+            if current in seen:
+                continue
+            seen.add(current)
+            info = self.classes.get(current)
+            if info is None:
+                continue
+            if method in info.methods:
+                return info, info.methods[method]
+            stack.extend(info.bases)
+        return None
+
+    def attr_class(self, class_dotted: str,
+                   attr: str) -> Optional[str]:
+        """Dotted class of ``<instance of class_dotted>.<attr>``, walking
+        package base classes."""
+        seen: set[str] = set()
+        stack = [class_dotted]
+        while stack:
+            current = stack.pop(0)
+            if current in seen:
+                continue
+            seen.add(current)
+            info = self.classes.get(current)
+            if info is None:
+                continue
+            if attr in info.attr_classes:
+                return info.attr_classes[attr]
+            stack.extend(info.bases)
+        return None
 
     def jit_reachable(self) -> dict[str, str]:
         """Package functions reachable from any jit entry point, mapped
@@ -293,10 +480,19 @@ class PackageIndex:
 
 def build_index(modules: list[ModuleInfo]) -> PackageIndex:
     functions: dict[str, tuple[ModuleInfo, ast.AST]] = {}
+    class_names: set[str] = set()
     for mod in modules:
         for name, node in mod.toplevel_defs.items():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 functions[f"{mod.module_name}.{name}"] = (mod, node)
+            elif isinstance(node, ast.ClassDef):
+                class_names.add(f"{mod.module_name}.{name}")
+    classes: dict[str, ClassInfo] = {}
+    for mod in modules:
+        for name, node in mod.toplevel_defs.items():
+            if isinstance(node, ast.ClassDef):
+                info = _class_info(mod, node, class_names)
+                classes[info.dotted] = info
     jit_bindings = [b for mod in modules for b in find_jit_bindings(mod)]
     jax_fns = {b.impl for b in jit_bindings}
     jax_fns.update(b.mod.module_name + "." + b.bound_name
@@ -310,6 +506,10 @@ def build_index(modules: list[ModuleInfo]) -> PackageIndex:
                 if d is not None and d in functions:
                     callees.add(d)
         call_graph[dotted] = callees
-    return PackageIndex(modules=modules, functions=functions,
-                        jit_bindings=jit_bindings, jax_fns=jax_fns,
-                        call_graph=call_graph)
+    index = PackageIndex(modules=modules, functions=functions,
+                         jit_bindings=jit_bindings, jax_fns=jax_fns,
+                         call_graph=call_graph, classes=classes,
+                         by_module_name={m.module_name: m
+                                         for m in modules})
+    index.mesh_axes = collect_mesh_axes(index)
+    return index

@@ -1,0 +1,305 @@
+"""W6xx — collective safety on the sharded mesh.
+
+PR 12 made the training loop genuinely distributed: `shard_map` entity
+shards, `psum` score exchanges, replica-sharded weight updates. A
+mismatched axis name or a collective under replica-divergent control
+flow passes every single-device CPU test and then deadlocks (or worse,
+silently mis-reduces) on a real mesh. These rules make the axis/spec
+discipline mechanical:
+
+- **W601** a collective (``lax.psum``/``pmean``/``all_gather``/
+  ``psum_scatter``/``axis_index``/...) whose *literal* axis name matches
+  no axis the program ever defines. The axis universe is built from
+  defining sites only — ``Mesh(..., axis_names)`` constructions,
+  ``jax.pmap(axis_name=...)``, and the package's ``*_AXIS`` string
+  constants — never from collectives themselves (a typo must not define
+  its own axis). Axis arguments that do not resolve to a literal (e.g.
+  an ``axis_name`` function parameter, as in ``optimize/``) are skipped:
+  unknown is clean.
+- **W602** a collective lexically under an ``if``/``while`` whose
+  condition is a traced (per-replica) value or queries
+  ``jax.process_index``/``process_count``: replicas can disagree about
+  reaching the collective, which deadlocks the mesh. This is the
+  ``accept``-flag pattern PR 12 had to get right by hand.
+- **W603** ``shard_map(f, ..., in_specs=..., out_specs=...)`` whose
+  literal spec-tuple arity disagrees with ``f``'s positional signature
+  (in_specs) or with ``f``'s literal tuple returns (out_specs). Only
+  fires when ``f`` resolves to exactly one statically-known def — a
+  name that is also rebound by assignment in scope is skipped.
+- **W604** ``PartitionSpec`` naming an axis no mesh defines (the
+  sharding-side twin of W601).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow, is_jax
+from photon_ml_tpu.analysis.package import (
+    ModuleInfo, PackageIndex, literal_in,
+)
+
+# collective -> index of its positional axis-name argument
+_COLLECTIVES = {
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1, "jax.lax.all_gather": 1,
+    "jax.lax.psum_scatter": 1, "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1, "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0, "jax.lax.pshuffle": 1,
+}
+_AXIS_KWARGS = ("axis_name", "axis_index_groups_axis")
+
+_SHARD_MAP_EXACT = {
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.experimental.shard_map",
+}
+_PSPEC_EXACT = {"jax.sharding.PartitionSpec", "PartitionSpec"}
+_PROCESS_QUERIES = {"jax.process_index", "jax.process_count",
+                    "jax.host_id", "jax.host_count"}
+
+
+def _short(dotted: str) -> str:
+    return dotted.split(".")[-1]
+
+
+def _axes_label(axes: set[str]) -> str:
+    return ", ".join(repr(a) for a in sorted(axes)) if axes \
+        else "none defined anywhere in the program"
+
+
+def _axis_node(call: ast.Call, pos: int) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            return kw.value
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def _is_shard_map(mod: ModuleInfo, call: ast.Call) -> bool:
+    d = mod.resolve(call.func)
+    if d is not None:
+        if d in _SHARD_MAP_EXACT:
+            return True
+        # wrapper convention: the version-compat `_shard_map` helpers.
+        # Exact last-component match only — `run_glm_shard_map` is a
+        # *user* of shard_map, not the primitive.
+        if _short(d) in ("shard_map", "_shard_map"):
+            return True
+        return False
+    name = call.func.id if isinstance(call.func, ast.Name) else (
+        call.func.attr if isinstance(call.func, ast.Attribute) else None)
+    return name in ("shard_map", "_shard_map")
+
+
+class _BranchMap(ast.NodeVisitor):
+    """id(node) -> enclosing If/While chain, reset at function borders."""
+
+    def __init__(self):
+        self.branches: dict[int, tuple] = {}
+        self._stack: list = []
+
+    def visit(self, node):
+        self.branches[id(node)] = tuple(self._stack)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            saved, self._stack = self._stack, []
+            super().generic_visit(node)
+            self._stack = saved
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            # the test itself is *outside* the controlled region
+            self.branches[id(node.test)] = tuple(self._stack)
+            for child in ast.walk(node.test):
+                self.branches[id(child)] = tuple(self._stack)
+            self._stack.append(node)
+            for stmt in node.body + node.orelse:
+                self.visit(stmt)
+            self._stack.pop()
+            return
+        super().generic_visit(node)
+
+    def generic_visit(self, node):
+        self.visit(node)
+
+
+def _divergent_test(mod: ModuleInfo, flow: Dataflow,
+                    test: ast.expr) -> Optional[str]:
+    """Why a branch condition can differ across replicas, or None."""
+    if is_jax(flow.tag(test)):
+        return "a traced per-replica value"
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            d = mod.resolve(node.func)
+            if d in _PROCESS_QUERIES:
+                return f"{_short(d)}() (differs per host)"
+    return None
+
+
+def _find_callee(mod: ModuleInfo, scope_of, call: ast.Call,
+                 fn_node: ast.expr) -> Optional[ast.AST]:
+    """The single FunctionDef/Lambda the shard_map target resolves to,
+    or None when unknown or ambiguous (e.g. the name is also rebound by
+    an Assign somewhere in scope — distributed.py's conditional
+    ``local_fit``)."""
+    if isinstance(fn_node, ast.Lambda):
+        return fn_node
+    if not isinstance(fn_node, ast.Name):
+        return None
+    name = fn_node.id
+    defs: list[ast.AST] = []
+    assigned = False
+    scope = scope_of.get(id(call))
+    while scope is not None:
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                defs.append(node)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id == name:
+                            assigned = True
+        scope = scope_of.get(id(scope))
+    top = mod.toplevel_defs.get(name)
+    if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        defs.append(top)
+    if name in mod.constants:
+        assigned = True
+    if assigned or len(set(id(d) for d in defs)) != 1:
+        return None
+    return defs[0]
+
+
+def _positional_arity(fdef) -> int:
+    a = fdef.args
+    return len(a.posonlyargs) + len(a.args)
+
+
+def _literal_tuple_arity(node: ast.expr) -> Optional[int]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    return None
+
+
+def _return_arities(fdef) -> set[Optional[int]]:
+    """Literal tuple length of each return in ``fdef``'s own scope
+    (None = a non-tuple / unknown-arity return)."""
+    from photon_ml_tpu.analysis.rules_sync import build_scope_map
+    scope_of = build_scope_map(ast.Module(body=[fdef], type_ignores=[]))
+    out: set[Optional[int]] = set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Return) and scope_of.get(id(node)) is fdef:
+            out.add(_literal_tuple_arity(node.value)
+                    if node.value is not None else None)
+    return out
+
+
+def _spec_kwarg(call: ast.Call, kwarg: str,
+                pos: int) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == kwarg:
+            return kw.value
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    from photon_ml_tpu.analysis.rules_sync import build_scope_map
+
+    findings: list[Finding] = []
+    axes = index.mesh_axes
+    for mod in modules:
+        flow = flows[mod.relpath]
+        scope_of = build_scope_map(mod.tree)
+        branch_map = _BranchMap()
+        branch_map.visit(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.resolve(node.func)
+            if d in _COLLECTIVES:
+                short = _short(d)
+                axis_node = _axis_node(node, _COLLECTIVES[d])
+                value = literal_in(mod, index, axis_node) \
+                    if axis_node is not None else None
+                names = (value,) if isinstance(value, str) else (
+                    value if isinstance(value, tuple) else ())
+                for axis in names:
+                    if isinstance(axis, str) and axis not in axes:
+                        findings.append(Finding(
+                            "W601", mod.relpath, node.lineno,
+                            node.col_offset,
+                            f"lax.{short}() over unknown axis "
+                            f"{axis!r} — no Mesh/pmap defines it; "
+                            f"known axes: {_axes_label(axes)}"))
+                # W602: collective under replica-divergent control flow
+                for branch in branch_map.branches.get(id(node), ()):
+                    why = _divergent_test(mod, flow, branch.test)
+                    if why is not None:
+                        kind = "if" if isinstance(branch, ast.If) \
+                            else "while"
+                        findings.append(Finding(
+                            "W602", mod.relpath, node.lineno,
+                            node.col_offset,
+                            f"lax.{short}() under a Python `{kind}` "
+                            f"(line {branch.lineno}) branching on "
+                            f"{why} — replicas that disagree about "
+                            f"entering the branch deadlock the "
+                            f"collective; hoist it out or use "
+                            f"lax.cond with a replicated predicate"))
+                        break  # one W602 per collective is enough
+            elif _is_shard_map(mod, node) and node.args:
+                findings.extend(_check_shard_map(
+                    mod, index, scope_of, node, axes))
+            elif d in _PSPEC_EXACT:
+                for arg in node.args:
+                    value = literal_in(mod, index, arg)
+                    names = (value,) if isinstance(value, str) else (
+                        value if isinstance(value, tuple) else ())
+                    for axis in names:
+                        if isinstance(axis, str) and axis not in axes:
+                            findings.append(Finding(
+                                "W604", mod.relpath, node.lineno,
+                                node.col_offset,
+                                f"PartitionSpec axis {axis!r} is not "
+                                f"defined by any mesh — known axes: "
+                                f"{_axes_label(axes)}"))
+    return findings
+
+
+def _check_shard_map(mod: ModuleInfo, index: PackageIndex, scope_of,
+                     call: ast.Call, axes: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    callee = _find_callee(mod, scope_of, call, call.args[0])
+    in_specs = _spec_kwarg(call, "in_specs", 2)
+    out_specs = _spec_kwarg(call, "out_specs", 3)
+    if callee is not None and in_specs is not None:
+        want = _literal_tuple_arity(in_specs)
+        have = _positional_arity(callee)
+        if want is not None and want != have:
+            name = getattr(callee, "name", "<lambda>")
+            findings.append(Finding(
+                "W603", mod.relpath, call.lineno, call.col_offset,
+                f"shard_map in_specs has {want} spec(s) but "
+                f"{name}() takes {have} positional argument(s) — "
+                f"each positional argument needs exactly one spec"))
+    if callee is not None and out_specs is not None:
+        want = _literal_tuple_arity(out_specs)
+        if want is not None:
+            arities = _return_arities(callee)
+            if arities and None not in arities and \
+                    all(a != want for a in arities):
+                name = getattr(callee, "name", "<lambda>")
+                got = sorted(a for a in arities if a is not None)
+                findings.append(Finding(
+                    "W603", mod.relpath, call.lineno, call.col_offset,
+                    f"shard_map out_specs has {want} spec(s) but "
+                    f"{name}() returns tuple(s) of length "
+                    f"{'/'.join(map(str, got))} — out_specs must "
+                    f"mirror the return structure"))
+    return findings

@@ -12,9 +12,14 @@ rebind. Donating callables are found syntactically: module-level or
 local bindings of ``jax.jit(..., donate_argnums=...)`` /
 ``partial(jax.jit, donate_argnums=...)(impl)``, one level of plain-name
 aliasing (``fn = _donating_variant``), and inline
-``jax.jit(f, donate_argnums=...)(x)`` calls. Reads that loop back
-around a ``for``/``while`` body are out of scope (documented
-limitation) — the dynamic tests own that case.
+``jax.jit(f, donate_argnums=...)(x)`` calls.
+
+Loop-carried reads are in scope too: a donating call inside a
+``for``/``while`` body whose donated name is never rebound anywhere in
+that loop reads the deleted buffer on the *next* iteration — the
+donation from iteration k poisons the argument of iteration k+1. The
+idiomatic self-rebind ``x = donating(x)`` stays clean (the assignment
+target counts as the rebind).
 """
 
 from __future__ import annotations
@@ -133,6 +138,25 @@ def _later_read(fdef, name: str, call: ast.Call) -> Optional[ast.Name]:
     return best
 
 
+def _loop_carried_hazard(fdef, call: ast.Call,
+                         name: str) -> Optional[ast.AST]:
+    """The innermost enclosing loop in which ``name`` is donated by
+    ``call`` but never rebound — so the next iteration reuses the
+    deleted buffer. None when there is no such loop."""
+    innermost = None
+    for node in ast.walk(fdef):
+        if isinstance(node, (ast.For, ast.While)) and \
+                any(c is call for c in ast.walk(node)):
+            innermost = node  # walk visits outer loops first
+    if innermost is None:
+        return None
+    for n in ast.walk(innermost):
+        if isinstance(n, ast.Name) and n.id == name \
+                and isinstance(n.ctx, (ast.Store, ast.Del)):
+            return None  # rebound inside the loop: hazard cleared
+    return innermost
+
+
 def check(modules: list[ModuleInfo], index: PackageIndex,
           flows: dict[str, Dataflow], ctx) -> list[Finding]:
     findings: list[Finding] = []
@@ -168,4 +192,18 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
                             f"{i} here but read again at line "
                             f"{read.lineno} — donated buffers are "
                             f"deleted; copy first or drop the read"))
+                        continue
+                    loop = _loop_carried_hazard(fdef, call, arg.id)
+                    if loop is not None:
+                        kind = "for" if isinstance(loop, ast.For) \
+                            else "while"
+                        findings.append(Finding(
+                            "W301", mod.relpath, call.lineno,
+                            call.col_offset,
+                            f"'{arg.id}' is donated to XLA at argument "
+                            f"{i} inside the `{kind}` loop at line "
+                            f"{loop.lineno} without being rebound — "
+                            f"the next iteration reads the deleted "
+                            f"buffer; rebind it (x = fn(x)) or stop "
+                            f"donating in a loop"))
     return findings

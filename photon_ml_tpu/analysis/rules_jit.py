@@ -17,6 +17,13 @@ from them through the call graph.
   call site — including one module-level constant hop); for reachable
   helpers only locally-derived jax values count, which biases toward
   precision over recall.
+- **W203** host-callback ordering under checkpoint resume:
+  ``io_callback`` without ``ordered=True`` inside jit-traced code may
+  execute in a different order after a restore-and-replay than it did
+  in the original run (the side effects PR 9's resume contract cares
+  about — progress lines, telemetry appends — land out of order), and
+  ``pure_callback`` wrapping a known-impure callable (``time.*``,
+  ``random.*``, ...) invites jit to cache/elide the "pure" result.
 """
 
 from __future__ import annotations
@@ -33,6 +40,19 @@ _IMPURE_EXACT = {"print", "open", "input", "breakpoint",
 # escape hatch for calls that LOOK impure but are jit-legal (none known
 # yet; populate before reaching for a suppression in shared helpers)
 _PURE_EXCEPTIONS: set[str] = set()
+
+_IO_CALLBACKS = {"jax.experimental.io_callback", "jax.io_callback",
+                 "io_callback"}
+_PURE_CALLBACKS = {"jax.pure_callback",
+                   "jax.experimental.pure_callback", "pure_callback"}
+
+
+def _io_callback_ordered(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "ordered":
+            return isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True
+    return False
 
 
 def _short_root(root: str) -> str:
@@ -59,7 +79,35 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
                     d = node.func.id  # true builtins resolve to None
                 if d is None:
                     continue
-                if (d in _IMPURE_EXACT or d.startswith(_IMPURE_PREFIXES)) \
+                if d in _IO_CALLBACKS:
+                    if not _io_callback_ordered(node):
+                        findings.append(Finding(
+                            "W203", mod.relpath, node.lineno,
+                            node.col_offset,
+                            f"io_callback without ordered=True inside "
+                            f"jit-traced code{via} — unordered "
+                            f"callbacks can replay in a different "
+                            f"order after a checkpoint resume, "
+                            f"breaking the resume contract for host "
+                            f"side effects"))
+                elif d in _PURE_CALLBACKS and node.args:
+                    target = mod.resolve(node.args[0])
+                    if target is None and isinstance(node.args[0],
+                                                     ast.Name):
+                        target = node.args[0].id
+                    if target is not None and (
+                            target in _IMPURE_EXACT
+                            or target.startswith(_IMPURE_PREFIXES)):
+                        findings.append(Finding(
+                            "W203", mod.relpath, node.lineno,
+                            node.col_offset,
+                            f"pure_callback wrapping impure "
+                            f"{target}(){via} — jit may cache, elide "
+                            f"or reorder a 'pure' callback; use "
+                            f"io_callback(..., ordered=True) for "
+                            f"side-effecting host calls"))
+                elif (d in _IMPURE_EXACT
+                        or d.startswith(_IMPURE_PREFIXES)) \
                         and d not in _PURE_EXCEPTIONS:
                     findings.append(Finding(
                         "W201", mod.relpath, node.lineno,

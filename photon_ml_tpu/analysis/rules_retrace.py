@@ -1,0 +1,290 @@
+"""W7xx — retrace risk: data-dependent shapes entering jit.
+
+XLA compiles one program per distinct argument *shape*: an array built
+as ``jnp.zeros(len(rows))`` recompiles every time the batch size
+wobbles, which is exactly the per-dispatch stall ``obs/compile.py``'s
+``xla.retrace`` spans exist to catch at runtime. These rules catch it
+before the job runs:
+
+- **W701** an argument of a call to a jitted entry point is constructed
+  by an array maker (``jnp.zeros``/``ones``/``full``/``empty``/
+  ``arange``/``reshape``) whose shape expression derives from a
+  data-dependent Python value — ``len(...)``, ``.shape[...]``,
+  ``.size`` — that never passed through a padding/bucketing helper
+  (anything named ``pad*``/``*bucket*``/``round_up*``/``*pow2*``, e.g.
+  ``pad_rows_to_multiple``). Padded values are shape-stable by
+  construction and stay clean.
+- **W702** (only with ``--trace-evidence <dir>``) a runtime
+  ``xla.retrace`` record from ``obs/compile.py`` names a dispatch site
+  that static analysis found nothing wrong with — the run retraced
+  there anyway, so the risk is proven, not hypothesized. The finding
+  lands on the ``obs_compile.call("<site>", ...)`` source line and
+  carries the argument and shape transition from the trace. Sites that
+  already have a static W701 in the same function are not re-reported:
+  the evidence confirms the existing finding instead of duplicating it.
+
+Both rules treat unknown as clean: a shape that cannot be traced back
+to a data-dependent source is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow
+from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
+
+_ARRAY_MAKERS = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+    "jax.numpy.empty", "jax.numpy.arange", "jax.numpy.broadcast_to",
+    "jax.numpy.reshape",
+}
+# A value that went through one of these is considered shape-stabilized.
+_PADDING_MARKERS = ("pad", "bucket", "pow2", "round_up")
+_DYN_SOURCES = {"len"}
+_DYN_ATTRS = {"shape", "size", "nbytes"}
+
+
+def _call_name(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    d = mod.resolve(call.func)
+    if d is not None:
+        return d
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _is_padding_call(name: Optional[str]) -> bool:
+    if name is None:
+        return False
+    last = name.split(".")[-1].lower()
+    return any(m in last for m in _PADDING_MARKERS)
+
+
+class _DynShapes:
+    """Per-function map of names holding data-dependent Python sizes.
+
+    Two passes over the body in statement order (so loop-carried
+    propagation settles); a name assigned from a padding/bucketing call
+    is *cleared* — that is the sanctioned way to stabilize a shape.
+    """
+
+    def __init__(self, mod: ModuleInfo, owner, scope_of, scope):
+        self.mod = mod
+        self.dyn: dict[str, str] = {}  # name -> provenance note
+        for _ in range(2):
+            for node in ast.walk(owner):
+                if scope_of.get(id(node)) is not scope:
+                    continue  # nested defs track their own sizes
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    self._bind(node.targets[0].id, node.value)
+                elif isinstance(node, ast.AnnAssign) and \
+                        node.value is not None and \
+                        isinstance(node.target, ast.Name):
+                    self._bind(node.target.id, node.value)
+
+    def _bind(self, name: str, value: ast.expr) -> None:
+        why = self.provenance(value)
+        if why is not None:
+            self.dyn[name] = why
+        elif isinstance(value, ast.Call) and \
+                _is_padding_call(_call_name(self.mod, value)):
+            self.dyn.pop(name, None)
+
+    def provenance(self, e: ast.expr) -> Optional[str]:
+        """Why ``e`` is a data-dependent size, or None when it is not."""
+        if isinstance(e, ast.Name):
+            return self.dyn.get(e.id)
+        if isinstance(e, ast.Call):
+            name = _call_name(self.mod, e)
+            if _is_padding_call(name):
+                return None
+            if name in _DYN_SOURCES:
+                return f"{name}(...)"
+            if name in ("int", "max", "min", "abs", "sum"):
+                for arg in e.args:
+                    why = self.provenance(arg)
+                    if why is not None:
+                        return why
+            return None
+        if isinstance(e, ast.Attribute) and e.attr in _DYN_ATTRS:
+            return f".{e.attr}"
+        if isinstance(e, ast.Subscript):
+            return self.provenance(e.value)
+        if isinstance(e, ast.BinOp):
+            return self.provenance(e.left) or self.provenance(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.provenance(e.operand)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            for elt in e.elts:
+                why = self.provenance(elt)
+                if why is not None:
+                    return why
+        return None
+
+
+def _jitted_callables(index: PackageIndex) -> set[str]:
+    """Dotted names whose *call* triggers a trace."""
+    out: set[str] = set()
+    for b in index.jit_bindings:
+        out.add(b.impl)
+        if b.bound_name:
+            out.add(f"{b.mod.module_name}.{b.bound_name}")
+    return out
+
+
+def _dyn_shape_in_arg(dyn: _DynShapes, mod: ModuleInfo,
+                      arg: ast.expr) -> Optional[tuple[str, str]]:
+    """(maker, provenance) when ``arg`` contains an array-maker call
+    with a data-dependent shape expression."""
+    for node in ast.walk(arg):
+        if not isinstance(node, ast.Call):
+            continue
+        d = mod.resolve(node.func)
+        if d not in _ARRAY_MAKERS:
+            continue
+        shape_nodes = list(node.args[:1]) + [
+            kw.value for kw in node.keywords if kw.arg == "shape"]
+        if d == "jax.numpy.reshape":
+            shape_nodes = list(node.args[1:2])
+        for sn in shape_nodes:
+            why = dyn.provenance(sn)
+            if why is not None:
+                return d.split(".")[-1], why
+    return None
+
+
+def _check_w701(modules: list[ModuleInfo], index: PackageIndex
+                ) -> list[Finding]:
+    from photon_ml_tpu.analysis.rules_sync import build_scope_map
+
+    jitted = _jitted_callables(index)
+    findings: list[Finding] = []
+    for mod in modules:
+        scope_of = build_scope_map(mod.tree)
+        fdefs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fdef in [None] + fdefs:
+            body_owner = fdef if fdef is not None else mod.tree
+            dyn = _DynShapes(mod, body_owner, scope_of, fdef)
+            if not dyn.dyn:
+                continue
+            for call in ast.walk(body_owner):
+                if not isinstance(call, ast.Call):
+                    continue
+                if scope_of.get(id(call)) is not fdef:
+                    continue
+                d = mod.resolve(call.func)
+                if d not in jitted:
+                    continue
+                for i, arg in enumerate(call.args):
+                    hit = _dyn_shape_in_arg(dyn, mod, arg)
+                    if hit is None:
+                        continue
+                    maker, why = hit
+                    findings.append(Finding(
+                        "W701", mod.relpath, call.lineno,
+                        call.col_offset,
+                        f"argument {i} of jitted {d.split('.')[-1]}() "
+                        f"is built with jnp.{maker}() whose shape "
+                        f"comes from {why} — every distinct value "
+                        f"recompiles; pad or bucket it (e.g. "
+                        f"pad_rows_to_multiple) before the jit "
+                        f"boundary"))
+    return findings
+
+
+# -- trace evidence (W702) -------------------------------------------------
+
+
+def load_retrace_records(trace_dir) -> list[dict]:
+    """``xla.retrace`` span records from every ``*.jsonl`` in a trace
+    directory (the format ``obs/trace.py`` streams). Unparseable lines
+    are skipped — traces are telemetry, not inputs we trust."""
+    records: list[dict] = []
+    d = Path(trace_dir)
+    if not d.is_dir():
+        return records
+    for f in sorted(d.glob("*.jsonl")):
+        try:
+            text = f.read_text()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("name") == "xla.retrace":
+                records.append(rec)
+    return records
+
+
+def _dispatch_sites(modules: list[ModuleInfo]
+                    ) -> dict[str, tuple[ModuleInfo, ast.Call]]:
+    """site name -> the ``obs_compile.call("<site>", ...)`` location."""
+    out: dict[str, tuple[ModuleInfo, ast.Call]] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            d = mod.resolve(node.func)
+            if d is None or not d.endswith(".compile.call"):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                out.setdefault(first.value, (mod, node))
+    return out
+
+
+def _check_w702(modules: list[ModuleInfo], trace_dir,
+                w701: list[Finding]) -> list[Finding]:
+    records = load_retrace_records(trace_dir)
+    if not records:
+        return []
+    sites = _dispatch_sites(modules)
+    static_files = {f.path for f in w701}
+    findings: list[Finding] = []
+    seen: set[tuple[str, str]] = set()
+    for rec in records:
+        labels = rec.get("labels") or {}
+        site = labels.get("site")
+        if not isinstance(site, str) or site not in sites:
+            continue
+        arg = str(labels.get("arg", "?"))
+        if (site, arg) in seen:
+            continue
+        seen.add((site, arg))
+        mod, call = sites[site]
+        if mod.relpath in static_files:
+            continue  # the static W701 already owns this file's story
+        field = labels.get("field", "shape")
+        old, new = labels.get("old", "?"), labels.get("new", "?")
+        findings.append(Finding(
+            "W702", mod.relpath, call.lineno, call.col_offset,
+            f"runtime retrace evidence at site {site!r}: argument "
+            f"{arg} changed {field} {old} → {new} between dispatches "
+            f"and static analysis saw nothing — pad/bucket the "
+            f"argument or mark it static at this call"))
+    return findings
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    findings = _check_w701(modules, index)
+    trace_dir = getattr(ctx, "trace_dir", None)
+    if trace_dir is not None:
+        findings.extend(_check_w702(modules, trace_dir, findings))
+    return findings

@@ -12,8 +12,8 @@ from pathlib import Path
 from typing import Iterable, Optional
 
 from photon_ml_tpu.analysis import (
-    core, dataflow, rules_checkpoint, rules_donation, rules_faults,
-    rules_jit, rules_sync,
+    core, dataflow, rules_checkpoint, rules_collectives, rules_donation,
+    rules_faults, rules_jit, rules_retrace, rules_sync,
 )
 from photon_ml_tpu.analysis.core import Finding, LintReport
 from photon_ml_tpu.analysis.package import (
@@ -26,6 +26,8 @@ RULE_MODULES = {
     "W3": rules_donation,
     "W4": rules_faults,
     "W5": rules_checkpoint,
+    "W6": rules_collectives,
+    "W7": rules_retrace,
 }
 
 
@@ -35,6 +37,7 @@ class LintContext:
     readme_path: Optional[Path]
     readme_lines: Optional[list[str]]
     readme_relpath: Optional[str]
+    trace_dir: Optional[Path] = None
 
 
 def _collect_files(root: Path, paths: Iterable[str]) -> list[Path]:
@@ -57,6 +60,7 @@ def collect_findings(
     paths: Optional[Iterable[str]] = None,
     readme: Optional[Path] = None,
     families: Optional[set[str]] = None,
+    trace_dir: Optional[Path] = None,
 ) -> tuple[list[Finding], list[ModuleInfo], PackageIndex]:
     """Run the rule families and return raw findings (before suppression
     and baseline filtering)."""
@@ -94,7 +98,8 @@ def collect_findings(
         readme_path = readme_lines = readme_relpath = None
     ctx = LintContext(root=root, readme_path=readme_path,
                       readme_lines=readme_lines,
-                      readme_relpath=readme_relpath)
+                      readme_relpath=readme_relpath,
+                      trace_dir=trace_dir)
 
     findings: list[Finding] = []
     enabled = families or set(RULE_MODULES)
@@ -114,6 +119,7 @@ def lint(
     readme=None,
     baseline=None,
     families: Optional[set[str]] = None,
+    trace_dir: Optional[Path] = None,
 ) -> LintReport:
     """Full lint pass: rules, then per-line suppressions, then baseline.
 
@@ -121,9 +127,18 @@ def lint(
     None to report everything as new.
     """
     findings, modules, _ = collect_findings(
-        Path(root), paths, readme, families)
+        Path(root), paths, readme, families, trace_dir)
     by_file = {m.relpath: m.suppressions for m in modules}
-    kept, suppressed = core.apply_suppressions(findings, by_file)
+    kept, suppressed, used = core.apply_suppressions(findings, by_file)
+    if families is None:
+        # W002 needs every family's verdict: on a partial run an
+        # off-family directive would merely LOOK unused.
+        w002 = core.unused_suppressions(by_file, used)
+        w002_kept, w002_suppressed, _ = core.apply_suppressions(
+            w002, by_file)
+        kept = sorted(kept + w002_kept,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+        suppressed.extend(w002_suppressed)
     entries = core.load_baseline(baseline)
     new, baselined, stale = core.apply_baseline(kept, entries)
     return LintReport(new=new, baselined=baselined,
@@ -139,9 +154,16 @@ def write_baseline(
     families: Optional[set[str]] = None,
 ) -> int:
     """Grandfather every current (non-suppressed) finding into
-    ``path``; returns the number of baseline entries written."""
+    ``path``. Stale entries are pruned by construction: the file is
+    rewritten from the findings that exist *now*, so anything fixed
+    since the last refresh simply never re-enters. Returns the number
+    of baseline entries written."""
     findings, modules, _ = collect_findings(
         Path(root), paths, readme, families)
     by_file = {m.relpath: m.suppressions for m in modules}
-    kept, _ = core.apply_suppressions(findings, by_file)
+    kept, _, used = core.apply_suppressions(findings, by_file)
+    if families is None:
+        w002 = core.unused_suppressions(by_file, used)
+        w002_kept, _, _ = core.apply_suppressions(w002, by_file)
+        kept = kept + w002_kept
     return core.write_baseline(path, kept)

@@ -961,6 +961,7 @@ class RandomEffectOptimizationProblem:
         """Σ over entities of the per-entity penalty
         (RandomEffectOptimizationProblem.getRegularizationTermValue)."""
         val = self.regularization_value_device(coefs)
+        # photonlint: allow-W101(this IS the host-scalar accessor: one guarded scalar sync per sweep-end objective, annotated -> float)
         return val if isinstance(val, float) else float(val)
 
 

@@ -283,4 +283,5 @@ class GLMOptimizationProblem:
         used by coordinate descent's global objective
         (GeneralizedLinearOptimizationProblem.getRegularizationTermValue)."""
         val = self.regularization_value_device(coef_normalized)
+        # photonlint: allow-W101(this IS the host-scalar accessor: one guarded scalar sync per objective evaluation, annotated -> float)
         return val if isinstance(val, float) else float(val)

@@ -215,6 +215,7 @@ def run_game_step(
     # parity with the model's host-side scoring path
     data.encode_ids("itemId", items)
     np.testing.assert_allclose(
+        # photonlint: allow-W103(parity check: fetching both score paths to host for comparison is the whole point of this tool)
         mf_scores, np.asarray(mf.score(data)), rtol=1e-5, atol=1e-6)
 
     # --- explicit collectives backend: shard_map + psum fixed-effect fit

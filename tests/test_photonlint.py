@@ -30,7 +30,7 @@ README = REPO_ROOT / "README.md"
 
 
 def run_fixture(tmp_path, files, readme=None, families=None,
-                baseline=None):
+                baseline=None, trace_dir=None):
     pkg = tmp_path / "pkg"
     pkg.mkdir(exist_ok=True)
     for name, src in files.items():
@@ -42,7 +42,8 @@ def run_fixture(tmp_path, files, readme=None, families=None,
         readme_path = tmp_path / "README.md"
         readme_path.write_text(readme)
     return runner.lint(tmp_path, paths=["pkg"], readme=readme_path,
-                       baseline=baseline, families=families)
+                       baseline=baseline, families=families,
+                       trace_dir=trace_dir)
 
 
 def rules_of(report):
@@ -509,6 +510,707 @@ def run(buf):
     assert rules_of(report) == ["W301"]
 
 
+# -- W203 host-callback ordering under resume ------------------------------
+
+W203_POSITIVE = """
+import time
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+def note(x):
+    return None
+
+@jax.jit
+def kernel(x):
+    io_callback(note, None, x)                       # W203: unordered
+    t = jax.pure_callback(
+        time.time, jax.ShapeDtypeStruct((), jnp.float32))  # W203: impure
+    return x * t
+"""
+
+W203_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+def note(x):
+    return None
+
+def pure_sq(x):
+    return x * x
+
+@jax.jit
+def kernel(x):
+    io_callback(note, None, x, ordered=True)         # ordered: fine
+    y = jax.pure_callback(
+        pure_sq, jax.ShapeDtypeStruct((), jnp.float32), x)
+    return x + y
+
+def host_only(x):
+    io_callback(note, None, x)   # not jit-reachable: out of scope
+    return x
+"""
+
+W203_SUPPRESSED = """
+import jax
+from jax.experimental import io_callback
+
+def note(x):
+    return None
+
+@jax.jit
+def kernel(x):
+    # photonlint: allow-W203(fixture: effect is idempotent, order-free)
+    io_callback(note, None, x)
+    return x
+"""
+
+
+def test_w203_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W203_POSITIVE},
+                         families={"W2"})
+    w203 = [f for f in report.new if f.rule == "W203"]
+    assert len(w203) == 2
+    assert any("ordered=True" in f.message for f in w203)
+    assert any("time.time" in f.message for f in w203)
+
+
+def test_w203_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W203_NEGATIVE},
+                         families={"W2"})
+    assert report.new == []
+
+
+def test_w203_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W203_SUPPRESSED},
+                         families={"W2"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W203"]
+
+
+# -- W301 loop-carried donation reads --------------------------------------
+
+def test_w301_loop_carried_positive(tmp_path):
+    """A buffer donated inside a loop without a rebind is read (deleted)
+    again by the NEXT iteration — the carried-over lint debt."""
+    src = """
+import jax
+
+def step(x):
+    return x + 1
+
+_step = jax.jit(step, donate_argnums=(0,))
+
+def run(buf, n):
+    acc = 0.0
+    for _ in range(n):
+        acc = acc + _step(buf)      # W301: buf never rebound in loop
+    return acc
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"W3"})
+    assert rules_of(report) == ["W301"]
+    assert "next iteration" in report.new[0].message
+
+
+def test_w301_loop_carried_negative_fresh_buffer(tmp_path):
+    """A buffer created fresh each iteration before the donating call is
+    a new allocation every time — no loop-carried hazard."""
+    src = """
+import jax
+import jax.numpy as jnp
+
+def step(x):
+    return x + 1
+
+_step = jax.jit(step, donate_argnums=(0,))
+
+def run(n):
+    acc = 0.0
+    for i in range(n):
+        buf = jnp.full((4,), float(i))
+        acc = acc + _step(buf)
+    return acc
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"W3"})
+    assert report.new == []
+
+
+# -- cross-module receiver-type inference ----------------------------------
+
+RECEIVER_CLASS_MOD = """
+import jax.numpy as jnp
+
+class Scorer:
+    def __init__(self, scale):
+        self.scale = scale
+
+    def score(self, x):
+        return jnp.sum(x) * self.scale
+
+    def label(self):
+        return "scorer"
+
+class Holder:
+    def __init__(self):
+        self.scorer = Scorer(1.0)
+"""
+
+RECEIVER_USE_MOD = """
+from pkg.mod_a import Scorer, Holder
+
+def evaluate(x):
+    s = Scorer(2.0)
+    return float(s.score(x))        # W101: method resolves cross-module
+
+def evaluate_chain(x):
+    h = Holder()
+    return float(h.scorer.score(x))  # W101: through the attribute index
+
+def describe():
+    s = Scorer(2.0)
+    return float(len(s.label()))    # str-returning method: clean
+"""
+
+
+def test_cross_module_receiver_inference(tmp_path):
+    report = run_fixture(
+        tmp_path,
+        {"mod_a.py": RECEIVER_CLASS_MOD, "mod_b.py": RECEIVER_USE_MOD},
+        families={"W1"})
+    w101 = [f for f in report.new if f.rule == "W101"]
+    assert len(w101) == 2, [f.format() for f in report.new]
+    assert all(f.path == "pkg/mod_b.py" for f in w101)
+    assert {f.line for f in w101} == {6, 10}
+
+
+def test_receiver_inference_host_annotation_trusted(tmp_path):
+    """A method annotated ``-> float`` is a deliberate host accessor:
+    its CALLERS must not be re-flagged for consuming the result."""
+    class_mod = """
+import jax.numpy as jnp
+
+class Penalty:
+    def value_device(self, x):
+        return jnp.sum(x * x)
+
+    def value(self, x) -> float:
+        v = self.value_device(x)
+        # photonlint: allow-W101(the designated host accessor syncs here)
+        return v if isinstance(v, float) else float(v)
+"""
+    use_mod = """
+from pkg.mod_a import Penalty
+
+def objective(x):
+    p = Penalty()
+    return 2.0 * float(p.value(x))   # already host: clean
+"""
+    report = run_fixture(
+        tmp_path, {"mod_a.py": class_mod, "mod_b.py": use_mod},
+        families={"W1"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+# -- W6xx collective safety ------------------------------------------------
+
+MESH_MOD = """
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+def make_mesh(devs):
+    return Mesh(devs, (DATA_AXIS, ENTITY_AXIS))
+"""
+
+W601_POSITIVE = """
+from jax import lax
+
+def exchange(x):
+    return lax.psum(x, "entty")     # W601: typo'd axis
+"""
+
+W601_NEGATIVE = """
+import jax
+from jax import lax
+from pkg.mesh import ENTITY_AXIS
+
+def score(x, mesh):
+    def impl(v):
+        return lax.psum(v, ENTITY_AXIS)   # correct psum inside shard_map
+    fn = jax.shard_map(impl, mesh=mesh, in_specs=(None,),
+                       out_specs=None)
+    return fn(x)
+
+def gather(x, axis_name):
+    return lax.all_gather(x, axis_name)   # unresolvable param: skipped
+"""
+
+W601_SUPPRESSED = """
+from jax import lax
+
+def exchange(x):
+    # photonlint: allow-W601(fixture: axis is created by the test harness)
+    return lax.psum(x, "harness_axis")
+"""
+
+
+def test_w601_positive_names_offender_and_candidates(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W601_POSITIVE},
+        families={"W6"})
+    assert rules_of(report) == ["W601"]
+    msg = report.new[0].message
+    assert "'entty'" in msg, "must name the offending axis"
+    assert "'data'" in msg and "'entity'" in msg, \
+        "must name the candidate axes"
+
+
+def test_w601_negative(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W601_NEGATIVE},
+        families={"W6"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_w601_suppressed(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W601_SUPPRESSED},
+        families={"W6"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W601"]
+
+
+W602_POSITIVE = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def exchange(x):
+    if jax.process_index() == 0:
+        return lax.psum(x, "data")  # W602: only host 0 reaches it
+    return x
+
+def accept_gate(x):
+    flag = jnp.sum(x)
+    while flag > 0:                 # traced predicate
+        x = lax.pmean(x, "data")    # W602: replicas may disagree
+        flag = jnp.sum(x)
+    return x
+"""
+
+W602_NEGATIVE = """
+from jax import lax
+
+def exchange(x, enabled):
+    if enabled:                     # host-uniform config flag: fine
+        return lax.psum(x, "data")
+    return x
+
+def always(x):
+    return lax.pmean(x, "data")     # unconditional: fine
+"""
+
+
+def test_w602_positive(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W602_POSITIVE},
+        families={"W6"})
+    w602 = [f for f in report.new if f.rule == "W602"]
+    assert len(w602) == 2, [f.format() for f in report.new]
+    assert any("process_index" in f.message for f in w602)
+    assert any("traced per-replica value" in f.message for f in w602)
+
+
+def test_w602_negative(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W602_NEGATIVE},
+        families={"W6"})
+    assert report.new == []
+
+
+W603_POSITIVE = """
+import jax
+
+def run(x, mesh):
+    def impl(a, b):
+        return a + b
+    fn = jax.shard_map(impl, mesh=mesh, in_specs=(None,),
+                       out_specs=None)      # W603: 1 spec, 2 params
+    return fn(x)
+
+def run2(x, mesh):
+    def impl2(a):
+        return a, a
+    fn = jax.shard_map(impl2, mesh=mesh, in_specs=(None,),
+                       out_specs=(None, None, None))  # W603: 3 vs 2
+    return fn(x)
+"""
+
+W603_NEGATIVE = """
+import jax
+
+def run(x, y, mesh):
+    def impl(a, b):
+        return a + b, a - b
+    fn = jax.shard_map(impl, mesh=mesh, in_specs=(None, None),
+                       out_specs=(None, None))
+    return fn(x, y)
+
+def run_conditional(x, mesh, fast):
+    # a callee name that is ALSO assigned is ambiguous: skipped
+    if fast:
+        local = _make_impl()
+    else:
+        def local(a):
+            return a
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(None, None),
+                       out_specs=None)
+    return fn(x)
+
+def _make_impl():
+    def impl(a, b):
+        return a
+    return impl
+"""
+
+
+def test_w603_positive(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W603_POSITIVE},
+        families={"W6"})
+    w603 = [f for f in report.new if f.rule == "W603"]
+    assert len(w603) == 2, [f.format() for f in report.new]
+    assert any("takes 2 positional" in f.message for f in w603)
+    assert any("out_specs" in f.message for f in w603)
+
+
+def test_w603_negative(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W603_NEGATIVE},
+        families={"W6"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+W604_POSITIVE = """
+from jax.sharding import PartitionSpec as P
+
+def specs():
+    return P("bogus_axis")          # W604
+"""
+
+W604_NEGATIVE = """
+from jax.sharding import PartitionSpec as P
+from pkg.mesh import DATA_AXIS
+
+def specs():
+    return P(DATA_AXIS), P("entity"), P()
+"""
+
+
+def test_w604_positive(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W604_POSITIVE},
+        families={"W6"})
+    assert rules_of(report) == ["W604"]
+    assert "'bogus_axis'" in report.new[0].message
+
+
+def test_w604_negative(tmp_path):
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W604_NEGATIVE},
+        families={"W6"})
+    assert report.new == []
+
+
+def test_w601_seeded_axis_typo_in_random_effect(tmp_path_factory):
+    """The acceptance scenario: a deliberate axis-name typo seeded into
+    a scratch copy of ``game/random_effect.py``'s score-exchange psum
+    must produce a W601 naming both the offender and the candidates."""
+    root = tmp_path_factory.mktemp("axis_typo")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    target = root / "photon_ml_tpu" / "game" / "random_effect.py"
+    src = target.read_text()
+    needle = "lax.psum(flat[:num_samples], ENTITY_AXIS)"
+    assert needle in src, "score-exchange psum moved; update this test"
+    target.write_text(src.replace(
+        needle, 'lax.psum(flat[:num_samples], "entty")'))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         families={"W6"})
+    w601 = [f for f in report.new if f.rule == "W601"]
+    assert len(w601) == 1, [f.format() for f in report.new]
+    f = w601[0]
+    assert f.path == "photon_ml_tpu/game/random_effect.py"
+    assert "'entty'" in f.message
+    assert "'data'" in f.message and "'entity'" in f.message
+
+
+# -- W7xx retrace risk -----------------------------------------------------
+
+W701_POSITIVE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(v):
+    return v * 2
+
+def run(xs):
+    n = len(xs)
+    return kernel(jnp.zeros(n))     # W701: shape follows len(xs)
+
+def run_shape(batch):
+    rows = batch.shape[0]
+    return kernel(jnp.ones((rows, 4)))   # W701: shape follows .shape
+"""
+
+W701_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(v):
+    return v * 2
+
+def pad_to_bucket(n):
+    return max(8, 1 << (int(n) - 1).bit_length())
+
+def run(xs):
+    n = pad_to_bucket(len(xs))      # bucketed: shape-stable
+    return kernel(jnp.zeros(n))
+
+def run_const(xs):
+    return kernel(jnp.zeros(128))   # static shape: fine
+"""
+
+W701_SUPPRESSED = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(v):
+    return v * 2
+
+def run(xs):
+    n = len(xs)
+    # photonlint: allow-W701(fixture: xs has one size in this pipeline)
+    return kernel(jnp.zeros(n))
+"""
+
+
+def test_w701_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W701_POSITIVE},
+                         families={"W7"})
+    w701 = [f for f in report.new if f.rule == "W701"]
+    assert len(w701) == 2, [f.format() for f in report.new]
+    assert any("len(...)" in f.message for f in w701)
+    assert any(".shape" in f.message for f in w701)
+
+
+def test_w701_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W701_NEGATIVE},
+                         families={"W7"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_w701_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W701_SUPPRESSED},
+                         families={"W7"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W701"]
+
+
+W702_SITE_MOD = """
+from photon_ml_tpu.obs import compile as obs_compile
+
+def dispatch(fn, batch):
+    return obs_compile.call("fix.site", fn, (batch,),
+                            arg_names=("batch",))
+"""
+
+
+def _write_trace(tmp_path, records):
+    trace = tmp_path / "trace"
+    trace.mkdir()
+    lines = [json.dumps(r) for r in records]
+    (trace / "spans.jsonl").write_text("\n".join(lines) + "\n")
+    return trace
+
+
+def test_w702_with_trace_evidence(tmp_path):
+    trace = _write_trace(tmp_path, [
+        {"name": "span.other", "labels": {}},
+        {"name": "xla.retrace",
+         "labels": {"site": "fix.site", "arg": "batch",
+                    "field": "shape", "old": "(8, 4)",
+                    "new": "(9, 4)"}},
+        {"name": "xla.retrace",   # same site+arg: deduplicated
+         "labels": {"site": "fix.site", "arg": "batch",
+                    "field": "shape", "old": "(9, 4)",
+                    "new": "(10, 4)"}},
+        {"name": "xla.retrace",   # site with no source location: skipped
+         "labels": {"site": "unknown.site", "arg": "x"}},
+    ])
+    report = run_fixture(tmp_path, {"mod.py": W702_SITE_MOD},
+                         families={"W7"}, trace_dir=trace)
+    w702 = [f for f in report.new if f.rule == "W702"]
+    assert len(w702) == 1, [f.format() for f in report.new]
+    f = w702[0]
+    assert f.path == "pkg/mod.py"
+    assert "'fix.site'" in f.message
+    assert "(8, 4)" in f.message and "(9, 4)" in f.message
+
+
+def test_w702_without_trace_evidence_is_silent(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W702_SITE_MOD},
+                         families={"W7"})
+    assert report.new == []
+
+
+def test_w702_garbage_trace_lines_are_skipped(tmp_path):
+    trace = tmp_path / "trace"
+    trace.mkdir()
+    (trace / "spans.jsonl").write_text(
+        "not json at all\n{\"name\": \"xla.retrace\"\n\n")
+    report = run_fixture(tmp_path, {"mod.py": W702_SITE_MOD},
+                         families={"W7"}, trace_dir=trace)
+    assert report.new == []
+
+
+# -- W002 stale suppressions + baseline pruning ----------------------------
+
+def test_w002_stale_suppression_fires(tmp_path):
+    src = """
+import jax.numpy as jnp
+
+def f(x):
+    # photonlint: allow-W102(stale: the .item() call was removed)
+    return x + 1
+"""
+    report = run_fixture(tmp_path, {"mod.py": src})
+    w002 = [f for f in report.new if f.rule == "W002"]
+    assert len(w002) == 1
+    assert "allow-W102" in w002[0].message
+
+
+def test_w002_used_suppression_is_clean(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W1_SUPPRESSED})
+    assert [f.rule for f in report.suppressed] == ["W101"]
+    assert not [f for f in report.new if f.rule == "W002"]
+
+
+def test_w002_skipped_on_family_subset_runs(tmp_path):
+    """On a partial run an off-family directive merely LOOKS unused —
+    W002 must only judge directives when every family has spoken."""
+    report = run_fixture(tmp_path, {"mod.py": W1_SUPPRESSED},
+                         families={"W2"})
+    assert report.new == []
+
+
+def test_write_baseline_prunes_stale_entries(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(W1_POSITIVE)
+    baseline = tmp_path / "baseline.json"
+    n = runner.write_baseline(tmp_path, baseline, paths=["pkg"],
+                              families={"W1"})
+    assert n > 0
+
+    (pkg / "mod.py").write_text(W1_NEGATIVE)  # everything fixed
+    n = runner.write_baseline(tmp_path, baseline, paths=["pkg"],
+                              families={"W1"})
+    assert n == 0
+    assert core.load_baseline(baseline) == [], \
+        "stale entries must not be carried forever"
+
+
+def test_cli_write_baseline_reports_pruned(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(W1_POSITIVE)
+    baseline = tmp_path / "baseline.json"
+    cli = [sys.executable, str(REPO_ROOT / "tools" / "photonlint.py"),
+           "pkg", "--root", str(tmp_path), "--baseline", str(baseline),
+           "--rules", "W1", "--write-baseline"]
+    proc = subprocess.run(cli, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    (pkg / "mod.py").write_text(W1_NEGATIVE)
+    proc = subprocess.run(cli, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned" in proc.stdout
+
+
+# -- W4xx reconcile pins for the PR 11-12 fault points ---------------------
+
+@pytest.mark.parametrize("point,site_file", [
+    ("obs.otlp", "photon_ml_tpu/obs/otlp.py"),
+    ("re.shard_dispatch", "photon_ml_tpu/game/random_effect.py"),
+])
+def test_fault_point_round_trip_pinned(tmp_path_factory, point,
+                                       site_file):
+    """The PR 11-12 fault points round-trip between README table and
+    call sites: the real tree is clean (the package gate), and renaming
+    the README row makes BOTH directions fire — W401 at the real call
+    site and W402 for the now-phantom row."""
+    readme_text = README.read_text()
+    assert f"| `{point}` |" in readme_text, \
+        f"README PHOTON_FAULTS table lost its {point} row"
+
+    root = tmp_path_factory.mktemp(f"faultpin_{point.replace('.', '_')}")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    (root / "README.md").write_text(readme_text.replace(
+        f"| `{point}` |", f"| `{point}.phantom` |"))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE)
+    w401 = [f for f in report.new if f.rule == "W401"
+            and f'"{point}"' in f.message]
+    assert w401, f"no W401 for the undocumented {point} call site"
+    assert all(f.path == site_file for f in w401)
+    w402 = [f for f in report.new if f.rule == "W402"
+            and f"{point}.phantom" in f.message]
+    assert w402, f"no W402 for the phantom {point} README row"
+
+
+# -- SARIF output ----------------------------------------------------------
+
+def test_sarif_fixture_shape(tmp_path):
+    from photon_ml_tpu.analysis.sarif import to_sarif
+
+    report = run_fixture(
+        tmp_path, {"mesh.py": MESH_MOD, "mod.py": W601_POSITIVE},
+        families={"W6"})
+    doc = to_sarif(report)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "photonlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == set(core.RULES)
+    results = run["results"]
+    assert len(results) == 1
+    assert results[0]["ruleId"] == "W601"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/mod.py"
+    assert loc["region"]["startLine"] == report.new[0].line
+
+
+def test_cli_sarif_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "photonlint.py"),
+         "photon_ml_tpu", "--sarif"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == "2.1.0"
+    assert payload["runs"][0]["results"] == []
+
+
 # -- suppression grammar / W001 --------------------------------------------
 
 def test_malformed_suppression_is_w001(tmp_path):
@@ -652,6 +1354,21 @@ CANARIES = {
     "W501": (
         "\n\ndef _photonlint_canary_schema(snap):\n"
         "    return snap[\"photonlint_canary_missing_key\"]\n"),
+    "W203": (
+        "\n\n@jax.jit\n"
+        "def _photonlint_canary_callback(x):\n"
+        "    jax.experimental.io_callback(print, None, x)\n"
+        "    return x\n"),
+    "W601": (
+        "\n\ndef _photonlint_canary_axis(x):\n"
+        "    return jax.lax.psum(x, \"photonlint_bogus_axis\")\n"),
+    "W701": (
+        "\n\n@jax.jit\n"
+        "def _photonlint_canary_kernel(v):\n"
+        "    return v * 2\n"
+        "\n\ndef _photonlint_canary_retrace(xs):\n"
+        "    n = len(xs)\n"
+        "    return _photonlint_canary_kernel(jnp.zeros(n))\n"),
 }
 
 

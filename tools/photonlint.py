@@ -6,9 +6,11 @@ Usage (from the repo root)::
     python tools/photonlint.py                       # lint photon_ml_tpu/
     python tools/photonlint.py photon_ml_tpu tools   # explicit paths
     python tools/photonlint.py --format json         # machine output
+    python tools/photonlint.py --sarif               # SARIF 2.1.0 output
     python tools/photonlint.py --write-baseline      # grandfather all
     python tools/photonlint.py --no-baseline         # raw findings
     python tools/photonlint.py --rules W1,W4         # family subset
+    python tools/photonlint.py --trace-evidence runs/trace  # W702 mode
     python tools/photonlint.py --list-rules
 
 Exit codes: 0 clean (no non-baselined findings), 1 findings, 2 usage or
@@ -50,7 +52,11 @@ def parse_args(argv):
                          "(default: photon_ml_tpu)")
     ap.add_argument("--root", default=_REPO_ROOT,
                     help="lint root; finding paths are relative to it")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--sarif", action="store_true",
+                    help="shorthand for --format sarif (SARIF 2.1.0, "
+                         "for editor/CI consumption)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file (grandfathered findings)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -63,6 +69,10 @@ def parse_args(argv):
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule families to run, e.g. "
                          "W1,W4 (default: all)")
+    ap.add_argument("--trace-evidence", default=None, metavar="DIR",
+                    help="directory of obs/trace spans (*.jsonl); "
+                         "xla.retrace records there drive W702 "
+                         "runtime-confirmed retrace findings")
     ap.add_argument("--list-rules", action="store_true")
     return ap.parse_args(argv)
 
@@ -85,21 +95,35 @@ def main(argv=None) -> int:
     paths = ns.paths or None
     try:
         if ns.write_baseline:
+            from photon_ml_tpu.analysis.core import load_baseline
+            before = {(e["rule"], e["path"], e["message"])
+                      for e in load_baseline(
+                          ns.baseline
+                          if os.path.exists(ns.baseline) else None)}
             n = runner.write_baseline(
                 ns.root, ns.baseline, paths=paths, readme=ns.readme,
                 families=families)
+            after = {(e["rule"], e["path"], e["message"])
+                     for e in load_baseline(ns.baseline)}
+            pruned = len(before - after)
             print(f"photonlint: wrote {n} baseline entr(ies) to "
-                  f"{ns.baseline}")
+                  f"{ns.baseline}"
+                  + (f" ({pruned} stale entr(ies) pruned)"
+                     if pruned else ""))
             return 0
         report = runner.lint(
             ns.root, paths=paths, readme=ns.readme,
             baseline=None if ns.no_baseline else ns.baseline,
-            families=families)
+            families=families, trace_dir=ns.trace_evidence)
     except (OSError, ValueError, SyntaxError) as e:
         print(f"photonlint: error: {e}", file=sys.stderr)
         return 2
-    if ns.format == "json":
+    fmt = "sarif" if ns.sarif else ns.format
+    if fmt == "json":
         print(report.format_json())
+    elif fmt == "sarif":
+        from photon_ml_tpu.analysis.sarif import format_sarif
+        print(format_sarif(report))
     else:
         print(report.format_text())
     return 0 if report.ok else 1
