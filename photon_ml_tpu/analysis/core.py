@@ -76,9 +76,31 @@ RULES: dict[str, str] = {
             "helper — per-batch retrace risk",
     "W702": "runtime xla.retrace evidence at a jit site with no static "
             "finding (from --trace-evidence)",
+    "W801": "reduction (sum/dot/matmul/psum/segment_sum/...) over a "
+            "bf16/f16/runtime-selected dtype without an f32 accumulator "
+            "(preferred_element_type / explicit dtype / upcast)",
+    "W802": "float64 construction in jit-reachable code with no "
+            "jax_enable_x64 config guard — silently truncates to f32 "
+            "under the default config",
+    "W803": "jax value round-tripped through np.asarray and fed back "
+            "into a jitted callable — dtype/weak-type erasing, silent "
+            "retrace on the promoted dtype",
+    "W804": "bf16/f16 mixed with f32/f64 by implicit promotion in a "
+            "loss/gradient path — the precision decision should be an "
+            "explicit cast",
+    "W901": "shared attribute/global written without the lock that "
+            "guards it elsewhere (thread-body write visible to "
+            "unlocked readers, or lock held on some writes but not "
+            "all)",
+    "W902": "signal handler doing more than async-signal-safe "
+            "flag/Event latching",
+    "W903": "thread started with no join/stop in any shutdown path — "
+            "its lifetime is unbounded at exit",
+    "W904": "inconsistent nested lock acquisition order across the "
+            "package — deadlock shape",
 }
 
-FAMILIES = ("W0", "W1", "W2", "W3", "W4", "W5", "W6", "W7")
+FAMILIES = ("W0", "W1", "W2", "W3", "W4", "W5", "W6", "W7", "W8", "W9")
 
 
 @dataclasses.dataclass(frozen=True)

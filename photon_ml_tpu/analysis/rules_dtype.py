@@ -1,0 +1,614 @@
+"""W8xx — precision dtype-flow: bf16/f16 accumulation discipline.
+
+A second abstract interpreter rides the same module ASTs as the jax
+dataflow, but tracks *dtypes* instead of device placement. The lattice:
+
+- ``f64``/``f32``/``bf16``/``f16`` — concrete float dtypes, from
+  ``jnp.float32``-style tokens, ``"float32"`` strings, dtype kwargs and
+  ``astype`` casts;
+- ``weak`` — python float literals (weakly typed: they inherit the
+  other operand's dtype under jax promotion);
+- ``dyn`` — a *runtime-selected* dtype: the result of reading
+  ``x.dtype`` or of ``astype(some_dtype_variable)``. A ``dyn`` value may
+  be bf16/f16 at runtime — this is exactly the dtype-generic kernel
+  pattern (``ops/pallas_kernels.py`` casts operands to ``x_ref.dtype``),
+  so reductions over it need an explicit f32 accumulator;
+- ``None`` — unknown; unknown is clean everywhere (precision over
+  recall, same bias as the jax dataflow).
+
+Cross-module: a top-level function whose every return joins to one
+concrete tag exports it, fixpoint-style, so ``scale(x)`` returning bf16
+in another module taints its callers.
+
+Rules:
+
+- **W801** a reduction (``sum``/``mean``/``dot``/``matmul``/``einsum``/
+  ``dot_general``/``psum``/``pmean``/``segment_sum``/…) over a
+  may-low-precision operand (bf16/f16/dyn) with no explicit accumulator
+  — no ``preferred_element_type=``/``dtype=`` kwarg and no upcast. Any
+  explicit accumulator kwarg clears the taint (a deliberate low-
+  precision accumulator is a choice, not an accident).
+- **W802** float64 construction (f64 dtype kwarg, ``astype(float64)``,
+  ``jnp.float64(...)``) inside jit-reachable code in a module with no
+  ``jax_enable_x64`` guard: under default config this silently truncates
+  to f32; with x64 on it doubles memory — either way it should be
+  deliberate and guarded.
+- **W803** a jax value round-tripped through ``np.asarray``/``np.array``
+  and fed back into a jitted callable — the round trip erases weak-type
+  and committed-device information and re-traces on the promoted dtype
+  (complements W701's shape-driven retrace rule).
+- **W804** arithmetic mixing a concrete low dtype (bf16/f16) with a
+  concrete high one (f32/f64) inside a loss/gradient-named function,
+  relying on implicit promotion — make the promotion explicit where it
+  decides gradient precision.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from photon_ml_tpu.analysis.core import Finding
+from photon_ml_tpu.analysis.dataflow import Dataflow, JAXFN, is_jax
+from photon_ml_tpu.analysis.package import ModuleInfo, PackageIndex
+
+F64, F32, BF16, F16, WEAK, DYN = "f64", "f32", "bf16", "f16", "weak", "dyn"
+_LOW_CONCRETE = {BF16, F16}
+_LOW = {BF16, F16, DYN}
+_HIGH = {F32, F64}
+_RANK = {WEAK: 0, F16: 1, BF16: 1, F32: 2, F64: 3}
+
+# Trailing-component dtype tokens: jnp.float32, np.bfloat16, "float16".
+_DTYPE_TOKENS = {
+    "float64": F64, "double": F64, "float32": F32, "single": F32,
+    "bfloat16": BF16, "float16": F16, "half": F16,
+}
+
+# jax reductions that accumulate in the operand dtype unless told not to.
+_REDUCTIONS = {
+    "jax.numpy.sum", "jax.numpy.mean", "jax.numpy.prod", "jax.numpy.dot",
+    "jax.numpy.matmul", "jax.numpy.einsum", "jax.numpy.tensordot",
+    "jax.numpy.cumsum", "jax.lax.dot", "jax.lax.dot_general",
+    "jax.lax.psum", "jax.lax.pmean", "jax.ops.segment_sum",
+}
+_REDUCE_METHODS = {"sum", "mean", "prod", "dot"}
+_ACC_KWARGS = ("preferred_element_type", "dtype", "acc_dtype")
+# dtype-preserving methods worth following through chains.
+_KEEP_METHODS = {"reshape", "ravel", "transpose", "squeeze", "copy",
+                 "flatten", "block_until_ready", "conj", "clip"}
+# dtype-preserving/promoting jnp calls: name -> index of first VALUE arg
+# (where's condition arg carries no dtype).
+_ELEMENTWISE = {
+    "where": 1, "maximum": 0, "minimum": 0, "clip": 0, "abs": 0,
+    "exp": 0, "log": 0, "log1p": 0, "expm1": 0, "sqrt": 0, "tanh": 0,
+    "negative": 0, "transpose": 0, "reshape": 0, "ravel": 0,
+    "squeeze": 0, "broadcast_to": 0, "concatenate": 0, "stack": 0,
+    "add": 0, "subtract": 0, "multiply": 0, "divide": 0,
+}
+# array makers: name -> positional index of the dtype argument.
+_MAKER_DTYPE_POS = {
+    "asarray": 1, "array": 1, "zeros": 1, "ones": 1, "empty": 1,
+    "full": 2, "zeros_like": 1, "ones_like": 1, "full_like": 2,
+    "arange": None, "linspace": None, "eye": None,
+}
+# makers whose no-dtype default is the jnp float default (f32).
+_F32_DEFAULT_MAKERS = {"zeros", "ones", "empty", "full", "linspace", "eye"}
+_NP_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+_LOSS_PATH_MARKERS = ("loss", "grad", "objective")
+
+
+def _promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """May-join under jax promotion: unknown defers to the known side,
+    dyn stays dyn against weak/low (it may BE low) but a concrete f32/f64
+    operand dominates the runtime result."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a == b:
+        return a
+    if DYN in (a, b):
+        other = b if a == DYN else a
+        return other if other in _HIGH else DYN
+    if {a, b} == {F16, BF16}:
+        return F32  # jax promotes mixed half types to f32
+    return a if _RANK[a] >= _RANK[b] else b
+
+
+def _elt_tags(tag):
+    if isinstance(tag, tuple) and tag and tag[0] == "tuple":
+        return tag[1]
+    return None
+
+
+def _scalar(tag):
+    """Collapse a tuple tag to the join of its elements."""
+    elts = _elt_tags(tag)
+    if elts is None:
+        return tag
+    out = None
+    for t in elts:
+        out = _promote(out, _scalar(t))
+    return out
+
+
+def _tail(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _x64_guarded(mod: ModuleInfo) -> bool:
+    """True when the module visibly engages the x64 config switch."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and node.value == "jax_enable_x64":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "jax_enable_x64", "x64_enabled", "enable_x64"):
+            return True
+    return False
+
+
+class _DtypeInterp:
+    """Per-module dtype walker; mirrors the jax dataflow's statement
+    coverage (may-merge branches, two loop passes, nested defs at their
+    definition point with the enclosing env as closure)."""
+
+    def __init__(self, mod: ModuleInfo, index: PackageIndex,
+                 flow: Dataflow, fn_dtypes: dict[str, str],
+                 jit_reachable: set[str], emit: bool,
+                 findings: Optional[list] = None):
+        self.mod = mod
+        self.index = index
+        self.flow = flow
+        self.fn_dtypes = fn_dtypes
+        self.jit_reachable = jit_reachable
+        self.emit = emit
+        self.findings = findings if findings is not None else []
+        self.fn_returns: dict[int, list] = {}
+        self._ret_stack: list[list] = []
+        self._fn_stack: list[str] = []
+        self._x64_guard = _x64_guarded(mod)
+
+    def run_module(self) -> None:
+        self.run_block(self.mod.tree.body, {})
+
+    # -- statements --------------------------------------------------------
+
+    def run_block(self, body, env: dict) -> dict:
+        for stmt in body:
+            env = self.stmt(stmt, env)
+        return env
+
+    def stmt(self, s: ast.stmt, env: dict) -> dict:
+        if isinstance(s, ast.Assign):
+            t = self.expr(s.value, env)
+            for tgt in s.targets:
+                self.bind(tgt, t, env)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.bind(s.target, self.expr(s.value, env), env)
+        elif isinstance(s, ast.AugAssign):
+            t = self.expr(s.value, env)
+            if isinstance(s.target, ast.Name):
+                env[s.target.id] = _promote(
+                    _scalar(env.get(s.target.id)), _scalar(t))
+        elif isinstance(s, ast.Return):
+            t = self.expr(s.value, env) if s.value is not None else None
+            if self._ret_stack:
+                self._ret_stack[-1].append(t)
+        elif isinstance(s, ast.Expr):
+            self.expr(s.value, env)
+        elif isinstance(s, ast.If):
+            self.expr(s.test, env)
+            env_a = self.run_block(s.body, dict(env))
+            env_b = self.run_block(s.orelse, dict(env))
+            env = _merge(env_a, env_b)
+        elif isinstance(s, ast.For):
+            self.expr(s.iter, env)
+            self.bind(s.target, None, env)
+            for _ in range(2):
+                env = _merge(env, self.run_block(s.body, dict(env)))
+            env = self.run_block(s.orelse, env)
+        elif isinstance(s, ast.While):
+            self.expr(s.test, env)
+            for _ in range(2):
+                env = _merge(env, self.run_block(s.body, dict(env)))
+            env = self.run_block(s.orelse, env)
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                t = self.expr(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, t, env)
+            env = self.run_block(s.body, env)
+        elif isinstance(s, ast.Try):
+            env = self.run_block(s.body, env)
+            base = dict(env)
+            for h in s.handlers:
+                env = _merge(env, self.run_block(h.body, dict(base)))
+            env = self.run_block(s.orelse, env)
+            env = self.run_block(s.finalbody, env)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._enter_function(s, env)
+        elif isinstance(s, ast.ClassDef):
+            for sub in s.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._enter_function(sub, dict(env))
+        elif isinstance(s, (ast.Raise, ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.expr(child, env)
+        return env
+
+    def _enter_function(self, fdef, closure_env: dict) -> None:
+        env = dict(closure_env)
+        a = fdef.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            env.pop(p.arg, None)
+        if a.vararg:
+            env.pop(a.vararg.arg, None)
+        if a.kwarg:
+            env.pop(a.kwarg.arg, None)
+        for d in fdef.args.defaults + fdef.args.kw_defaults:
+            if d is not None:
+                self.expr(d, closure_env)
+        self._ret_stack.append([])
+        self._fn_stack.append(fdef.name)
+        self.run_block(fdef.body, env)
+        self._fn_stack.pop()
+        self.fn_returns[id(fdef)] = self._ret_stack.pop()
+
+    def bind(self, target, tag, env: dict) -> None:
+        if isinstance(target, ast.Name):
+            if tag is None:
+                env.pop(target.id, None)
+            else:
+                env[target.id] = tag
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = _elt_tags(tag)
+            if elts is not None and len(elts) == len(target.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in target.elts):
+                for elt, t in zip(target.elts, elts):
+                    self.bind(elt, t, env)
+            else:
+                for elt in target.elts:
+                    self.bind(elt, None, env)
+        elif isinstance(target, ast.Starred):
+            self.bind(target.value, tag, env)
+
+    # -- expressions -------------------------------------------------------
+
+    def expr(self, e: Optional[ast.expr], env: dict):
+        if e is None:
+            return None
+        if isinstance(e, ast.Constant):
+            if isinstance(e.value, float):
+                return WEAK
+            if isinstance(e.value, str):
+                return _DTYPE_TOKENS.get(e.value)
+            return None
+        if isinstance(e, ast.Name):
+            tok = self._token(e)
+            return tok if tok is not None else env.get(e.id)
+        if isinstance(e, ast.Attribute):
+            if e.attr == "dtype":
+                self.expr(e.value, env)
+                return DYN
+            tok = self._token(e)
+            if tok is not None:
+                return tok
+            base = self.expr(e.value, env)
+            if e.attr in ("T", "mT", "real", "imag", "at"):
+                return _scalar(base)
+            return None
+        if isinstance(e, ast.Call):
+            return self._call(e, env)
+        if isinstance(e, ast.BinOp):
+            left = _scalar(self.expr(e.left, env))
+            right = _scalar(self.expr(e.right, env))
+            if isinstance(e.op, ast.MatMult):
+                self._check_reduction(e, [left, right], has_acc=False)
+            elif self.emit and isinstance(
+                    e.op, (ast.Add, ast.Sub, ast.Mult, ast.Div)):
+                pair = {left, right}
+                if pair & _LOW_CONCRETE and pair & _HIGH and any(
+                        m in name for name in self._fn_stack
+                        for m in _LOSS_PATH_MARKERS):
+                    low = (pair & _LOW_CONCRETE).pop()
+                    high = (pair & _HIGH).pop()
+                    self.findings.append(Finding(
+                        "W804", self.mod.relpath, e.lineno, e.col_offset,
+                        f"{low} and {high} mixed by implicit promotion "
+                        f"in a loss/gradient path — cast explicitly so "
+                        f"the gradient precision is a decision, not a "
+                        f"promotion-rule accident"))
+            return _promote(left, right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr(e.operand, env)
+        if isinstance(e, (ast.Compare, ast.BoolOp)):
+            for child in ast.iter_child_nodes(e):
+                if isinstance(child, ast.expr):
+                    self.expr(child, env)
+            return None  # boolean results
+        if isinstance(e, ast.IfExp):
+            self.expr(e.test, env)
+            return _promote(_scalar(self.expr(e.body, env)),
+                            _scalar(self.expr(e.orelse, env)))
+        if isinstance(e, ast.Subscript):
+            t = self.expr(e.value, env)
+            self.expr(e.slice, env)
+            elts = _elt_tags(t)
+            if elts is not None and isinstance(e.slice, ast.Constant) \
+                    and isinstance(e.slice.value, int) \
+                    and -len(elts) <= e.slice.value < len(elts):
+                return elts[e.slice.value]
+            return _scalar(t)
+        if isinstance(e, (ast.Tuple, ast.List)) and not any(
+                isinstance(v, ast.Starred) for v in e.elts):
+            tags = tuple(self.expr(v, env) for v in e.elts)
+            return ("tuple", tags) if any(t is not None for t in tags) \
+                else None
+        if isinstance(e, ast.NamedExpr):
+            t = self.expr(e.value, env)
+            self.bind(e.target, t, env)
+            return t
+        if isinstance(e, ast.Lambda):
+            inner = dict(env)
+            a = e.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                inner.pop(p.arg, None)
+            self.expr(e.body, inner)
+            return None
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            inner = dict(env)
+            for gen in e.generators:
+                self.expr(gen.iter, inner)
+                self.bind(gen.target, None, inner)
+                for cond in gen.ifs:
+                    self.expr(cond, inner)
+            if isinstance(e, ast.DictComp):
+                self.expr(e.key, inner)
+                self.expr(e.value, inner)
+            else:
+                self.expr(e.elt, inner)
+            return None
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self.expr(child, env)
+        return None
+
+    def _token(self, node) -> Optional[str]:
+        d = self.mod.resolve(node)
+        if d is not None:
+            return _DTYPE_TOKENS.get(_tail(d))
+        return None
+
+    def _dtype_arg(self, node, env) -> Optional[str]:
+        """Dtype tag of an expression used *as a dtype* (kwarg/astype)."""
+        if node is None:
+            return None
+        tok = None
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            tok = self._token(node)
+        if tok is not None:
+            return tok
+        return _scalar(self.expr(node, env))
+
+    def _acc_kwarg(self, call: ast.Call, env):
+        """(present, tag) for an explicit accumulator kwarg."""
+        for kw in call.keywords:
+            if kw.arg in _ACC_KWARGS:
+                return True, self._dtype_arg(kw.value, env)
+        return False, None
+
+    def _check_reduction(self, node, operand_tags, has_acc: bool,
+                         name: str = "@") -> None:
+        if has_acc or not self.emit:
+            return
+        low = [t for t in operand_tags if t in _LOW]
+        if not low:
+            return
+        tag = DYN if DYN in low else low[0]
+        what = ("a value whose dtype is selected at runtime (propagated "
+                "from a .dtype read)" if tag == DYN
+                else f"a {tag} value")
+        self.findings.append(Finding(
+            "W801", self.mod.relpath, node.lineno, node.col_offset,
+            f"{name} reduces {what} without an f32 accumulator — pass "
+            f"preferred_element_type=jnp.float32 (or an explicit f32 "
+            f"dtype/upcast) so bf16/f16 inputs do not accumulate in low "
+            f"precision"))
+
+    def _call(self, e: ast.Call, env):
+        arg_tags = [_scalar(self.expr(a, env)) for a in e.args]
+        kw_tags = {kw.arg: self.expr(kw.value, env) for kw in e.keywords}
+        d = self.mod.resolve(e.func)
+
+        # method calls on VALUES: x.astype(...), x.sum(), x.reshape(...)
+        # — a resolvable dotted func (jnp.mean, np.sum) is a module call
+        # and is classified below, not here
+        if isinstance(e.func, ast.Attribute) and d is None:
+            base = _scalar(self.expr(e.func.value, env))
+            attr = e.func.attr
+            if attr == "astype":
+                target = e.args[0] if e.args else None
+                for kw in e.keywords:
+                    if kw.arg == "dtype":
+                        target = kw.value
+                return self._dtype_arg(target, env)
+            if attr in _REDUCE_METHODS:
+                jax_base = is_jax(self.flow.tag(e.func.value))
+                has_acc, acc = self._acc_kwarg(e, env)
+                if jax_base:
+                    self._check_reduction(e, [base], has_acc,
+                                          name=f".{attr}()")
+                return acc if has_acc else base
+            if attr in _KEEP_METHODS:
+                return base
+
+        if d is None:
+            return None
+        tail = _tail(d)
+        is_jnp = d.startswith(("jax.numpy.", "jax.lax.", "jax.ops.",
+                               "jax.nn.", "jax.scipy."))
+        if d in _REDUCTIONS:
+            has_acc, acc = self._acc_kwarg(e, env)
+            self._check_reduction(e, arg_tags, has_acc, name=tail)
+            if has_acc:
+                return acc
+            out = None
+            for t in arg_tags:
+                out = _promote(out, t)
+            return out
+        if is_jnp or d.startswith("numpy."):
+            if tail in _MAKER_DTYPE_POS:
+                dt = None
+                pos = _MAKER_DTYPE_POS[tail]
+                if "dtype" in kw_tags:
+                    dt = self._dtype_arg(
+                        next(kw.value for kw in e.keywords
+                             if kw.arg == "dtype"), env)
+                elif pos is not None and len(e.args) > pos:
+                    dt = self._dtype_arg(e.args[pos], env)
+                if dt is not None:
+                    self._check_f64_construction(e, dt, d)
+                    return dt
+                if tail in ("asarray", "array", "zeros_like", "ones_like",
+                            "full_like") and arg_tags:
+                    return arg_tags[0]
+                if is_jnp and tail in _F32_DEFAULT_MAKERS:
+                    return F32
+                return None
+            if _DTYPE_TOKENS.get(tail) is not None:
+                dt = _DTYPE_TOKENS[tail]
+                self._check_f64_construction(e, dt, d)
+                return dt
+            if is_jnp and tail in _ELEMENTWISE:
+                out = None
+                for t in arg_tags[_ELEMENTWISE[tail]:]:
+                    out = _promote(out, t)
+                return out
+            return None
+        # cross-module: a package function with a known return dtype
+        if d in self.fn_dtypes:
+            return self.fn_dtypes[d]
+        if d == "dataclasses.replace" and arg_tags:
+            return arg_tags[0]
+        return None
+
+    def _check_f64_construction(self, node, dt, dotted) -> None:
+        if not self.emit or dt != F64 or self._x64_guard:
+            return
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is None:
+            return
+        dotted_fn = f"{self.mod.module_name}.{fn}"
+        if dotted_fn not in self.jit_reachable:
+            return
+        self.findings.append(Finding(
+            "W802", self.mod.relpath, node.lineno, node.col_offset,
+            f"float64 constructed via {dotted} in jit-reachable code "
+            f"with no jax_enable_x64 guard in the module — under the "
+            f"default config this silently truncates to float32; guard "
+            f"the x64 config or use an explicit float32 dtype"))
+
+
+def _merge(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for k, v in b.items():
+        cur = out.get(k)
+        out[k] = v if cur is None else (
+            cur if cur == v else _promote(_scalar(cur), _scalar(v)))
+    return out
+
+
+def _w803(mod: ModuleInfo, flow: Dataflow, jit_names: set[str],
+          findings: list) -> None:
+    """np.asarray(jax) results fed back into a jitted callable."""
+    from photon_ml_tpu.analysis.rules_sync import build_scope_map
+
+    scope_of = build_scope_map(mod.tree)
+    # (scope id, name) -> line of the erasing conversion
+    erased: dict[tuple, int] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            d = mod.resolve(node.value.func)
+            if d in _NP_CONVERTERS and node.value.args \
+                    and is_jax(flow.tag(node.value.args[0])):
+                scope = scope_of.get(id(node.value))
+                key = (None if scope is None else id(scope),
+                       node.targets[0].id)
+                erased[key] = node.lineno
+    if not erased:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = mod.resolve(node.func)
+        jitted = (d in jit_names) or flow.tag(node.func) == JAXFN
+        if not jitted:
+            continue
+        scope = scope_of.get(id(node))
+        sid = None if scope is None else id(scope)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and (sid, arg.id) in erased:
+                findings.append(Finding(
+                    "W803", mod.relpath, node.lineno, node.col_offset,
+                    f"{arg.id!r} is a jax value round-tripped through "
+                    f"np.asarray (line {erased[(sid, arg.id)]}) and fed "
+                    f"back into a jitted callable — the round trip "
+                    f"erases weak-type/committed-device info and "
+                    f"retraces on the promoted dtype; keep the value on "
+                    f"device or device_get once at the boundary"))
+
+
+def check(modules: list[ModuleInfo], index: PackageIndex,
+          flows: dict[str, Dataflow], ctx) -> list[Finding]:
+    jit_reachable = set(index.jit_reachable())
+    jit_names = {b.impl for b in index.jit_bindings}
+    jit_names.update(b.mod.module_name + "." + b.bound_name
+                     for b in index.jit_bindings if b.bound_name)
+
+    # fixpoint: export concrete return dtypes of top-level functions
+    fn_dtypes: dict[str, str] = {}
+    for _ in range(3):
+        grew = False
+        for mod in modules:
+            interp = _DtypeInterp(mod, index, flows[mod.relpath],
+                                  fn_dtypes, jit_reachable, emit=False)
+            interp.run_module()
+            for name, node in mod.toplevel_defs.items():
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                rets = interp.fn_returns.get(id(node), [])
+                if not rets or any(not isinstance(t, str) or t == WEAK
+                                   for t in (_scalar(r) for r in rets)):
+                    continue
+                tag = None
+                for r in rets:
+                    tag = _promote(tag, _scalar(r))
+                dotted = f"{mod.module_name}.{name}"
+                if tag is not None and fn_dtypes.get(dotted) != tag:
+                    fn_dtypes[dotted] = tag
+                    grew = True
+        if not grew:
+            break
+
+    findings: list[Finding] = []
+    for mod in modules:
+        interp = _DtypeInterp(mod, index, flows[mod.relpath], fn_dtypes,
+                              jit_reachable, emit=True, findings=findings)
+        interp.run_module()
+        _w803(mod, flows[mod.relpath], jit_names, findings)
+    # loop bodies run twice and both If arms run — dedupe repeat visits
+    seen: set[tuple] = set()
+    out: list[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

@@ -13,7 +13,8 @@ from typing import Iterable, Optional
 
 from photon_ml_tpu.analysis import (
     core, dataflow, rules_checkpoint, rules_collectives, rules_donation,
-    rules_faults, rules_jit, rules_retrace, rules_sync,
+    rules_dtype, rules_faults, rules_jit, rules_retrace, rules_sync,
+    rules_threads,
 )
 from photon_ml_tpu.analysis.core import Finding, LintReport
 from photon_ml_tpu.analysis.package import (
@@ -28,6 +29,8 @@ RULE_MODULES = {
     "W5": rules_checkpoint,
     "W6": rules_collectives,
     "W7": rules_retrace,
+    "W8": rules_dtype,
+    "W9": rules_threads,
 }
 
 
@@ -120,11 +123,16 @@ def lint(
     baseline=None,
     families: Optional[set[str]] = None,
     trace_dir: Optional[Path] = None,
+    changed_paths: Optional[set[str]] = None,
 ) -> LintReport:
     """Full lint pass: rules, then per-line suppressions, then baseline.
 
     ``baseline`` is a path (entries grandfather existing findings) or
-    None to report everything as new.
+    None to report everything as new. ``changed_paths`` (root-relative
+    posix paths) restricts the *report* to findings in those files; the
+    analysis itself is always whole-program, so cross-module findings
+    (a W801 whose accumulator lives two calls away, a W904 lock-order
+    pair) still resolve against the unchanged half of the package.
     """
     findings, modules, _ = collect_findings(
         Path(root), paths, readme, families, trace_dir)
@@ -139,6 +147,8 @@ def lint(
         kept = sorted(kept + w002_kept,
                       key=lambda f: (f.path, f.line, f.col, f.rule))
         suppressed.extend(w002_suppressed)
+    if changed_paths is not None:
+        kept = [f for f in kept if f.path in changed_paths]
     entries = core.load_baseline(baseline)
     new, baselined, stale = core.apply_baseline(kept, entries)
     return LintReport(new=new, baselined=baselined,

@@ -18,6 +18,9 @@ SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
 _INFO_URI = "https://github.com/photon-ml-tpu"  # repo docs anchor
+# Every rule row lives in the README "Rule catalog" table; SARIF viewers
+# surface helpUri as the rule's "more info" link.
+_CATALOG_URI = _INFO_URI + "/blob/main/README.md#rule-catalog"
 
 
 def _result(f: Finding) -> dict:
@@ -42,6 +45,7 @@ def to_sarif(report: LintReport) -> dict:
             "id": rule,
             "name": rule,
             "shortDescription": {"text": text},
+            "helpUri": _CATALOG_URI,
             "defaultConfiguration": {"level": "warning"},
         }
         for rule, text in sorted(RULES.items())

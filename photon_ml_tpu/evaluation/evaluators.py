@@ -238,7 +238,10 @@ def sharded_precision_at_k(labels: Array, scores: Array, entity_ids: Array,
     """
     order = jnp.lexsort((-scores, entity_ids))
     e_s = entity_ids[order]
-    pos_s = (labels[order] > 0.5).astype(scores.dtype)
+    # Hit/count indicators accumulate in (at least) f32: bf16 segment sums
+    # round away increments once a segment count passes 256.
+    acc_t = jnp.promote_types(scores.dtype, jnp.float32)
+    pos_s = (labels[order] > 0.5).astype(acc_t)
 
     # Rank within entity = global position - entity start position.
     n = scores.shape[0]
@@ -249,9 +252,10 @@ def sharded_precision_at_k(labels: Array, scores: Array, entity_ids: Array,
 
     hits_e = jax.ops.segment_sum(jnp.where(in_top, pos_s, 0.0), e_s,
                                  num_segments=num_entities)
-    cnt_e = jax.ops.segment_sum(in_top.astype(scores.dtype), e_s,
+    cnt_e = jax.ops.segment_sum(in_top.astype(acc_t), e_s,
                                 num_segments=num_entities)
     has_rows = cnt_e > 0
-    prec_e = hits_e / jnp.maximum(cnt_e, jnp.finfo(scores.dtype).tiny)
-    return jnp.sum(jnp.where(has_rows, prec_e, 0.0)) / jnp.maximum(
+    prec_e = hits_e / jnp.maximum(cnt_e, jnp.finfo(acc_t).tiny)
+    mean = jnp.sum(jnp.where(has_rows, prec_e, 0.0)) / jnp.maximum(
         jnp.sum(has_rows), 1)
+    return mean.astype(scores.dtype)

@@ -153,7 +153,10 @@ def area_under_pr_curve(labels: Array, scores: Array,
     r_b = recall[next_boundary]
     r_prev = jnp.concatenate([jnp.zeros(1, r_b.dtype), r_b[:-1]])
     p_prev = jnp.concatenate([p_b[:1], p_b[:-1]])
-    return jnp.sum((r_b - r_prev) * 0.5 * (p_b + p_prev))
+    # Trapezoid areas are tiny per-row quantities: accumulate in at least
+    # f32 regardless of the score dtype, then return that accumulator.
+    return jnp.sum((r_b - r_prev) * 0.5 * (p_b + p_prev),
+                   dtype=jnp.promote_types(p_b.dtype, jnp.float32))
 
 
 def peak_f1(labels: Array, scores: Array, weights: Array | None = None) -> Array:
@@ -225,4 +228,7 @@ def precision_at_k(labels: Array, scores: Array, k: int,
         top_valid = valid[top_idx]
         denom = jnp.maximum(jnp.sum(top_valid), 1)
         return jnp.sum(jnp.where(top_valid, top_labels > 0.5, False)) / denom
-    return jnp.mean((top_labels > 0.5).astype(scores.dtype))
+    # Mean of 0/1 indicators: accumulate in at least f32 (a bf16 mean of
+    # >256 rows loses the low bits), cast back to the caller's dtype.
+    acc_t = jnp.promote_types(scores.dtype, jnp.float32)
+    return jnp.mean((top_labels > 0.5).astype(acc_t)).astype(scores.dtype)

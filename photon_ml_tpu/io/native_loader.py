@@ -55,24 +55,36 @@ def _compile() -> bool:
 def get_native_lib() -> Optional[ctypes.CDLL]:
     """The loaded shared library, building it on first use; None when
     unavailable (no source, no compiler, or disabled via
-    PHOTON_DISABLE_NATIVE)."""
+    PHOTON_DISABLE_NATIVE).
+
+    ``PHOTON_NATIVE_LIB`` overrides the library path with a prebuilt
+    .so and skips the build/staleness logic entirely — the sanitizer
+    harness uses it to replay the decode corpus against the
+    ASan+UBSan build (``make -C native sanitize``)."""
     global _lib, _build_failed
     if os.environ.get("PHOTON_DISABLE_NATIVE"):
         return None
+    override = os.environ.get("PHOTON_NATIVE_LIB")
     with _lock:
         if _lib is not None:
             return _lib
         if _build_failed:
             return None
-        src_mtime = _newest_source_mtime()
-        if not os.path.exists(_LIB_PATH) or (
-                src_mtime is not None
-                and src_mtime > os.path.getmtime(_LIB_PATH)):
-            if src_mtime is None or not _compile():
+        lib_path = override or _LIB_PATH
+        if override is not None:
+            if not os.path.exists(override):
                 _build_failed = True
                 return None
+        else:
+            src_mtime = _newest_source_mtime()
+            if not os.path.exists(_LIB_PATH) or (
+                    src_mtime is not None
+                    and src_mtime > os.path.getmtime(_LIB_PATH)):
+                if src_mtime is None or not _compile():
+                    _build_failed = True
+                    return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
             lib.photon_libsvm_open.restype = ctypes.c_void_p
             lib.photon_libsvm_open.argtypes = [
                 ctypes.c_char_p,

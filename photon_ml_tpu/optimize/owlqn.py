@@ -105,7 +105,10 @@ def _minimize_owlqn_impl(
 
     def full_objective(x):
         f, g = value_and_grad_fn(x, data)
-        penalty = jnp.sum(l1 * jnp.abs(x))
+        # L1 penalty sums d tiny per-coordinate terms: accumulate in at
+        # least f32 so bf16/f16 iterates don't lose the penalty entirely.
+        penalty = jnp.sum(l1 * jnp.abs(x),
+                          dtype=jnp.promote_types(dtype, jnp.float32))
         if update_axis_name is not None:
             penalty = lax.psum(penalty, update_axis_name)
         return f + penalty, g

@@ -29,6 +29,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end sweeps excluded from the tier-1 run "
+        "(-m 'not slow'), e.g. the sanitized decode-corpus replay")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

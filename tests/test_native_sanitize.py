@@ -1,0 +1,225 @@
+"""Sanitized native builds: `make -C native sanitize` (ASan+UBSan,
+-fno-sanitize-recover) and a decode-corpus replay against that build.
+
+The replay runs the existing native decode tests — libsvm parse corpus,
+the avro chaos fixtures (truncation at every offset, sync flips,
+hostile varints, single-byte corruption sweeps) — in a subprocess whose
+loader is pointed at the sanitized .so via PHOTON_NATIVE_LIB, with the
+matching libasan LD_PRELOADed so the runtime is initialized before
+ctypes dlopens the library. Any out-of-bounds read/write or UB in the
+C++ readers aborts that subprocess (-fno-sanitize-recover) and fails
+the test here.
+
+The handful of corpus tests that trigger an XLA compile are deselected:
+jit compilation aborts under an ASan-preloaded interpreter (the crash
+is inside XLA, not our readers). Their native coverage — both block
+packers and the score encoder — is replayed instead by the pure-numpy
+``--replay-packers`` driver at the bottom of this file, which exercises
+the same entry points with ragged/empty/nullable edge inputs and never
+imports jax.
+
+Both tests skip with a logged reason when no sanitizer-capable C++
+compiler is present; the full replay is slow-marked.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+SAN_LIB = os.path.join(NATIVE_DIR, "build", "sanitize",
+                       "libphoton_native.so")
+# The corpus: every test file that exercises the four native readers,
+# including the corrupt/truncated avro shard fixtures.
+CORPUS = ["tests/test_native_loader.py", "tests/test_avro.py"]
+# Corpus tests that compile through XLA; see the module docstring. Their
+# native entry points are covered by _replay_packers instead.
+XLA_DESELECTS = [
+    "tests/test_native_loader.py::test_native_block_packer_matches_numpy",
+    "tests/test_native_loader.py::test_native_ell_pack_matches_numpy",
+    "tests/test_native_loader.py::"
+    "test_duplicate_libsvm_entries_sum_in_sparse_paths",
+    "tests/test_native_loader.py::test_native_score_encoder_matches_python",
+]
+
+
+def _cxx() -> str:
+    return os.environ.get("CXX", "g++")
+
+
+def _sanitizer_reason() -> str | None:
+    """None when ASan+UBSan builds are possible here, else a skip reason."""
+    cxx = shutil.which(_cxx())
+    if cxx is None:
+        return f"no C++ compiler ({_cxx()}) on PATH"
+    try:
+        probe = subprocess.run(
+            [cxx, "-x", "c++", "-", "-std=c++17", "-fPIC", "-shared",
+             "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+             "-o", os.devnull],
+            input="int main(){return 0;}", text=True,
+            capture_output=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"sanitizer probe compile failed to run: {e}"
+    if probe.returncode != 0:
+        return ("compiler lacks -fsanitize=address,undefined support: "
+                + probe.stderr.strip().splitlines()[-1][:200]
+                if probe.stderr.strip() else "probe compile failed")
+    return None
+
+
+def _libasan_path() -> str | None:
+    """The runtime to LD_PRELOAD, resolved from the compiler itself so it
+    matches the one the sanitized .so was linked against."""
+    try:
+        out = subprocess.run(
+            [_cxx(), "-print-file-name=libasan.so"],
+            capture_output=True, text=True, timeout=30).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out if out and os.path.isabs(out) and os.path.exists(out) \
+        else None
+
+
+def _build_sanitized() -> None:
+    r = subprocess.run(["make", "-C", NATIVE_DIR, "sanitize"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"make sanitize failed:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    assert os.path.exists(SAN_LIB), f"sanitize built no {SAN_LIB}"
+
+
+def _skip_unless_sanitizer() -> None:
+    reason = _sanitizer_reason()
+    if reason is not None:
+        pytest.skip(f"sanitized native build unavailable: {reason}")
+
+
+def test_sanitize_target_builds():
+    """`make -C native sanitize` produces the instrumented library.
+
+    Cheap enough for tier-1: four translation units, no replay."""
+    _skip_unless_sanitizer()
+    _build_sanitized()
+
+
+@pytest.mark.slow
+def test_sanitized_decode_corpus_replay():
+    """Replay the whole native decode corpus with the ASan+UBSan build.
+
+    -fno-sanitize-recover means the first sanitizer report kills the
+    subprocess, so a green replay is a real memory-safety statement
+    about the malformed-input paths, not just a crash-free one."""
+    _skip_unless_sanitizer()
+    _build_sanitized()
+    libasan = _libasan_path()
+    if libasan is None:
+        pytest.skip("sanitized native build present but libasan.so not "
+                    "resolvable for LD_PRELOAD into the test subprocess")
+    env = dict(
+        os.environ,
+        PHOTON_NATIVE_LIB=SAN_LIB,
+        LD_PRELOAD=libasan,
+        # detect_leaks=0: the CPython interpreter itself "leaks" interned
+        # state at exit; leak checking would drown real reader findings.
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("PHOTON_DISABLE_NATIVE", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         *CORPUS,
+         *(a for t in XLA_DESELECTS for a in ("--deselect", t))],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=1800)
+    assert r.returncode == 0, (
+        "decode corpus under ASan+UBSan failed "
+        f"(rc={r.returncode}):\n{r.stdout[-4000:]}\n{r.stderr[-4000:]}")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join("tests", "test_native_sanitize.py"),
+         "--replay-packers"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=600)
+    assert r2.returncode == 0 and "packers-replay-ok" in r2.stdout, (
+        "packer/encoder replay under ASan+UBSan failed "
+        f"(rc={r2.returncode}):\n{r2.stdout[-4000:]}\n{r2.stderr[-4000:]}")
+
+
+def _replay_packers() -> None:
+    """Exercise the packers + score encoder without importing jax.
+
+    Run inside the sanitized subprocess (PHOTON_NATIVE_LIB + LD_PRELOAD
+    set by the test above). Covers what the deselected corpus tests
+    would have: ragged and empty ELL rows, projected-row packing through
+    pad-sentinel tables, and every nullable-field combination of the
+    score encoder including zero rows.
+    """
+    import numpy as np
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.io import native_loader as nl
+
+    assert nl.get_native_lib() is not None, \
+        "sanitized native library failed to load"
+    r = np.random.default_rng(7)
+
+    # ELL pack: ragged rows including empty rows; k = max row length.
+    for n, d in ((1, 1), (200, 50)):
+        rows, cols, vals = [], [], []
+        for i in range(n):
+            for _ in range(int(r.integers(0, 9))):
+                rows.append(i)
+                cols.append(int(r.integers(0, d)))
+                vals.append(float(r.random()))
+        mat = sp.csr_matrix((vals, (rows, cols)), shape=(n, d))
+        mat.sum_duplicates()
+        k = max(int(np.diff(mat.indptr).max(initial=0)), 1)
+        out_idx = np.zeros((n, k), np.int32)
+        out_val = np.zeros((n, k), np.float32)
+        assert nl.pack_ell_native(mat.indptr, mat.indices, mat.data, k,
+                                  out_idx, out_val)
+
+    # Projected-row pack: per-entity sorted tables with pad sentinels,
+    # features absent from a table must be skipped, not written.
+    n_rows, d, n_tables, d_red = 64, 40, 5, 8
+    mat = sp.random(n_rows, d, density=0.25,
+                    random_state=np.random.RandomState(3),
+                    format="csr", dtype=np.float32)
+    raw = np.full((n_tables, d_red), np.iinfo(np.int32).max, np.int32)
+    for t in range(n_tables):
+        width = int(r.integers(1, d_red + 1))
+        raw[t, :width] = np.sort(
+            r.choice(d, size=width, replace=False)).astype(np.int32)
+    table_of = r.integers(0, n_tables, n_rows).astype(np.int64)
+    out_row_of = np.arange(n_rows, dtype=np.int64)
+    out = np.zeros((n_rows, d_red), np.float32)
+    assert nl.pack_projected_rows_native(mat, table_of, out_row_of, raw,
+                                         out)
+
+    # Score encoder: nullable-field matrix incl. n == 0.
+    for n in (0, 1, 33):
+        scores = r.normal(size=n)
+        for uids in (None, [f"user-{i}" for i in range(n)]):
+            for labels in (None, r.normal(size=n)):
+                for weights in (None, r.random(n)):
+                    blob = nl.encode_scores_native(
+                        scores, "model-1", uids=uids, labels=labels,
+                        weights=weights)
+                    assert blob is not None
+    print("packers-replay-ok")
+
+
+if __name__ == "__main__":
+    if "--replay-packers" in sys.argv:
+        _replay_packers()
+    else:
+        sys.exit("usage: test_native_sanitize.py --replay-packers")

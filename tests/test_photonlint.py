@@ -1190,8 +1190,13 @@ def test_sarif_fixture_shape(tmp_path):
     assert doc["version"] == "2.1.0"
     run = doc["runs"][0]
     assert run["tool"]["driver"]["name"] == "photonlint"
-    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert rule_ids == set(core.RULES)
+    rules = run["tool"]["driver"]["rules"]
+    assert {r["id"] for r in rules} == set(core.RULES)
+    # per-rule metadata: a shortDescription and a helpUri into the
+    # README rule-catalog anchor, for SARIF viewers
+    for r in rules:
+        assert r["shortDescription"]["text"] == core.RULES[r["id"]]
+        assert r["helpUri"].endswith("README.md#rule-catalog")
     results = run["results"]
     assert len(results) == 1
     assert results[0]["ruleId"] == "W601"
@@ -1430,3 +1435,654 @@ def test_canaries_turn_the_run_red(seeded_package):
               if f.rule in CANARIES]
     assert all(f.path == "photon_ml_tpu/game/coordinate_descent.py"
                for f in seeded)
+
+
+# -- W8xx precision dtype-flow ----------------------------------------------
+
+W801_POSITIVE = """
+import jax
+import jax.numpy as jnp
+
+def total_loss(per_example, a, b):
+    acts = per_example.astype(jnp.bfloat16)
+    total = jnp.sum(acts)                      # W801: bf16 sum, no acc
+    lhs = a.astype(jnp.bfloat16)
+    rhs = b.astype(jnp.bfloat16)
+    prod = lhs @ rhs                           # W801: bf16 matmul
+    return total, prod
+"""
+
+W801_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+
+def total_loss(per_example, a, b):
+    acts = per_example.astype(jnp.bfloat16)
+    total = jnp.sum(acts, dtype=jnp.float32)       # explicit accumulator
+    upcast = jnp.sum(acts.astype(jnp.float32))     # upcast clears taint
+    lhs = a.astype(jnp.bfloat16)
+    rhs = b.astype(jnp.bfloat16)
+    prod = jax.lax.dot_general(
+        lhs, rhs, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # f32 accumulation
+    kept = jnp.sum(per_example)                    # unknown dtype: clean
+    return total, upcast, prod, kept
+"""
+
+W801_SUPPRESSED = """
+import jax.numpy as jnp
+
+def total_loss(per_example):
+    acts = per_example.astype(jnp.bfloat16)
+    # photonlint: allow-W801(fixture: bf16 partial sum re-reduced in f32)
+    return jnp.sum(acts)
+"""
+
+
+def test_w801_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W801_POSITIVE},
+                         families={"W8"})
+    assert [f.rule for f in report.new] == ["W801", "W801"]
+
+
+def test_w801_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W801_NEGATIVE},
+                         families={"W8"})
+    assert report.new == []
+
+
+def test_w801_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W801_SUPPRESSED},
+                         families={"W8"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W801"]
+
+
+W802_POSITIVE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(x):
+    scale = jnp.asarray(1.0, dtype=jnp.float64)    # W802: f64 under jit
+    return x * scale
+"""
+
+W802_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+@jax.jit
+def kernel(x):
+    scale = jnp.asarray(1.0, dtype=jnp.float64)    # guarded: x64 enabled
+    return x * scale
+
+def host_accumulate(xs):
+    return jnp.asarray(xs, dtype=jnp.float32)
+"""
+
+W802_SUPPRESSED = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(x):
+    # photonlint: allow-W802(fixture: caller asserts x64 mode at startup)
+    scale = jnp.asarray(1.0, dtype=jnp.float64)
+    return x * scale
+"""
+
+
+def test_w802_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W802_POSITIVE},
+                         families={"W8"})
+    assert [f.rule for f in report.new] == ["W802"]
+
+
+def test_w802_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W802_NEGATIVE},
+                         families={"W8"})
+    assert report.new == []
+
+
+def test_w802_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W802_SUPPRESSED},
+                         families={"W8"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W802"]
+
+
+W803_POSITIVE = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+def run(v):
+    host = np.asarray(kernel(v))
+    return kernel(host)            # W803: round-trip re-enters jit
+"""
+
+W803_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+def run(v):
+    host = np.asarray(kernel(v))
+    np.save("/tmp/x.npy", host)    # host-side consumption only
+    return kernel(jnp.asarray(host, dtype=jnp.float32))  # explicit dtype
+"""
+
+W803_SUPPRESSED = """
+import jax
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+def run(v):
+    host = np.asarray(kernel(v))
+    # photonlint: allow-W803(fixture: dtype identical by construction)
+    return kernel(host)
+"""
+
+
+def test_w803_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W803_POSITIVE},
+                         families={"W8"})
+    assert [f.rule for f in report.new] == ["W803"]
+
+
+def test_w803_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W803_NEGATIVE},
+                         families={"W8"})
+    assert report.new == []
+
+
+def test_w803_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W803_SUPPRESSED},
+                         families={"W8"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W803"]
+
+
+W804_POSITIVE = """
+import jax.numpy as jnp
+
+def loss_fn(preds, targets):
+    p16 = preds.astype(jnp.bfloat16)
+    t32 = targets.astype(jnp.float32)
+    return p16 - t32               # W804: implicit promotion in loss path
+"""
+
+W804_NEGATIVE = """
+import jax.numpy as jnp
+
+def loss_fn(preds, targets):
+    p = preds.astype(jnp.float32)  # explicit cast: the decision is visible
+    t = targets.astype(jnp.float32)
+    return p - t
+
+def combine(a, b):
+    lo = a.astype(jnp.bfloat16)
+    hi = b.astype(jnp.float32)
+    return lo * hi                 # not a loss/grad path: quiet
+"""
+
+W804_SUPPRESSED = """
+import jax.numpy as jnp
+
+def loss_fn(preds, targets):
+    p16 = preds.astype(jnp.bfloat16)
+    t32 = targets.astype(jnp.float32)
+    # photonlint: allow-W804(fixture: promotion to f32 is the intent)
+    return p16 - t32
+"""
+
+
+def test_w804_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W804_POSITIVE},
+                         families={"W8"})
+    assert [f.rule for f in report.new] == ["W804"]
+
+
+def test_w804_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W804_NEGATIVE},
+                         families={"W8"})
+    assert report.new == []
+
+
+def test_w804_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W804_SUPPRESSED},
+                         families={"W8"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W804"]
+
+
+# -- W9xx host-concurrency safety -------------------------------------------
+
+W901_POSITIVE = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._count = 0
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._count += 1       # W901: thread write, unlocked reader
+
+    def snapshot(self):
+        return self._count
+
+    def stop(self):
+        self._thread.join()
+"""
+
+W901_NEGATIVE = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._count = 0
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._count
+
+    def stop(self):
+        self._thread.join()
+"""
+
+W901_SUPPRESSED = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._count = 0
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            # photonlint: allow-W901(fixture: int store is atomic enough here)
+            self._count += 1
+
+    def snapshot(self):
+        return self._count
+
+    def stop(self):
+        self._thread.join()
+"""
+
+
+def test_w901_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W901_POSITIVE},
+                         families={"W9"})
+    assert [f.rule for f in report.new] == ["W901"]
+    assert "_count" in report.new[0].message
+
+
+def test_w901_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W901_NEGATIVE},
+                         families={"W9"})
+    assert report.new == []
+
+
+def test_w901_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W901_SUPPRESSED},
+                         families={"W9"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W901"]
+
+
+W901_GUARD_POSITIVE = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = {}
+
+    def inc(self, key):
+        self._values[key] = self._values.get(key, 0) + 1   # W901: bare
+
+    def total(self):
+        with self._lock:
+            return sum(self._values.values())
+"""
+
+
+def test_w901_inconsistent_guard_positive(tmp_path):
+    """The other W901 shape: a lock guards reads of an attribute while a
+    write elsewhere skips it."""
+    report = run_fixture(tmp_path, {"mod.py": W901_GUARD_POSITIVE},
+                         families={"W9"})
+    assert [f.rule for f in report.new] == ["W901"]
+    assert "_values" in report.new[0].message
+
+
+W902_POSITIVE = """
+import signal
+import time
+
+class Latch:
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        time.sleep(0.1)            # W902: not async-signal-safe
+"""
+
+W902_NEGATIVE = """
+import os
+import signal
+import threading
+
+class Latch:
+    def __init__(self):
+        self._event = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self._event.set()
+        os.kill(os.getpid(), signum)
+"""
+
+W902_SUPPRESSED = """
+import signal
+import time
+
+class Latch:
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        # photonlint: allow-W902(fixture: test-only handler, never installed in prod)
+        time.sleep(0.1)
+"""
+
+
+def test_w902_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W902_POSITIVE},
+                         families={"W9"})
+    assert [f.rule for f in report.new] == ["W902"]
+    assert "time.sleep" in report.new[0].message
+
+
+def test_w902_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W902_NEGATIVE},
+                         families={"W9"})
+    assert report.new == []
+
+
+def test_w902_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W902_SUPPRESSED},
+                         families={"W9"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W902"]
+
+
+W903_POSITIVE = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()       # W903: never joined
+
+    def _run(self):
+        pass
+"""
+
+W903_NEGATIVE = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def close(self):
+        self._thread.join()
+
+    def _run(self):
+        pass
+"""
+
+W903_SUPPRESSED = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        # photonlint: allow-W903(fixture: process-lifetime daemon by design)
+        self._thread.start()
+
+    def _run(self):
+        pass
+"""
+
+
+def test_w903_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W903_POSITIVE},
+                         families={"W9"})
+    assert [f.rule for f in report.new] == ["W903"]
+    assert "_thread" in report.new[0].message
+
+
+def test_w903_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W903_NEGATIVE},
+                         families={"W9"})
+    assert report.new == []
+
+
+def test_w903_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W903_SUPPRESSED},
+                         families={"W9"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W903"]
+
+
+W904_POSITIVE = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def one(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def two(self):
+        with self._lb:
+            with self._la:         # W904: reversed nesting
+                pass
+"""
+
+W904_NEGATIVE = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def one(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def two(self):
+        with self._la:
+            with self._lb:
+                pass
+"""
+
+
+def test_w904_positive(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W904_POSITIVE},
+                         families={"W9"})
+    assert [f.rule for f in report.new] == ["W904"]
+
+
+def test_w904_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W904_NEGATIVE},
+                         families={"W9"})
+    assert report.new == []
+
+
+def test_w904_suppressed(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": W904_POSITIVE},
+                         families={"W9"})
+    assert len(report.new) == 1
+    line = report.new[0].line
+    src = W904_POSITIVE.splitlines()
+    src.insert(line - 1,
+               "            # photonlint: allow-W904"
+               "(fixture: methods never run concurrently)")
+    report = run_fixture(tmp_path, {"mod.py": "\n".join(src) + "\n"},
+                         families={"W9"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["W904"]
+
+
+# -- W8xx / W9xx seeded canaries --------------------------------------------
+
+def test_w801_seeded_pallas_accumulator_deletion(tmp_path_factory):
+    """Deleting ``preferred_element_type=jnp.float32`` from the pallas
+    margin matmul must fire W801 on a scratch copy — the f32-accumulator
+    convention is enforced, not just commented."""
+    root = tmp_path_factory.mktemp("pallas_acc")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    target = root / "photon_ml_tpu" / "ops" / "pallas_kernels.py"
+    src = target.read_text()
+    needle = (
+        "    z = (jax.lax.dot_general(\n"
+        "        X, w_col, (((1,), (0,)), ((), ())),\n"
+        "        preferred_element_type=jnp.float32).reshape(-1)\n")
+    assert needle in src, "pallas margin matmul moved; update this test"
+    target.write_text(src.replace(needle, (
+        "    z = (jax.lax.dot_general(\n"
+        "        X, w_col, (((1,), (0,)), ((), ()))).reshape(-1)\n")))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         families={"W8"})
+    w801 = [f for f in report.new if f.rule == "W801"
+            and f.path == "photon_ml_tpu/ops/pallas_kernels.py"]
+    assert w801, [f.format() for f in report.new]
+
+
+def test_w901_seeded_metrics_lock_deletion(tmp_path_factory):
+    """Deleting the ``with self._lock:`` acquire around Counter.inc's
+    write must fire W901 on a scratch copy."""
+    root = tmp_path_factory.mktemp("metrics_lock")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    target = root / "photon_ml_tpu" / "obs" / "metrics.py"
+    src = target.read_text()
+    needle = ("        with self._lock:\n"
+              "            self._values[key] = "
+              "self._values.get(key, 0) + n\n")
+    assert needle in src, "Counter.inc moved; update this test"
+    target.write_text(src.replace(needle, (
+        "        self._values[key] = self._values.get(key, 0) + n\n")))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         families={"W9"})
+    w901 = [f for f in report.new if f.rule == "W901"
+            and f.path == "photon_ml_tpu/obs/metrics.py"]
+    assert w901, [f.format() for f in report.new]
+    assert "_values" in w901[0].message
+
+
+def test_w902_seeded_preempt_sleep_insertion(tmp_path_factory):
+    """A ``time.sleep`` added to the preempt SIGTERM latch handler must
+    fire W902 on a scratch copy — the async-signal-safety of
+    utils/preempt.py is enforced, not assumed."""
+    root = tmp_path_factory.mktemp("preempt_sleep")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    target = root / "photon_ml_tpu" / "utils" / "preempt.py"
+    src = target.read_text()
+    needle = "    def _on_signal(self, signum, frame) -> None:\n"
+    assert needle in src, "preempt._on_signal moved; update this test"
+    target.write_text(src.replace(
+        needle, needle + "        time.sleep(0.5)\n"))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         families={"W9"})
+    w902 = [f for f in report.new if f.rule == "W902"
+            and f.path == "photon_ml_tpu/utils/preempt.py"]
+    assert w902, [f.format() for f in report.new]
+    assert "time.sleep" in w902[0].message
+
+
+def test_exemplars_clean_without_suppressions():
+    """pallas_kernels.py and preempt.py must be clean BY CONSTRUCTION —
+    zero W8xx/W9xx findings and zero suppression directives."""
+    for rel in ("photon_ml_tpu/ops/pallas_kernels.py",
+                "photon_ml_tpu/utils/preempt.py"):
+        assert "photonlint:" not in (REPO_ROOT / rel).read_text(), \
+            f"{rel} must not need suppressions"
+    report = runner.lint(REPO_ROOT, paths=["photon_ml_tpu"],
+                         families={"W8", "W9"}, baseline=None)
+    hits = [f for f in report.new
+            if f.path in ("photon_ml_tpu/ops/pallas_kernels.py",
+                          "photon_ml_tpu/utils/preempt.py")]
+    assert hits == [], [f.format() for f in hits]
+
+
+def test_changed_files_filter_keeps_whole_program_resolution(tmp_path):
+    """changed_paths restricts the report, not the analysis: the same
+    fixture reports its W801 when its file is in the changed set and
+    nothing when only the other file is."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(W801_POSITIVE)
+    (pkg / "cold.py").write_text("x = 1\n")
+    report = runner.lint(tmp_path, paths=["pkg"], families={"W8"},
+                         changed_paths={"pkg/hot.py"})
+    assert [f.rule for f in report.new] == ["W801", "W801"]
+    report = runner.lint(tmp_path, paths=["pkg"], families={"W8"},
+                         changed_paths={"pkg/cold.py"})
+    assert report.new == []

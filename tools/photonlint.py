@@ -10,6 +10,8 @@ Usage (from the repo root)::
     python tools/photonlint.py --write-baseline      # grandfather all
     python tools/photonlint.py --no-baseline         # raw findings
     python tools/photonlint.py --rules W1,W4         # family subset
+    python tools/photonlint.py --changed-files       # only files vs HEAD
+    python tools/photonlint.py --since origin/main   # only files vs rev
     python tools/photonlint.py --trace-evidence runs/trace  # W702 mode
     python tools/photonlint.py --list-rules
 
@@ -41,6 +43,25 @@ DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
 DEFAULT_README = os.path.join(_REPO_ROOT, "README.md")
 
 
+def changed_py_files(root: str, rev: str) -> set[str]:
+    """Root-relative posix paths of .py files changed vs ``rev``.
+
+    Union of the working-tree diff against ``rev`` and untracked files,
+    so a brand-new module is linted before its first ``git add``.
+    """
+    import subprocess
+
+    def run(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args, "--", "*.py"], cwd=root, check=True,
+            capture_output=True, text=True).stdout
+
+    lines = (run("diff", "--name-only", rev).splitlines()
+             + run("ls-files", "--others", "--exclude-standard")
+               .splitlines())
+    return {p.strip() for p in lines if p.strip().endswith(".py")}
+
+
 def parse_args(argv):
     ap = argparse.ArgumentParser(
         prog="photonlint",
@@ -69,6 +90,12 @@ def parse_args(argv):
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule families to run, e.g. "
                          "W1,W4 (default: all)")
+    ap.add_argument("--changed-files", action="store_true",
+                    help="report only findings in files changed vs "
+                         "--since (default HEAD); the analysis is "
+                         "still whole-program")
+    ap.add_argument("--since", default=None, metavar="REV",
+                    help="git rev for --changed-files (implies it)")
     ap.add_argument("--trace-evidence", default=None, metavar="DIR",
                     help="directory of obs/trace spans (*.jsonl); "
                          "xla.retrace records there drive W702 "
@@ -93,6 +120,24 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     paths = ns.paths or None
+    changed = None
+    if ns.changed_files or ns.since:
+        if ns.write_baseline:
+            print("photonlint: --write-baseline is whole-program; it "
+                  "cannot combine with --changed-files/--since",
+                  file=sys.stderr)
+            return 2
+        rev = ns.since or "HEAD"
+        try:
+            changed = changed_py_files(ns.root, rev)
+        except Exception as e:  # subprocess or git failure
+            print(f"photonlint: error: git diff vs {rev!r} failed: {e}",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print(f"photonlint: no .py files changed vs {rev}; "
+                  "nothing to report")
+            return 0
     try:
         if ns.write_baseline:
             from photon_ml_tpu.analysis.core import load_baseline
@@ -114,7 +159,8 @@ def main(argv=None) -> int:
         report = runner.lint(
             ns.root, paths=paths, readme=ns.readme,
             baseline=None if ns.no_baseline else ns.baseline,
-            families=families, trace_dir=ns.trace_evidence)
+            families=families, trace_dir=ns.trace_evidence,
+            changed_paths=changed)
     except (OSError, ValueError, SyntaxError) as e:
         print(f"photonlint: error: {e}", file=sys.stderr)
         return 2
