@@ -1218,6 +1218,153 @@ def bench_avro_ingest(n=200_000, d=30) -> dict:
             "features_per_sec": round(data.features.nnz / dt, 0)}
 
 
+def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
+                duration_secs=3.0) -> dict:
+    """Sustained concurrent-client load against a real photon-serve
+    subprocess: NDJSON protocol + micro-batcher + tiered store, end to
+    end. The HBM budget holds half the entities so the device tier
+    churns under load; the probe reports client-observed rows/sec, the
+    service's own SLO gauges, and the per-tier hit split read back from
+    the exit metrics snapshot."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (
+        FixedEffectModel, GameModel, RandomEffectModel)
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.models.glm import (
+        Coefficients, GeneralizedLinearModel)
+    from photon_ml_tpu.optimize.config import TaskType
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    rng = np.random.default_rng(17)
+    imaps = {
+        "global": IndexMap.from_keys([f"g{j}" for j in range(d_g)],
+                                     add_intercept=True),
+        "user": IndexMap.from_keys([f"u{j}" for j in range(d_u)],
+                                   add_intercept=True),
+    }
+    fixed = FixedEffectModel(GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=len(imaps["global"])),
+                                 jnp.float32)),
+        TaskType.LINEAR_REGRESSION), "global")
+    vocab = np.asarray([f"user{u}" for u in range(n_users)])
+    re_model = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n_users),
+        coefficients=jnp.asarray(
+            rng.normal(size=(n_users, len(imaps["user"]))), jnp.float32))
+    records = []
+    for i in range(512):
+        u = int(rng.integers(0, n_users))
+        records.append({
+            "uid": f"r{i}", "metadataMap": {"userId": f"user{u}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(rng.normal())}
+                               for j in range(d_g)],
+            "userFeatures": [{"name": f"u{j}", "term": "",
+                              "value": float(rng.normal())}
+                             for j in range(d_u)],
+        })
+    row_bytes = len(imaps["user"]) * 4
+    budget_mb = (n_users // 2) * row_bytes / (1 << 20)
+    rows_scored = [0] * n_clients
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = os.path.join(tmp, "model")
+        save_game_model(GameModel({"fixed": fixed, "per-user": re_model}),
+                        model_dir, imaps, entity_vocabs={"userId": vocab})
+        trace = os.path.join(tmp, "trace")
+        sock = os.path.join(tmp, "serve.sock")
+        # the serve subprocess is pinned to CPU so the probe never
+        # contends with the parent bench for the accelerator; it
+        # measures protocol + batcher + tier overhead, not chip FLOPs
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "photon_ml_tpu.serve.service",
+             "--game-model-input-dir", model_dir,
+             "--listen", f"unix:{sock}",
+             "--feature-shard-id-to-feature-section-keys-map",
+             "global:globalFeatures|user:userFeatures",
+             "--random-effect-id-set", "userId",
+             "--max-batch-rows", "256",
+             "--serve-hbm-budget-mb", f"{budget_mb:.6f}",
+             "--trace-dir", trace,
+             "--trace-heartbeat-seconds", "0.5"],
+            env=env, cwd=_REPO_DIR, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        ready = proc.stdout.readline().strip()
+        if "ready endpoint=" not in ready:
+            proc.kill()
+            raise RuntimeError(f"serve probe: no ready line: {ready!r}")
+        endpoint = ready.split("endpoint=", 1)[1]
+
+        def client_loop(ci):
+            # mixed request sizes landing on a handful of pad buckets —
+            # the adaptive-batching shape the service is built for
+            sizes = (1, 4, 13, 64)
+            crng = np.random.default_rng(100 + ci)
+            with ServeClient(endpoint) as client:
+                deadline = time.perf_counter() + duration_secs
+                while time.perf_counter() < deadline:
+                    n = int(sizes[crng.integers(0, len(sizes))])
+                    lo = int(crng.integers(0, len(records) - n))
+                    resp = client.score(records[lo:lo + n])
+                    if resp.get("kind") == "scores":
+                        rows_scored[ci] += len(resp["scores"])
+
+        threads = [threading.Thread(target=client_loop, args=(ci,))
+                   for ci in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        with ServeClient(endpoint) as client:
+            stats = client.stats()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        # per-tier hit split: the exit snapshot is the only labeled view
+        # (heartbeats carry label-summed totals only)
+        tier_hits: dict = {}
+        shed = 0.0
+        with open(os.path.join(trace, "metrics.jsonl")) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("kind") != "counter":
+                    continue
+                if rec.get("name") == "serve_tier_hits":
+                    tier = rec.get("labels", {}).get("tier", "?")
+                    tier_hits[tier] = tier_hits.get(tier, 0) \
+                        + rec.get("value", 0)
+                elif rec.get("name") == "serve_shed":
+                    shed += rec.get("value", 0)
+    total_rows = int(sum(rows_scored))
+    total_hits = sum(tier_hits.values())
+    return {
+        "clients": n_clients,
+        "rows_scored": total_rows,
+        "rows_per_sec": round(total_rows / dt, 0),
+        "qps": round(float(stats.get("qps") or 0.0), 1),
+        "p50_ms": round(float(stats.get("p50_ms") or 0.0), 2),
+        "p99_ms": round(float(stats.get("p99_ms") or 0.0), 2),
+        "device_tier_hit_rate": round(
+            tier_hits.get("device", 0) / total_hits, 3) if total_hits
+        else None,
+        "tier_hits": {k: int(v) for k, v in sorted(tier_hits.items())},
+        "shed": int(shed),
+    }
+
+
 def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
                  n_entities=50_000) -> dict:
     """10M-row ingestion: vectorized ELL pack + random-effect block build
@@ -1510,6 +1657,8 @@ def main():
     game_full = bench_game_full()
     _progress("avro ingest bench")
     avro_ingest = bench_avro_ingest()
+    _progress("serve probe")
+    serve = bench_serve()
     _progress("ingest bench")
     ingest = _bench_ingest_isolated()
     _progress("streamed ingest bench")
@@ -1544,6 +1693,7 @@ def main():
         "glmix": glmix,
         "game_full": game_full,
         "avro_ingest": avro_ingest,
+        "serve": serve,
         "ingest": ingest,
         "ingest_streamed": ingest_streamed,
     }

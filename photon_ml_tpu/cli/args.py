@@ -1,0 +1,72 @@
+"""Shared CLI flag grammars and parse-time validation.
+
+Both GAME drivers (training and scoring) and the serving entrypoint
+speak the same ``Params.scala`` flag dialect; the parsers lived as
+private helpers of the training driver and were imported across driver
+modules as another driver's privates. They are shared surface — this
+module is their home.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_key_value_map(s: str) -> dict[str, str]:
+    """``key1:v|key2:v`` → dict (Params.scala:316-371 line format)."""
+    out = {}
+    for line in s.split("|"):
+        if not line.strip():
+            continue
+        key, _, value = line.partition(":")
+        out[key.strip()] = value.strip()
+    return out
+
+
+def parse_section_keys_map(s: str) -> dict[str, list[str]]:
+    return {k: [x.strip() for x in v.split(",") if x.strip()]
+            for k, v in parse_key_value_map(s).items()}
+
+
+def check_telemetry_flags(p: argparse.ArgumentParser,
+                          ns: argparse.Namespace) -> None:
+    """Fail flag misuse at parse time with argparse's one-line usage
+    error (exit 2), not a ValueError traceback from the obs wiring."""
+    if getattr(ns, "device_telemetry", False) and not ns.trace_dir:
+        p.error("--device-telemetry requires --trace-dir (compile spans "
+                "and hbm gauges ride the run's span spill + heartbeat)")
+    if not getattr(ns, "telemetry_endpoint", None):
+        return
+    if not ns.trace_dir:
+        p.error("--telemetry-endpoint requires --trace-dir (the live "
+                "stream is fed by the run's span spill + heartbeat)")
+    from photon_ml_tpu.obs.export import parse_endpoint
+
+    try:
+        parse_endpoint(ns.telemetry_endpoint)
+    except ValueError as e:
+        p.error(str(e))
+
+
+def add_observability_flags(p: argparse.ArgumentParser,
+                            heartbeat_default: float = 10.0,
+                            stall_default: float = 120.0) -> None:
+    """The ``--trace-dir`` flag family every long-running entrypoint
+    shares (training, scoring, serving)."""
+    p.add_argument("--trace-dir",
+                   help="enable span tracing/metrics for this run and "
+                        "write trace.json (Chrome trace events), "
+                        "spans.jsonl, metrics.jsonl and "
+                        "run_manifest.json here")
+    p.add_argument("--trace-heartbeat-seconds", type=float,
+                   default=heartbeat_default)
+    p.add_argument("--trace-stall-seconds", type=float,
+                   default=stall_default)
+    p.add_argument("--telemetry-endpoint",
+                   help="with --trace-dir: stream telemetry records "
+                        "live to this consumer (host:port, "
+                        "unix:/path.sock, or file:/path.jsonl)")
+    p.add_argument("--device-telemetry", action="store_true",
+                   help="with --trace-dir: arm the device plane "
+                        "(xla.compile spans, retrace-cause records, "
+                        "hbm_bytes gauges, peak_hbm_bytes on run_end)")

@@ -23,20 +23,23 @@ from photon_ml_tpu.evaluation.evaluators import (
     evaluate_many,
     resolve_entity_ids,
 )
-from photon_ml_tpu.io.data_format import (
-    NameAndTermFeatureSets,
-    load_game_dataset_avro,
+from photon_ml_tpu.io.data_format import load_game_dataset_avro
+from photon_ml_tpu.io.model_io import save_scored_items
+from photon_ml_tpu.serve.scoring import (
+    load_scoring_model,
+    resolve_index_maps,
+    score_game_dataset,
 )
-from photon_ml_tpu.io.model_io import load_game_model, save_scored_items
 from photon_ml_tpu.utils import parse_flag
 from photon_ml_tpu.utils.logging import PhotonLogger, timed_phase
 from photon_ml_tpu.utils.compile_cache import (
     enable_persistent_compile_cache,
 )
 
-from photon_ml_tpu.cli.game_training_driver import (
-    _parse_key_value_map,
-    _parse_section_keys_map,
+from photon_ml_tpu.cli.args import (
+    check_telemetry_flags,
+    parse_key_value_map,
+    parse_section_keys_map,
 )
 
 
@@ -104,11 +107,7 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "hbm_bytes gauges, peak_hbm_bytes on run_end) — "
                         "same contract as the training driver")
     ns = p.parse_args(argv)
-    from photon_ml_tpu.cli.game_training_driver import (
-        _check_telemetry_flags,
-    )
-
-    _check_telemetry_flags(p, ns)
+    check_telemetry_flags(p, ns)
     return ns
 
 
@@ -120,11 +119,11 @@ class GameScoringDriver:
         self.ns = ns
         self.logger = logger or PhotonLogger(
             os.path.join(ns.output_dir, "game-scoring.log"), echo=False)
-        self.section_keys = _parse_section_keys_map(
+        self.section_keys = parse_section_keys_map(
             ns.feature_shard_id_to_feature_section_keys_map)
         self.intercept_map = {
             k: parse_flag(v)
-            for k, v in _parse_key_value_map(
+            for k, v in parse_key_value_map(
                 ns.feature_shard_id_to_intercept_map).items()}
         self.evaluators = [EvaluatorSpec.parse(x)
                            for x in ns.evaluator_type.split(",")
@@ -154,32 +153,19 @@ class GameScoringDriver:
                 shutil.rmtree(ns.output_dir)
         os.makedirs(ns.output_dir, exist_ok=True)
 
-        # Feature maps: from the feature lists when given, else from the
-        # model files themselves (loadGameModelFromHDFS's no-index path).
-        index_maps = {}
-        all_sections = sorted({s for secs in self.section_keys.values()
-                               for s in secs})
-        if getattr(ns, "offheap_indexmap_dir", None):
-            from photon_ml_tpu.io.feature_index_job import load_feature_index
-
-            # offheap=True matches the legacy driver's hard requirement: the
-            # flag asks for the off-heap store, missing meta fails loudly
-            index_maps.update(load_feature_index(
-                ns.offheap_indexmap_dir, sorted(self.section_keys),
-                offheap=True,
-                expected_partitions=getattr(
-                    ns, "offheap_indexmap_num_partitions", None)))
-        elif ns.feature_name_and_term_set_path:
-            sets = NameAndTermFeatureSets.load(
-                ns.feature_name_and_term_set_path, all_sections)
-            for shard, sections in self.section_keys.items():
-                index_maps[shard] = sets.index_map(
-                    sections,
-                    add_intercept=self.intercept_map.get(shard, True))
+        # Feature maps + model load: the shared serving core
+        # (serve/scoring.py) — the always-on service runs the same two
+        # calls, so batch and served scores agree by construction.
+        index_maps = resolve_index_maps(
+            self.section_keys, self.intercept_map,
+            feature_set_path=ns.feature_name_and_term_set_path,
+            offheap_dir=getattr(ns, "offheap_indexmap_dir", None),
+            offheap_partitions=getattr(
+                ns, "offheap_indexmap_num_partitions", None))
 
         with timed_phase("loadModel", self.logger):
-            model, index_maps = load_game_model(
-                ns.game_model_input_dir, index_maps or None)
+            model, index_maps = load_scoring_model(
+                ns.game_model_input_dir, index_maps)
         self.logger.info(f"model coordinates: {model.coordinate_ids}")
 
         id_types = sorted(
@@ -227,7 +213,7 @@ class GameScoringDriver:
             f"{ingest.coverage_fraction:.1%})")
 
         with timed_phase("scoreGameDataSet", self.logger):
-            scores = np.asarray(model.score(data))
+            scores = score_game_dataset(model, data)
 
         save_scored_items(
             os.path.join(ns.output_dir, "scores",

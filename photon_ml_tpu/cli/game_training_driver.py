@@ -86,6 +86,12 @@ from photon_ml_tpu.utils.compile_cache import (
     enable_persistent_compile_cache,
 )
 
+from photon_ml_tpu.cli.args import (
+    check_telemetry_flags,
+    parse_key_value_map,
+    parse_section_keys_map,
+)
+
 
 class ModelOutputMode:
     """io/ModelOutputMode.scala: ALL / BEST / NONE."""
@@ -95,20 +101,11 @@ class ModelOutputMode:
     NONE = "NONE"
 
 
-def _parse_key_value_map(s: str) -> dict[str, str]:
-    """``key1:v|key2:v`` → dict (Params.scala:316-371 line format)."""
-    out = {}
-    for line in s.split("|"):
-        if not line.strip():
-            continue
-        key, _, value = line.partition(":")
-        out[key.strip()] = value.strip()
-    return out
-
-
-def _parse_section_keys_map(s: str) -> dict[str, list[str]]:
-    return {k: [x.strip() for x in v.split(",") if x.strip()]
-            for k, v in _parse_key_value_map(s).items()}
+# The composite-flag grammars are shared CLI surface (the scoring
+# driver and the serving entrypoint speak the same dialect); they live
+# in cli/args.py now. The old private names stay importable.
+_parse_key_value_map = parse_key_value_map
+_parse_section_keys_map = parse_section_keys_map
 
 
 def _parse_opt_config_grid(s: str) -> list[dict[str,
@@ -406,24 +403,8 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     return ns
 
 
-def _check_telemetry_flags(p: argparse.ArgumentParser,
-                           ns: argparse.Namespace) -> None:
-    """Fail flag misuse at parse time with argparse's one-line usage
-    error (exit 2), not a ValueError traceback from the obs wiring."""
-    if getattr(ns, "device_telemetry", False) and not ns.trace_dir:
-        p.error("--device-telemetry requires --trace-dir (compile spans "
-                "and hbm gauges ride the run's span spill + heartbeat)")
-    if not getattr(ns, "telemetry_endpoint", None):
-        return
-    if not ns.trace_dir:
-        p.error("--telemetry-endpoint requires --trace-dir (the live "
-                "stream is fed by the run's span spill + heartbeat)")
-    from photon_ml_tpu.obs.export import parse_endpoint
-
-    try:
-        parse_endpoint(ns.telemetry_endpoint)
-    except ValueError as e:
-        p.error(str(e))
+# Shared parse-time validation (cli/args.py); old private name kept.
+_check_telemetry_flags = check_telemetry_flags
 
 
 class GameTrainingDriver:

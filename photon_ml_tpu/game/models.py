@@ -77,6 +77,19 @@ def _codes_via_ids(ids: np.ndarray, vocab: np.ndarray,
 # ---------------------------------------------------------------------------
 
 
+def rowwise_sparse_dot(mat, w_rows: np.ndarray) -> Array:
+    """Per-row sparse-dense dot ``Σ_j x_ij w_ij`` for CSR ``mat`` [N, D]
+    against dense per-row coefficient rows ``w_rows`` [N, D].
+
+    Shared between :meth:`RandomEffectModel.score` and the serving
+    path's tiered coefficient store (``photon_ml_tpu/serve``): both
+    must produce bit-identical contributions for the same rows, so they
+    run the same expression — scipy's f64 accumulation, cast to the
+    array default dtype on the way into the Σ-coordinate fold."""
+    prod = mat.multiply(w_rows).sum(axis=1)
+    return jnp.asarray(np.asarray(prod).ravel())
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectModel:
     """GLM over one feature shard (model/FixedEffectModel.scala:29-103)."""
@@ -139,9 +152,7 @@ class RandomEffectModel:
                            np.zeros((1, self.coefficients.shape[1]),
                                     dtype=np.asarray(self.coefficients).dtype)])
         w_rows = coefs[local]  # [N, D]
-        # rowwise sparse-dense dot: Σ_j x_ij w_ij
-        prod = mat.multiply(w_rows).sum(axis=1)
-        return jnp.asarray(np.asarray(prod).ravel())
+        return rowwise_sparse_dot(mat, w_rows)
 
 
 @dataclasses.dataclass(frozen=True)

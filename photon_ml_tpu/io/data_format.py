@@ -805,48 +805,22 @@ def _columnar_game_dataset(
     return ds
 
 
-def load_game_dataset_avro(
-        path: str | Sequence[str],
+def game_dataset_from_records(
+        records: Sequence[dict],
         feature_shard_sections: dict[str, Sequence[str]],
         index_maps: dict[str, IndexMap],
         id_types: Sequence[str] = (),
-        response_required: bool = True,
-        policy=None) -> GameDataset:
-    """Avro records → columnar :class:`GameDataset`: one CSR per feature
-    shard (union of that shard's sections, intercept appended when the
-    shard's index map has the intercept key), response/offset/weight
-    columns, dictionary-encoded id columns, uids kept when present.
+        response_required: bool = True) -> GameDataset:
+    """Decoded GAME records (dicts in the Avro record shape) →
+    :class:`GameDataset`.
 
-    ``path`` may be a single file/directory or a list of them (the dated
-    daily-partition layout resolves to several directories). Dispatches to
-    the native columnar decoder when available (falls back per schema
-    shape).
-
-    ``policy`` (an :class:`~photon_ml_tpu.data.ingest.IngestPolicy`)
-    engages shard-level quarantine on BOTH decode paths: a corrupt,
-    truncated, or persistently unreadable part file is skipped (with a
-    ``ShardQuarantinedEvent`` and a recorded coverage fraction) instead
-    of killing the load; past the policy's loss budget the load aborts
-    cleanly with ``ShardLossExceededError``."""
-    paths = [path] if isinstance(path, str) else list(path)
-    fast = _columnar_game_dataset(paths, feature_shard_sections,
-                                  index_maps, id_types, response_required,
-                                  policy=policy)
-    if fast is not None:
-        return fast
-    if policy is not None:
-        # shard-granular interpreted fallback: quarantine per part file
-        part_files = [f for p in paths for f in _columnar_part_paths(p)]
-        policy.begin(len(part_files))
-        records = []
-        for pf in part_files:
-            out = _read_shard(pf, policy=policy)
-            if out is not None:
-                records.extend(out[1])
-    elif isinstance(path, str):
-        records = _read_records(path)
-    else:
-        records = [r for p in path for r in _read_records(p)]
+    This IS the interpreted assembly loop of
+    :func:`load_game_dataset_avro`, shared verbatim with the serving
+    request path (``photon_ml_tpu/serve``): a scoring request's NDJSON
+    rows go through the same feature-key probing, duplicate detection,
+    intercept append, and CSR canonicalization as an Avro part file —
+    so service scores and batch-driver scores agree bit for bit by
+    construction, not by test luck."""
     n = len(records)
     responses = np.full(n, np.nan)
     offsets = np.zeros(n)
@@ -920,6 +894,53 @@ def load_game_dataset_avro(
     if uids is not None:
         ds.uids = np.asarray(uids, dtype=object)
     return ds
+
+
+def load_game_dataset_avro(
+        path: str | Sequence[str],
+        feature_shard_sections: dict[str, Sequence[str]],
+        index_maps: dict[str, IndexMap],
+        id_types: Sequence[str] = (),
+        response_required: bool = True,
+        policy=None) -> GameDataset:
+    """Avro records → columnar :class:`GameDataset`: one CSR per feature
+    shard (union of that shard's sections, intercept appended when the
+    shard's index map has the intercept key), response/offset/weight
+    columns, dictionary-encoded id columns, uids kept when present.
+
+    ``path`` may be a single file/directory or a list of them (the dated
+    daily-partition layout resolves to several directories). Dispatches to
+    the native columnar decoder when available (falls back per schema
+    shape).
+
+    ``policy`` (an :class:`~photon_ml_tpu.data.ingest.IngestPolicy`)
+    engages shard-level quarantine on BOTH decode paths: a corrupt,
+    truncated, or persistently unreadable part file is skipped (with a
+    ``ShardQuarantinedEvent`` and a recorded coverage fraction) instead
+    of killing the load; past the policy's loss budget the load aborts
+    cleanly with ``ShardLossExceededError``."""
+    paths = [path] if isinstance(path, str) else list(path)
+    fast = _columnar_game_dataset(paths, feature_shard_sections,
+                                  index_maps, id_types, response_required,
+                                  policy=policy)
+    if fast is not None:
+        return fast
+    if policy is not None:
+        # shard-granular interpreted fallback: quarantine per part file
+        part_files = [f for p in paths for f in _columnar_part_paths(p)]
+        policy.begin(len(part_files))
+        records = []
+        for pf in part_files:
+            out = _read_shard(pf, policy=policy)
+            if out is not None:
+                records.extend(out[1])
+    elif isinstance(path, str):
+        records = _read_records(path)
+    else:
+        records = [r for p in path for r in _read_records(p)]
+    return game_dataset_from_records(
+        records, feature_shard_sections, index_maps,
+        id_types=id_types, response_required=response_required)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,126 @@
+"""Bounded request queue + adaptive micro-batcher.
+
+The device loop must never block on a slow client and a slow device
+must never build an unbounded backlog: ``submit`` is the only producer
+API and it either enqueues or SHEDS (counted on ``serve_shed{reason}``,
+an error response to the client) — it never waits. The consumer side
+(``next_batch``) drains whatever is queued *right now* up to the batch
+cap, so batch size adapts to load: near-empty queues score singles at
+minimum latency, backlogs amortize fixed per-batch cost over hundreds
+of rows.
+
+Batches are padded to power-of-two row buckets (:func:`bucket_rows` —
+the lane-compaction pad convention from ``game/random_effect.py``) so
+the device loop presents XLA a handful of stable shapes: one compile
+per bucket at warmup, zero retraces after (asserted through the
+``obs/compile`` attribution layer in tests and the bench probe).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+#: Smallest pad bucket: micro-batches of 1..8 rows share one shape.
+MIN_BUCKET = 8
+
+
+def bucket_rows(n: int, min_bucket: int = MIN_BUCKET,
+                max_bucket: Optional[int] = None) -> int:
+    """Power-of-two pad bucket for an ``n``-row batch (≥ ``min_bucket``,
+    clamped to ``max_bucket`` when given — callers chunk above it)."""
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b <<= 1
+    if max_bucket is not None:
+        b = min(b, int(max_bucket))
+    return b
+
+
+@dataclass
+class ScoreWork:
+    """One queued scoring request."""
+
+    rows: list  # decoded records, Avro record shape
+    request_id: object
+    reply: Callable[[object], None]  # called with the response dict
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class MicroBatcher:
+    """Bounded FIFO of :class:`ScoreWork` with non-blocking admission.
+
+    ``max_queue_rows`` bounds total queued ROWS (the unit of device
+    work), not request count — a thousand single-row pings and one
+    thousand-row bulk request cost the queue the same.
+    """
+
+    def __init__(self, max_queue_rows: int, max_batch_rows: int,
+                 registry: MetricsRegistry = REGISTRY):
+        if max_batch_rows <= 0 or max_queue_rows <= 0:
+            raise ValueError("queue and batch caps must be positive")
+        self.max_queue_rows = int(max_queue_rows)
+        self.max_batch_rows = int(max_batch_rows)
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._items: list[ScoreWork] = []
+        self._queued_rows = 0
+        self._closed = False
+
+    # -- producer side (connection reader threads) ---------------------
+
+    def submit(self, work: ScoreWork) -> Optional[str]:
+        """Enqueue, or return a shed reason (``queue_full``/``closed``)
+        without blocking. Sheds are counted on ``serve_shed{reason}``."""
+        with self._lock:
+            if self._closed:
+                reason = "closed"
+            elif self._queued_rows + len(work.rows) > self.max_queue_rows:
+                reason = "queue_full"
+            else:
+                self._items.append(work)
+                self._queued_rows += len(work.rows)
+                self._registry.gauge("serve_queue_depth").set(
+                    self._queued_rows)
+                self._nonempty.notify()
+                return None
+        self._registry.counter("serve_shed").inc(reason=reason)
+        return reason
+
+    # -- consumer side (the device loop) -------------------------------
+
+    def next_batch(self, timeout: float = 0.1) -> list[ScoreWork]:
+        """Up to ``max_batch_rows`` rows of queued work, in arrival
+        order ([] on timeout). Always yields at least one request when
+        any is queued, even one wider than the batch cap — the scorer
+        chunks internally."""
+        with self._lock:
+            if not self._items:
+                self._nonempty.wait(timeout)
+            batch: list[ScoreWork] = []
+            rows = 0
+            while self._items:
+                head = self._items[0]
+                if batch and rows + len(head.rows) > self.max_batch_rows:
+                    break
+                batch.append(self._items.pop(0))
+                rows += len(head.rows)
+            self._queued_rows -= rows
+            self._registry.gauge("serve_queue_depth").set(
+                self._queued_rows)
+            return batch
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queued_rows
+
+    def close(self) -> None:
+        """Stop admitting; queued work stays for the drain loop."""
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()

@@ -1,0 +1,131 @@
+"""Scoring-service wire protocol: versioned NDJSON over TCP/unix.
+
+Same transport family as the PR 8 telemetry plane (``obs/export.py``):
+newline-delimited JSON objects over a stream socket, with an explicit
+protocol version stamped on every server-originated message so
+consumers can reject records they don't speak.
+
+Grammar (one JSON object per line):
+
+- server → client on connect::
+
+    {"kind": "serve_hello", "proto": 1, "model_id": ..., "coordinates": [...]}
+
+- client → server::
+
+    {"kind": "score", "id": <echoed>, "rows": [<record>, ...]}
+    {"kind": "ping"}
+    {"kind": "stats"}
+
+  A ``score`` row is a GAME record in the Avro record shape the batch
+  loader reads: feature sections of ``{"name", "term", "value"}``
+  entries, entity ids top-level or under ``metadataMap``, optional
+  ``uid``/``offset``/``weight``.
+
+- server → client::
+
+    {"kind": "scores", "proto": 1, "id": ..., "scores": [...], "uids": [...]}
+    {"kind": "pong",   "proto": 1}
+    {"kind": "stats",  "proto": 1, ...}
+    {"kind": "error",  "proto": 1, "id": ..., "error": "..."}
+
+Endpoints reuse the telemetry grammar (``host:port`` /
+``unix:/path.sock``); ``file:`` endpoints are rejected — a request
+protocol needs a peer, not a tail file.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Optional, Sequence
+
+from photon_ml_tpu.obs.export import parse_endpoint
+
+#: Protocol version stamped on every server message. Bump on any
+#: incompatible message-shape change (same discipline as
+#: ``obs/export.TELEMETRY_PROTO``).
+SERVE_PROTO = 1
+
+
+def parse_serve_endpoint(endpoint: str) -> tuple[str, object]:
+    """``("tcp", (host, port))`` or ``("unix", path)``."""
+    scheme, addr = parse_endpoint(endpoint)
+    if scheme == "file":
+        raise ValueError(
+            f"serve endpoint {endpoint!r}: a scoring service needs a "
+            f"socket endpoint (host:port or unix:/path.sock), not a file")
+    return scheme, addr
+
+
+def encode(obj: dict) -> bytes:
+    return (json.dumps(obj, separators=(",", ":")) + "\n").encode()
+
+
+def hello(model_id: str, coordinates: Sequence[str]) -> dict:
+    return {"kind": "serve_hello", "proto": SERVE_PROTO,
+            "model_id": model_id, "coordinates": list(coordinates)}
+
+
+def error_response(request_id, message: str) -> dict:
+    return {"kind": "error", "proto": SERVE_PROTO, "id": request_id,
+            "error": message}
+
+
+def scores_response(request_id, scores, uids=None) -> dict:
+    out = {"kind": "scores", "proto": SERVE_PROTO, "id": request_id,
+           "scores": [float(s) for s in scores]}
+    if uids is not None:
+        out["uids"] = [str(u) for u in uids]
+    return out
+
+
+class ServeClient:
+    """Blocking convenience client (tests, bench, chaos drills).
+
+    One request in flight at a time; responses are matched by arrival
+    order, which the single-connection protocol guarantees."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        scheme, addr = parse_serve_endpoint(endpoint)
+        if scheme == "unix":
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(addr)
+        self._file = self._sock.makefile("rb")
+        self.hello = self._read()
+
+    def _read(self) -> dict:
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("scoring service closed the connection")
+        return json.loads(line)
+
+    def request(self, obj: dict) -> dict:
+        self._sock.sendall(encode(obj))
+        return self._read()
+
+    def score(self, rows: Sequence[dict],
+              request_id: Optional[str] = None) -> dict:
+        return self.request({"kind": "score", "id": request_id or "0",
+                             "rows": list(rows)})
+
+    def ping(self) -> dict:
+        return self.request({"kind": "ping"})
+
+    def stats(self) -> dict:
+        return self.request({"kind": "stats"})
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,215 @@
+"""Shared scoring core + the bucketed serving scorer.
+
+The model-load and Σ-coordinate-score pipeline used to live inline in
+``cli/game_scoring_driver.py``; the scoring service needs exactly the
+same steps, so they live here and the batch driver is a thin client:
+
+- :func:`resolve_index_maps` — feature index maps from an off-heap
+  store, name-term set files, or (when neither is given) the model
+  files themselves.
+- :func:`load_scoring_model` — ``load_game_model`` + one-time
+  materialization of projected/factored coordinates into raw space
+  (their ``score()`` converts per call; a resident service converts
+  once).
+- :func:`score_game_dataset` — the Σ-coordinate score, one batch.
+
+:class:`ServingScorer` is the always-on path built on top: protocol
+rows → :func:`~photon_ml_tpu.io.data_format.game_dataset_from_records`
+(the SAME assembly loop the Avro loader runs) → per-coordinate
+contributions with random-effect rows served by the tiered stores → a
+jitted Σ-fold over power-of-two padded buckets. Every device call is
+routed through ``obs/compile`` with a per-bucket site name, so the
+warm loop compiles each bucket once and then never retraces — and the
+result is bit-identical to :func:`score_game_dataset` because every
+row-local operation is shared and the fold performs the same f32
+elementwise adds in the same coordinate order (padding lanes are
+sliced off before they can touch a real row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.models import (
+    FactoredRandomEffectModel,
+    GameModel,
+    RandomEffectModel,
+    RandomEffectModelInProjectedSpace,
+    rowwise_sparse_dot,
+)
+from photon_ml_tpu.io.data_format import (
+    NameAndTermFeatureSets,
+    game_dataset_from_records,
+)
+from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.obs import compile as obs_compile
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.serve.batcher import MIN_BUCKET, bucket_rows
+from photon_ml_tpu.serve.tiers import TieredCoefficientStore
+
+
+def resolve_index_maps(section_keys: dict[str, list[str]],
+                       intercept_map: dict[str, bool],
+                       feature_set_path: Optional[str] = None,
+                       offheap_dir: Optional[str] = None,
+                       offheap_partitions: Optional[int] = None) -> dict:
+    """Feature index maps for scoring, by precedence: pre-built off-heap
+    store → name-term set files → ``{}`` (the model files themselves
+    provide the maps via ``load_game_model``'s no-index path)."""
+    index_maps: dict = {}
+    if offheap_dir:
+        from photon_ml_tpu.io.feature_index_job import load_feature_index
+
+        # offheap=True matches the legacy driver's hard requirement: the
+        # flag asks for the off-heap store, missing meta fails loudly
+        index_maps.update(load_feature_index(
+            offheap_dir, sorted(section_keys), offheap=True,
+            expected_partitions=offheap_partitions))
+    elif feature_set_path:
+        all_sections = sorted({s for secs in section_keys.values()
+                               for s in secs})
+        sets = NameAndTermFeatureSets.load(feature_set_path, all_sections)
+        for shard, sections in section_keys.items():
+            index_maps[shard] = sets.index_map(
+                sections, add_intercept=intercept_map.get(shard, True))
+    return index_maps
+
+
+def load_scoring_model(model_dir: str, index_maps: Optional[dict],
+                       materialize: bool = False):
+    """``(model, index_maps)`` ready to score.
+
+    ``materialize=True`` converts projected/factored random-effect
+    coordinates to raw space ONCE (``to_raw()`` is exactly what their
+    ``score()`` does per call) — the serving path pays the conversion at
+    load instead of per batch; scores are bit-identical either way."""
+    model, index_maps = load_game_model(model_dir, index_maps or None)
+    if materialize:
+        out = {}
+        for cid, m in model.models.items():
+            if isinstance(m, (RandomEffectModelInProjectedSpace,
+                              FactoredRandomEffectModel)):
+                m = m.to_raw()
+            out[cid] = m
+        model = GameModel(out)
+    return model, index_maps
+
+
+def score_game_dataset(model: GameModel, data) -> np.ndarray:
+    """The batch Σ-coordinate score: one fetch of the full vector."""
+    return np.asarray(model.score(data))
+
+
+def _make_fold(num_coordinates: int):
+    """Jitted left-fold ``zeros + c_0 + c_1 + ...`` over a stacked
+    ``[C, P]`` contribution block — the exact add sequence (and
+    therefore the exact f32 bits) of :meth:`GameModel.score`, which
+    starts from ``jnp.zeros`` and adds coordinate scores in model
+    order. Elementwise adds are lane-local, so pad lanes never
+    influence real rows."""
+
+    def fold(stacked):
+        total = jnp.zeros_like(stacked[0])
+        for i in range(num_coordinates):
+            total = total + stacked[i]
+        return total
+
+    return jax.jit(fold)
+
+
+class ServingScorer:
+    """Resident scorer: tiered coefficient stores + bucketed device path.
+
+    One instance per service process; called only from the device loop.
+    """
+
+    def __init__(self, model: GameModel,
+                 section_keys: dict[str, list[str]],
+                 index_maps: dict,
+                 id_types: Sequence[str] = (),
+                 hbm_budget_bytes: int = 64 << 20,
+                 host_tier_entities: int = 65536,
+                 min_bucket: int = MIN_BUCKET,
+                 max_batch_rows: int = 4096,
+                 registry: MetricsRegistry = REGISTRY):
+        self.model = model
+        self.section_keys = section_keys
+        self.index_maps = index_maps
+        self.id_types = sorted(set(id_types) | {
+            m.random_effect_type for m in model.models.values()
+            if isinstance(m, RandomEffectModel)})
+        self.min_bucket = int(min_bucket)
+        self.max_batch_rows = int(max_batch_rows)
+        self._registry = registry
+        # One tiered store per random-effect coordinate that carries raw
+        # entity ids (all disk-loaded models do); the HBM budget is
+        # split evenly across them.
+        tiered = [cid for cid, m in model.models.items()
+                  if isinstance(m, RandomEffectModel)
+                  and m.entity_ids is not None
+                  and m.coefficients.shape[0] > 0]
+        per_store = hbm_budget_bytes // max(len(tiered), 1)
+        self.stores = {
+            cid: TieredCoefficientStore(
+                cid, model.models[cid], per_store,
+                host_capacity=host_tier_entities, registry=registry)
+            for cid in tiered}
+        self._fold_fn = _make_fold(len(model.models))
+
+    # -- per-batch path --------------------------------------------------
+
+    def score_records(self, records: Sequence[dict]
+                      ) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        """Protocol rows → ``(scores, uids)``. Chunks above the batch
+        cap; per-row scores are row-local, so chunk boundaries cannot
+        change any row's bits."""
+        if not records:
+            return np.zeros(0), None
+        if len(records) > self.max_batch_rows:
+            parts = [self.score_records(
+                records[i:i + self.max_batch_rows])
+                for i in range(0, len(records), self.max_batch_rows)]
+            scores = np.concatenate([p[0] for p in parts])
+            uids = (np.concatenate([p[1] for p in parts])
+                    if parts[0][1] is not None else None)
+            return scores, uids
+        data = game_dataset_from_records(
+            records, self.section_keys, self.index_maps,
+            id_types=self.id_types, response_required=False)
+        return self.score_dataset(data), data.uids
+
+    def score_dataset(self, data) -> np.ndarray:
+        """Σ-coordinate score through the tiered stores + bucketed fold.
+        Bit-identical to :func:`score_game_dataset` on the same rows."""
+        n = data.num_samples
+        bucket = bucket_rows(n, min_bucket=self.min_bucket)
+        contributions = []
+        for cid, m in self.model.models.items():
+            store = self.stores.get(cid)
+            if store is None:
+                contributions.append(m.score(data))
+                continue
+            codes = np.asarray(data.id_columns[m.random_effect_type])
+            vocab = data.id_vocabs[m.random_effect_type]
+            raw_ids = np.asarray(
+                [str(x) for x in np.asarray(vocab).ravel()],
+                dtype=object)[codes]
+            w_rows = store.lookup(raw_ids)
+            contributions.append(rowwise_sparse_dot(
+                data.feature_shards[m.feature_shard_id], w_rows))
+        stacked = np.zeros((len(contributions), bucket), np.float32)
+        for i, c in enumerate(contributions):
+            stacked[i, :n] = np.asarray(c, np.float32)
+        total = obs_compile.call(
+            f"serve.combine[b{bucket}]", self._fold_fn,
+            (jnp.asarray(stacked),), arg_names=("contributions",))
+        self._registry.counter("serve_rows_scored").inc(n)
+        return np.asarray(total)[:n].astype(np.float64)
+
+    def stats(self) -> dict:
+        return {"tiers": [s.stats() for s in self.stores.values()]}

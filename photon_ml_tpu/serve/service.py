@@ -1,0 +1,467 @@
+"""The always-on scoring service process.
+
+Thread layout (one process, one device context):
+
+- an **accept thread** takes connections on the listen socket;
+- one **reader thread per connection** decodes NDJSON requests and
+  either answers directly (``ping``/``stats``) or submits
+  :class:`~photon_ml_tpu.serve.batcher.ScoreWork` to the micro-batcher
+  — admission never blocks: overload sheds with an error response;
+- the **device loop** (the main thread) drains micro-batches,
+  scores each one through the shared
+  :class:`~photon_ml_tpu.serve.scoring.ServingScorer`, and replies per
+  request. It is the ONLY thread that touches the device, so the tier
+  stores and compile-site caches need no locking.
+
+Responses are written by the scoring loop into the request's
+connection under a per-connection lock; a write to a dead client is
+counted (``serve_shed{reason=dead_client}``) and the connection
+closed — a client death never disturbs the loop.
+
+Exit discipline matches the training driver (``cli/__init__.py``):
+SIGTERM/SIGINT latch a :class:`~photon_ml_tpu.utils.preempt
+.StopController` flag, the loop stops admitting, drains the queue, and
+the process exits ``75`` (requeue me — ``photon_supervise`` relaunches
+it); ``--max-serve-seconds``/``--stop-file`` drain the same way but
+exit ``0`` (a scheduled stop is a finished run); recognized terminal
+faults exit ``3`` with a ``PHOTON_ABORT`` line.
+
+Run as ``python -m photon_ml_tpu.serve.service`` (the module form
+``photon_supervise --module`` relaunches) or via
+``tools/photon_serve.py``. On readiness the process prints one
+``PHOTON_SERVE ready endpoint=<endpoint>`` line on stdout — with
+``--listen 127.0.0.1:0`` the endpoint carries the kernel-assigned
+port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.serve.batcher import MicroBatcher, ScoreWork
+from photon_ml_tpu.serve.protocol import (
+    SERVE_PROTO,
+    encode,
+    error_response,
+    hello,
+    parse_serve_endpoint,
+    scores_response,
+)
+from photon_ml_tpu.serve.scoring import ServingScorer
+from photon_ml_tpu.utils.faults import InjectedFault, fault_point
+
+#: Completed-request horizon for the p50/p99/qps gauges.
+_LATENCY_WINDOW = 1024
+_QPS_HORIZON_SECS = 30.0
+
+
+class ServeService:
+    """Socket front + device loop around one :class:`ServingScorer`."""
+
+    def __init__(self, scorer: ServingScorer, batcher: MicroBatcher,
+                 listen: str, model_id: str = "game-model",
+                 registry: MetricsRegistry = REGISTRY, warn=None):
+        self.scorer = scorer
+        self.batcher = batcher
+        self.model_id = model_id
+        self._registry = registry
+        self._warn = warn or (lambda msg: None)
+        self._lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
+        self._threads: list[threading.Thread] = []
+        self._closed = False
+        self._started_at = time.monotonic()
+        self._latencies_ms: list[float] = []
+        self._done_times: list[float] = []
+        scheme, addr = parse_serve_endpoint(listen)
+        if scheme == "unix":
+            try:
+                os.unlink(addr)
+            except FileNotFoundError:
+                pass
+            self._listener = socket.socket(socket.AF_UNIX,
+                                           socket.SOCK_STREAM)
+            self._listener.bind(addr)
+            self.endpoint = f"unix:{addr}"
+        else:
+            self._listener = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind(addr)
+            host, port = self._listener.getsockname()
+            self.endpoint = f"{host}:{port}"  # real port under :0
+        self._listener.listen(128)
+        self._listener.settimeout(0.2)
+
+    # -- socket front (accept + reader threads) -------------------------
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop,
+                             name="serve-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed during shutdown
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._conns.add(conn)
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 name="serve-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        alive = [True]
+
+        def send(obj: dict) -> bool:
+            with wlock:
+                if not alive[0]:
+                    return False
+                try:
+                    conn.sendall(encode(obj))
+                    return True
+                except OSError:
+                    # the client died with replies owed — account for it
+                    # and stop writing; the reader loop ends on its own
+                    alive[0] = False
+                    self._registry.counter("serve_shed").inc(
+                        reason="dead_client")
+                    return False
+
+        send(hello(self.model_id, list(self.scorer.model.models)))
+        try:
+            reader = conn.makefile("rb")
+            for line in reader:
+                if not line.strip():
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError as e:
+                    send(error_response(None, f"bad json: {e}"))
+                    continue
+                rid = msg.get("id")
+                kind = msg.get("kind")
+                try:
+                    # request-plane faults are CONNECTION-scoped: the
+                    # request fails, the service keeps serving
+                    fault_point("serve.request", tag=kind)
+                except (InjectedFault, OSError) as e:
+                    self._registry.counter("serve_errors").inc(
+                        kind=type(e).__name__)
+                    send(error_response(rid, f"{type(e).__name__}: {e}"))
+                    break
+                if kind == "ping":
+                    send({"kind": "pong", "proto": SERVE_PROTO})
+                elif kind == "stats":
+                    send({"kind": "stats", "proto": SERVE_PROTO,
+                          **self.stats()})
+                elif kind == "score":
+                    work = ScoreWork(rows=list(msg.get("rows") or []),
+                                     request_id=rid, reply=send)
+                    shed = self.batcher.submit(work)
+                    if shed is not None:
+                        send(error_response(rid, f"shed:{shed}"))
+                else:
+                    send(error_response(rid, f"unknown kind {kind!r}"))
+        except OSError:
+            pass  # connection reset mid-read
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- the device loop ------------------------------------------------
+
+    def serve_loop(self, stop) -> Optional[str]:
+        """Score until ``stop`` fires, then drain the queue and return
+        the stop reason. The caller owns the exit code."""
+        reason: Optional[str] = None
+        draining = False
+        while True:
+            if not draining:
+                reason = stop.should_stop()
+                if reason is not None:
+                    draining = True
+                    self.batcher.close()  # shed new work, keep the queue
+            batch = self.batcher.next_batch(
+                timeout=0.02 if draining else 0.2)
+            if not batch:
+                if draining:
+                    return reason
+                continue
+            self._score_batch(batch)
+
+    def _score_batch(self, batch: list[ScoreWork]) -> None:
+        from photon_ml_tpu.cli import clean_abort_types
+
+        try:
+            fault_point("serve.batch", tag=str(len(batch)))
+            all_rows = [r for w in batch for r in w.rows]
+            scores, uids = self.scorer.score_records(all_rows)
+        except InjectedFault:
+            raise  # process-scoped: the clean-abort contract applies
+        except clean_abort_types():
+            raise
+        except Exception as e:  # bad rows must not kill the loop
+            self._registry.counter("serve_errors").inc(
+                kind=type(e).__name__)
+            for w in batch:
+                w.reply(error_response(w.request_id,
+                                       f"{type(e).__name__}: {e}"))
+            return
+        # gauges BEFORE replies: a client that reads stats right after
+        # its scores must see its own request reflected in the SLOs
+        now = time.monotonic()
+        for w in batch:
+            self._latencies_ms.append((now - w.enqueued_at) * 1000.0)
+            self._done_times.append(now)
+        del self._latencies_ms[:-_LATENCY_WINDOW]
+        self._update_slo_gauges(now)
+        off = 0
+        for w in batch:
+            k = len(w.rows)
+            w.reply(scores_response(
+                w.request_id, scores[off:off + k],
+                uids[off:off + k] if uids is not None else None))
+            off += k
+
+    def _update_slo_gauges(self, now: float) -> None:
+        """p50/p99/qps as process gauges: they ride every heartbeat's
+        ``metric_totals`` into the telemetry stream, so ``photon_status``
+        monitors serving SLOs with no new plumbing."""
+        horizon = now - _QPS_HORIZON_SECS
+        self._done_times = [t for t in self._done_times if t >= horizon]
+        window = min(_QPS_HORIZON_SECS,
+                     max(now - self._started_at, 1e-3))
+        self._registry.gauge("serve_qps").set(
+            len(self._done_times) / window)
+        lat = np.asarray(self._latencies_ms)
+        self._registry.gauge("serve_p50_ms").set(
+            float(np.percentile(lat, 50)))
+        self._registry.gauge("serve_p99_ms").set(
+            float(np.percentile(lat, 99)))
+
+    # -- introspection / shutdown ---------------------------------------
+
+    def stats(self) -> dict:
+        g = self._registry.gauge
+        return {
+            "model_id": self.model_id,
+            "endpoint": self.endpoint,
+            "queue_depth": self.batcher.queue_depth(),
+            "qps": g("serve_qps").value(),
+            "p50_ms": g("serve_p50_ms").value(),
+            "p99_ms": g("serve_p99_ms").value(),
+            "uptime_secs": time.monotonic() - self._started_at,
+            **self.scorer.stats(),
+        }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+        self.batcher.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# entrypoint
+# ---------------------------------------------------------------------------
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    from photon_ml_tpu.cli.args import (
+        add_observability_flags,
+        check_telemetry_flags,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="photon-serve",
+        description="always-on GAME scoring service")
+    p.add_argument("--game-model-input-dir", required=True)
+    p.add_argument("--listen", default="127.0.0.1:0",
+                   help="host:port (port 0 = kernel-assigned, printed "
+                        "on the PHOTON_SERVE ready line) or "
+                        "unix:/path.sock")
+    p.add_argument("--feature-shard-id-to-feature-section-keys-map",
+                   required=True)
+    p.add_argument("--feature-shard-id-to-intercept-map", default="")
+    p.add_argument("--feature-name-and-term-set-path")
+    p.add_argument("--offheap-indexmap-dir")
+    p.add_argument("--offheap-indexmap-num-partitions", type=int,
+                   default=None)
+    p.add_argument("--random-effect-id-set", default="",
+                   help="comma-separated id types request rows carry")
+    p.add_argument("--model-id", default="game-model")
+    p.add_argument("--max-batch-rows", type=int, default=1024)
+    p.add_argument("--max-queue-rows", type=int, default=8192,
+                   help="admission bound; requests over it shed with "
+                        "an error response, never queue-block")
+    p.add_argument("--serve-hbm-budget-mb", type=float, default=64.0,
+                   help="device-tier coefficient budget, split across "
+                        "the random-effect coordinates")
+    p.add_argument("--host-tier-entities", type=int, default=65536)
+    p.add_argument("--min-bucket", type=int, default=8,
+                   help="smallest power-of-two pad bucket (batches of "
+                        "1..min-bucket rows share one compiled shape)")
+    p.add_argument("--max-serve-seconds", type=float, default=None,
+                   help="scheduled stop: drain and exit 0 (SIGTERM "
+                        "drains and exits 75 instead — requeue me)")
+    p.add_argument("--stop-file")
+    p.add_argument("--log-file",
+                   help="service log path (default: photon-serve.log "
+                        "under --trace-dir, else stderr only)")
+    add_observability_flags(p)
+    ns = p.parse_args(argv)
+    check_telemetry_flags(p, ns)
+    return ns
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    from photon_ml_tpu.cli import (
+        clean_abort,
+        clean_abort_types,
+        preempted_exit,
+    )
+    from photon_ml_tpu.cli.args import (
+        parse_key_value_map,
+        parse_section_keys_map,
+    )
+    from photon_ml_tpu.obs.run import start_observed_run_from_flags
+    from photon_ml_tpu.serve.scoring import (
+        load_scoring_model,
+        resolve_index_maps,
+    )
+    from photon_ml_tpu.utils import parse_flag
+    from photon_ml_tpu.utils.compile_cache import (
+        enable_persistent_compile_cache,
+    )
+    from photon_ml_tpu.utils.logging import PhotonLogger
+    from photon_ml_tpu.utils.preempt import (
+        PreemptionRequested,
+        StopController,
+    )
+
+    enable_persistent_compile_cache()
+    ns = parse_args(argv if argv is not None else sys.argv[1:])
+    log_path = ns.log_file or (
+        os.path.join(ns.trace_dir, "photon-serve.log")
+        if ns.trace_dir else os.devnull)
+    logger = PhotonLogger(log_path, echo=False)
+
+    section_keys = parse_section_keys_map(
+        ns.feature_shard_id_to_feature_section_keys_map)
+    intercept_map = {k: parse_flag(v)
+                     for k, v in parse_key_value_map(
+                         ns.feature_shard_id_to_intercept_map).items()}
+    id_types = sorted({x.strip()
+                       for x in ns.random_effect_id_set.split(",")
+                       if x.strip()})
+
+    # graceful stop BEFORE model load: a SIGTERM during a slow load
+    # still drains (an empty queue) and exits with the documented code
+    stop = StopController(max_train_seconds=ns.max_serve_seconds,
+                          stop_file=ns.stop_file)
+    stop.install_signal_handlers()
+    obs_run = start_observed_run_from_flags(
+        ns, warn=logger.warn,
+        preserve_existing=bool(os.environ.get("PHOTON_GAME_SUPERVISED")))
+    service = None
+    try:
+        index_maps = resolve_index_maps(
+            section_keys, intercept_map,
+            feature_set_path=ns.feature_name_and_term_set_path,
+            offheap_dir=ns.offheap_indexmap_dir,
+            offheap_partitions=ns.offheap_indexmap_num_partitions)
+        model, index_maps = load_scoring_model(
+            ns.game_model_input_dir, index_maps, materialize=True)
+        scorer = ServingScorer(
+            model, section_keys, index_maps, id_types=id_types,
+            hbm_budget_bytes=int(ns.serve_hbm_budget_mb * (1 << 20)),
+            host_tier_entities=ns.host_tier_entities,
+            min_bucket=ns.min_bucket,
+            max_batch_rows=ns.max_batch_rows)
+        batcher = MicroBatcher(max_queue_rows=ns.max_queue_rows,
+                               max_batch_rows=ns.max_batch_rows)
+        service = ServeService(scorer, batcher, ns.listen,
+                               model_id=ns.model_id, warn=logger.warn)
+        service.start()
+        logger.info(f"serving {ns.model_id} on {service.endpoint} "
+                    f"({len(scorer.stores)} tiered coordinate(s))")
+        print(f"PHOTON_SERVE ready endpoint={service.endpoint}",
+              flush=True)
+        reason = service.serve_loop(stop)
+        if reason and reason.startswith("signal:"):
+            # external preemption: requeue-me semantics, like training
+            raise PreemptionRequested(reason, 0, 0)
+        logger.info(f"scheduled stop ({reason}): drained and done")
+        if obs_run is not None:
+            obs_run.set_exit_status("ok", reason=reason or "")
+    except clean_abort_types() as e:
+        if obs_run is not None:
+            obs_run.set_exit_status("abort",
+                                    reason=f"{type(e).__name__}: {e}")
+        raise clean_abort(e, log=logger.error) from None
+    except PreemptionRequested as e:
+        if obs_run is not None:
+            obs_run.set_exit_status("preempted", reason=e.reason)
+        raise preempted_exit(e, log=logger.warn) from None
+    except KeyboardInterrupt:
+        if obs_run is not None:
+            obs_run.set_exit_status("abort", reason="KeyboardInterrupt")
+        raise clean_abort(KeyboardInterrupt("interrupted by operator"),
+                          log=logger.error) from None
+    except Exception as e:
+        logger.error(f"scoring service failed: {e}")
+        if obs_run is not None:
+            obs_run.set_exit_status("error",
+                                    reason=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        if service is not None:
+            service.shutdown()
+        stop.uninstall_signal_handlers()
+        if obs_run is not None:
+            obs_run.finish()
+        logger.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,219 @@
+"""Tiered per-entity coefficient store for the scoring service.
+
+Snap ML's hierarchy argument (PAPERS.md, arXiv 1803.06333) applied to
+GAME random effects: the entity coefficient blocks are by far the
+largest serving state, and request traffic over entities is heavily
+skewed — so only the hot head earns device residency.
+
+Three tiers, checked in order per request row:
+
+- **device** — a fixed ``[H, D]`` f32 block in device memory. ``H``
+  comes from the HBM budget (``budget // row_bytes``, the same
+  accounting the PR 11 ``hbm_bytes`` gauges report), so eviction
+  pressure IS the budget. Hits are gathered with a jitted bucketed
+  gather routed through ``obs/compile`` — one compile per pad bucket,
+  zero retraces warm.
+- **host** — an LRU of entities recently evicted from the device block
+  (indices into the model block, so the tier costs O(1) per entry).
+- **model** — the full coefficient block loaded from the on-disk model;
+  always correct, never evicted. Unknown entities miss every tier and
+  score zero from this coordinate (the reference's cogroup semantics).
+
+Promotion and eviction are counted per tier
+(``serve_tier_hits{coordinate,tier}``, ``serve_tier_promote``,
+``serve_tier_evict``) so the hit rate is a first-class serving metric.
+
+Bit-parity invariant: every tier stores the SAME f32 rows the model
+block holds (device transfer of f32 is bit-exact both ways), so the
+host-side rowwise dot downstream sees identical inputs no matter which
+tier served a row.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.models import RandomEffectModel, _match
+from photon_ml_tpu.obs import compile as obs_compile
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.serve.batcher import bucket_rows
+
+
+class TieredCoefficientStore:
+    """Per-coordinate tiered store over one :class:`RandomEffectModel`.
+
+    Requires a model with raw ``entity_ids`` (every model loaded from
+    disk has them); in-process models without raw ids score through the
+    untiered path instead. Single-consumer: only the device loop calls
+    :meth:`lookup`.
+    """
+
+    def __init__(self, coordinate_id: str, model: RandomEffectModel,
+                 hbm_budget_bytes: int, host_capacity: int = 65536,
+                 registry: MetricsRegistry = REGISTRY):
+        if model.entity_ids is None:
+            raise ValueError(
+                f"coordinate {coordinate_id!r}: tiered store needs raw "
+                f"entity_ids (models loaded from disk carry them)")
+        self.coordinate_id = coordinate_id
+        self._registry = registry
+        self._block_np = np.asarray(model.coefficients, np.float32)
+        e, d = self._block_np.shape
+        self.dim = d
+        self.row_bytes = d * 4
+        # sorted-comparable raw ids (python-string compare — the same
+        # convention as models._codes_via_ids, so tier lookups and
+        # untiered scoring resolve entities identically)
+        self._ids = np.asarray(
+            [str(x) for x in np.asarray(model.entity_ids).ravel()],
+            dtype=object)
+        self.capacity = int(max(1, min(
+            max(e, 1), hbm_budget_bytes // max(self.row_bytes, 1))))
+        self.host_capacity = int(max(0, host_capacity))
+        self._device_block = jnp.zeros((self.capacity, d), jnp.float32)
+        self._slot_of: "OrderedDict[str, int]" = OrderedDict()  # LRU
+        self._free = list(range(self.capacity))
+        self._host: "OrderedDict[str, int]" = OrderedDict()  # id → row
+        self._gather_fn = jax.jit(lambda block, slots: block[slots])
+        self._promote_fn = jax.jit(
+            lambda block, rows, slots: block.at[slots].set(rows))
+        registry.gauge("serve_tier_device_bytes").set(
+            self.capacity * self.row_bytes, coordinate=coordinate_id)
+
+    # -- internals ------------------------------------------------------
+
+    def _demote_to_host(self, ent: str, model_row: int) -> None:
+        self._registry.counter("serve_tier_evict").inc(
+            coordinate=self.coordinate_id, tier="device")
+        if not self.host_capacity:
+            return
+        self._host[ent] = model_row
+        self._host.move_to_end(ent)
+        while len(self._host) > self.host_capacity:
+            self._host.popitem(last=False)
+            self._registry.counter("serve_tier_evict").inc(
+                coordinate=self.coordinate_id, tier="host")
+
+    def _take_slot(self, pinned: set) -> int:
+        """A free device slot, evicting the LRU non-pinned resident if
+        the block is full; -1 when every resident is pinned."""
+        if self._free:
+            return self._free.pop()
+        for ent in self._slot_of:  # OrderedDict iterates LRU-first
+            if ent not in pinned:
+                slot = self._slot_of.pop(ent)
+                row = _match(self._ids, np.asarray([ent], dtype=object))
+                self._demote_to_host(ent, int(row[0]))
+                return slot
+        return -1
+
+    def _write_device(self, slots: list, rows: list) -> None:
+        """Bucketed jitted scatter of promoted rows into the block."""
+        k = len(slots)
+        bucket = bucket_rows(k, min_bucket=1)
+        rows_np = np.asarray(rows, np.float32)
+        slots_np = np.asarray(slots, np.int32)
+        if bucket > k:
+            # idempotent pad: repeat the first (slot, row) pair — a
+            # duplicate scatter of an identical value is deterministic
+            rows_np = np.concatenate(
+                [rows_np, np.repeat(rows_np[:1], bucket - k, axis=0)])
+            slots_np = np.concatenate(
+                [slots_np, np.repeat(slots_np[:1], bucket - k)])
+        self._device_block = obs_compile.call(
+            f"serve.tier_promote[{self.coordinate_id}.b{bucket}]",
+            self._promote_fn,
+            (self._device_block, jnp.asarray(rows_np),
+             jnp.asarray(slots_np)),
+            arg_names=("block", "rows", "slots"))
+
+    # -- the lookup -----------------------------------------------------
+
+    def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
+        """f32 coefficient row per request row (zeros for unknown
+        entities), served device-first with promotion on host/model
+        hits. ``raw_ids`` is an object array of python strings."""
+        b = len(raw_ids)
+        out = np.zeros((b, self.dim), np.float32)
+        if b == 0 or len(self._ids) == 0:
+            return out
+        unique_ids, inverse = np.unique(
+            np.asarray([str(x) for x in raw_ids], dtype=object),
+            return_inverse=True)
+        model_rows = _match(self._ids, unique_ids)
+        pinned = {str(ent) for ent in unique_ids}
+        tier_of: dict[str, str] = {}
+        from_model: dict[str, int] = {}
+        promote_slots: list = []
+        promote_rows: list = []
+        for ent, mrow in zip(unique_ids, model_rows):
+            ent, mrow = str(ent), int(mrow)
+            if ent in self._slot_of:
+                tier_of[ent] = "device"
+                self._slot_of.move_to_end(ent)
+                continue
+            if mrow >= len(self._ids):
+                tier_of[ent] = "miss"
+                continue
+            tier_of[ent] = "host" if ent in self._host else "model"
+            slot = self._take_slot(pinned)
+            if slot < 0:
+                # device tier saturated by this batch's own entities:
+                # serve the overflow straight from the model block
+                from_model[ent] = mrow
+                continue
+            self._host.pop(ent, None)
+            self._slot_of[ent] = slot
+            self._slot_of.move_to_end(ent)
+            promote_slots.append(slot)
+            promote_rows.append(self._block_np[mrow])
+            self._registry.counter("serve_tier_promote").inc(
+                coordinate=self.coordinate_id, tier=tier_of[ent])
+        if promote_slots:
+            self._write_device(promote_slots, promote_rows)
+
+        # one bucketed device gather for every resident unique id
+        resident = [str(e) for e in unique_ids if str(e) in self._slot_of]
+        gathered: dict[str, np.ndarray] = {}
+        if resident:
+            u = len(resident)
+            bucket = bucket_rows(u, min_bucket=1)
+            slots = np.asarray(
+                [self._slot_of[e] for e in resident], np.int32)
+            if bucket > u:
+                slots = np.concatenate(
+                    [slots, np.repeat(slots[:1], bucket - u)])
+            rows_dev = obs_compile.call(
+                f"serve.tier_gather[{self.coordinate_id}.b{bucket}]",
+                self._gather_fn,
+                (self._device_block, jnp.asarray(slots)),
+                arg_names=("block", "slots"))
+            gathered = dict(zip(resident, np.asarray(rows_dev)[:u]))
+
+        hits = self._registry.counter("serve_tier_hits")
+        for row_idx in range(b):
+            ent = str(unique_ids[inverse[row_idx]])
+            hits.inc(coordinate=self.coordinate_id, tier=tier_of[ent])
+            if ent in gathered:
+                out[row_idx] = gathered[ent]
+            elif ent in from_model:
+                out[row_idx] = self._block_np[from_model[ent]]
+            # miss → stays zero (cold entity scores 0)
+        return out
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "coordinate": self.coordinate_id,
+            "device_entities": len(self._slot_of),
+            "device_capacity": self.capacity,
+            "host_entities": len(self._host),
+            "host_capacity": self.host_capacity,
+            "device_bytes": self.capacity * self.row_bytes,
+        }

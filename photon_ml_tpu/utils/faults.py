@@ -160,6 +160,20 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
         "in a multi-host worker right after jax.distributed.initialize "
         "(parallel/multihost.py); tag = process id",
         modes=("raise", "kill", "delay"), multihost_only=True),
+    "serve.request": FaultPointInfo(
+        "in a scoring-service connection thread, per decoded request "
+        "before dispatch (serve/service.py); tag = request kind. "
+        "Connection-scoped: a firing fails THAT request/connection with "
+        "an error response — the service keeps serving",
+        modes=("raise", "io_error", "delay", "flaky")),
+    "serve.batch": FaultPointInfo(
+        "in the scoring-service device loop, per micro-batch before "
+        "scoring (serve/service.py); tag = batch request count. "
+        "raise aborts the service cleanly; io_error fails that batch's "
+        "requests with error responses (the service keeps serving); "
+        "signal drains and exits preempted; kill scripts it dead for "
+        "the supervisor-relaunch drill",
+        modes=("raise", "io_error", "delay", "kill", "signal")),
 }
 
 

@@ -1,0 +1,628 @@
+"""Serving stack tests: queue + micro-batcher, tiered coefficient
+store, the bucketed zero-retrace scorer, and the photon_serve e2e
+acceptance.
+
+Layers:
+- unit: ``bucket_rows`` / ``MicroBatcher`` admission, shedding, drain
+- unit: ``TieredCoefficientStore`` LRU under a tight HBM budget
+  (device → host demotion, promotion counters, exact f32 rows from
+  every tier)
+- in-process: ``ServingScorer`` determinism, chunk independence, and
+  the warm loop compiling each pad bucket once (zero retraces,
+  asserted through the armed ``obs/compile`` layer)
+- e2e: a real serve subprocess answering concurrent clients
+  bit-identically to a real batch-driver subprocess, surviving a dead
+  client, reporting SLOs through ``photon_status --json``, draining on
+  SIGTERM (rc 75), and riding an injected SIGKILL through
+  ``photon_supervise --module`` relaunch
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import write_container
+from photon_ml_tpu.io.data_format import game_dataset_from_records
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.model_io import load_scored_items, save_game_model
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.obs import compile as obs_compile
+from photon_ml_tpu.obs.metrics import MetricsRegistry
+from photon_ml_tpu.optimize.config import TaskType
+from photon_ml_tpu.serve.batcher import MicroBatcher, ScoreWork, bucket_rows
+from photon_ml_tpu.serve.protocol import ServeClient
+from photon_ml_tpu.serve.scoring import (
+    ServingScorer,
+    load_scoring_model,
+    score_game_dataset,
+)
+from photon_ml_tpu.serve.tiers import TieredCoefficientStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+KILL_EXIT = 19
+PREEMPTED_EXIT = 75
+
+SECTIONS = {"global": ["globalFeatures"], "user": ["userFeatures"]}
+SECTIONS_FLAG = "global:globalFeatures|user:userFeatures"
+
+GAME_SCHEMA = {
+    "name": "GameRecord", "type": "record", "namespace": "t",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+        {"name": "globalFeatures",
+         "type": {"type": "array", "items": schemas.FEATURE}},
+        {"name": "userFeatures",
+         "type": {"type": "array", "items": "FeatureAvro"}},
+    ],
+}
+
+
+def _build_model_dir(root: str, n_users=8, d_g=4, d_u=3, seed=7) -> str:
+    rng = np.random.default_rng(seed)
+    imaps = {
+        "global": IndexMap.from_keys([f"g{j}" for j in range(d_g)],
+                                     add_intercept=True),
+        "user": IndexMap.from_keys([f"u{j}" for j in range(d_u)],
+                                   add_intercept=True),
+    }
+    fixed = FixedEffectModel(GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=len(imaps["global"])),
+                                 jnp.float32)),
+        TaskType.LINEAR_REGRESSION), "global")
+    vocab = np.asarray([f"user{u}" for u in range(n_users)])
+    re_model = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n_users),
+        coefficients=jnp.asarray(
+            rng.normal(size=(n_users, len(imaps["user"]))), jnp.float32))
+    model_dir = os.path.join(root, "model")
+    save_game_model(GameModel({"fixed": fixed, "per-user": re_model}),
+                    model_dir, imaps, entity_vocabs={"userId": vocab})
+    return model_dir
+
+
+def _make_records(n=24, n_users=8, d_g=4, d_u=3, seed=3) -> list[dict]:
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        records.append({
+            "uid": f"req_{i}", "response": 0.0, "offset": None,
+            "weight": None, "metadataMap": {"userId": f"user{u}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(rng.normal())}
+                               for j in range(d_g)],
+            "userFeatures": [{"name": f"u{j}", "term": "",
+                              "value": float(rng.normal())}
+                             for j in range(d_u)],
+        })
+    return records
+
+
+def _subprocess_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# bucket_rows + MicroBatcher
+# ---------------------------------------------------------------------------
+
+
+class TestBucketRows:
+    def test_power_of_two_with_floor(self):
+        assert bucket_rows(1) == 8 and bucket_rows(8) == 8
+        assert bucket_rows(9) == 16
+        assert bucket_rows(100) == 128
+
+    def test_min_and_max_bucket(self):
+        assert bucket_rows(1, min_bucket=1) == 1
+        assert bucket_rows(3, min_bucket=1) == 4
+        assert bucket_rows(500, max_bucket=64) == 64
+
+
+def _work(n_rows, rid="r"):
+    return ScoreWork(rows=[{} for _ in range(n_rows)], request_id=rid,
+                     reply=lambda _obj: None)
+
+
+class TestMicroBatcher:
+    def test_arrival_order_batch_respects_row_cap(self):
+        b = MicroBatcher(1000, 10, registry=MetricsRegistry())
+        for i in range(4):
+            assert b.submit(_work(4, rid=i)) is None
+        batch = b.next_batch(timeout=0.01)
+        # 4+4 fits the 10-row cap, a third request would overflow it
+        assert [w.request_id for w in batch] == [0, 1]
+        assert b.queue_depth() == 8
+
+    def test_oversize_request_yields_alone(self):
+        b = MicroBatcher(1000, 10, registry=MetricsRegistry())
+        b.submit(_work(25, rid="wide"))
+        b.submit(_work(1, rid="next"))
+        batch = b.next_batch(timeout=0.01)
+        assert [w.request_id for w in batch] == ["wide"]
+
+    def test_queue_full_sheds_without_blocking(self):
+        reg = MetricsRegistry()
+        b = MicroBatcher(10, 10, registry=reg)
+        assert b.submit(_work(8)) is None
+        t0 = time.monotonic()
+        assert b.submit(_work(8)) == "queue_full"
+        assert time.monotonic() - t0 < 0.5  # shed, not blocked
+        assert reg.counter("serve_shed").value(reason="queue_full") == 1
+        assert b.queue_depth() == 8  # the shed request left no residue
+
+    def test_close_sheds_new_work_but_drains_queued(self):
+        reg = MetricsRegistry()
+        b = MicroBatcher(100, 100, registry=reg)
+        b.submit(_work(2, rid="queued"))
+        b.close()
+        assert b.submit(_work(1)) == "closed"
+        assert reg.counter("serve_shed").value(reason="closed") == 1
+        assert [w.request_id for w in b.next_batch(0.01)] == ["queued"]
+        assert b.next_batch(0.01) == []
+
+
+# ---------------------------------------------------------------------------
+# Tiered coefficient store
+# ---------------------------------------------------------------------------
+
+
+def _tier_model(n=12, d=3, seed=2):
+    rng = np.random.default_rng(seed)
+    return RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n),
+        coefficients=jnp.asarray(rng.normal(size=(n, d)), jnp.float32),
+        entity_ids=np.asarray([f"user{u}" for u in range(n)]))
+
+
+def _ids(*users):
+    return np.asarray([f"user{u}" for u in users], dtype=object)
+
+
+class TestTieredCoefficientStore:
+    def test_requires_raw_entity_ids(self):
+        m = _tier_model()
+        m = RandomEffectModel(
+            random_effect_type=m.random_effect_type,
+            feature_shard_id=m.feature_shard_id,
+            entity_codes=m.entity_codes, coefficients=m.coefficients)
+        with pytest.raises(ValueError, match="entity_ids"):
+            TieredCoefficientStore("c", m, 1 << 20,
+                                   registry=MetricsRegistry())
+
+    def test_capacity_follows_the_hbm_budget(self):
+        m = _tier_model(n=12, d=3)  # row_bytes = 12
+        reg = MetricsRegistry()
+        store = TieredCoefficientStore("c", m, hbm_budget_bytes=4 * 12,
+                                       registry=reg)
+        assert store.capacity == 4
+        assert reg.gauge("serve_tier_device_bytes").value(
+            coordinate="c") == 4 * 12
+
+    def test_every_tier_serves_the_exact_model_rows(self):
+        m = _tier_model(n=12, d=3)
+        block = np.asarray(m.coefficients, np.float32)
+        store = TieredCoefficientStore("c", m, hbm_budget_bytes=4 * 12,
+                                       registry=MetricsRegistry())
+        # cold (model tier), warm (device tier), and churned (host tier)
+        for users in ((0, 1, 2, 3), (0, 1, 2, 3), (4, 5, 6, 7),
+                      (0, 1, 2, 3), (0, 11, 11, 2)):
+            got = store.lookup(_ids(*users))
+            np.testing.assert_array_equal(
+                got, block[list(users)],
+                err_msg=f"tier rows diverge for {users}")
+
+    def test_unknown_entity_scores_zero(self):
+        store = TieredCoefficientStore("c", _tier_model(), 1 << 20,
+                                       registry=MetricsRegistry())
+        got = store.lookup(np.asarray(["user0", "ghost"], dtype=object))
+        np.testing.assert_array_equal(got[1], np.zeros(3, np.float32))
+        assert np.any(got[0] != 0)
+
+    def test_lru_eviction_and_promotion_counters(self):
+        m = _tier_model(n=12, d=3)
+        reg = MetricsRegistry()
+        store = TieredCoefficientStore("c", m, hbm_budget_bytes=4 * 12,
+                                       registry=reg)
+        hits = reg.counter("serve_tier_hits")
+        store.lookup(_ids(0, 1, 2, 3))  # fill: 4 model-tier promotions
+        assert hits.value(coordinate="c", tier="model") == 4
+        store.lookup(_ids(0, 1, 2, 3))  # warm: all device
+        assert hits.value(coordinate="c", tier="device") == 4
+        store.lookup(_ids(4, 5, 6, 7))  # churn: 4 LRU demotions
+        assert reg.counter("serve_tier_evict").value(
+            coordinate="c", tier="device") == 4
+        assert store.stats()["host_entities"] == 4
+        store.lookup(_ids(0, 1))  # demoted entities come back via host
+        assert hits.value(coordinate="c", tier="host") == 2
+        assert reg.counter("serve_tier_promote").value(
+            coordinate="c", tier="host") == 2
+
+    def test_batch_wider_than_device_capacity_overflows_to_model(self):
+        m = _tier_model(n=12, d=3)
+        block = np.asarray(m.coefficients, np.float32)
+        store = TieredCoefficientStore("c", m, hbm_budget_bytes=4 * 12,
+                                       registry=MetricsRegistry())
+        users = tuple(range(12))  # 12 unique entities, 4 device slots
+        got = store.lookup(_ids(*users))
+        np.testing.assert_array_equal(got, block[list(users)])
+        assert store.stats()["device_entities"] <= store.capacity
+
+    def test_host_tier_capacity_bounds_demotions(self):
+        m = _tier_model(n=12, d=3)
+        reg = MetricsRegistry()
+        store = TieredCoefficientStore("c", m, hbm_budget_bytes=4 * 12,
+                                       host_capacity=2, registry=reg)
+        store.lookup(_ids(0, 1, 2, 3))
+        store.lookup(_ids(4, 5, 6, 7))  # 4 demotions into a 2-slot host
+        assert store.stats()["host_entities"] == 2
+        assert reg.counter("serve_tier_evict").value(
+            coordinate="c", tier="host") == 2
+
+
+# ---------------------------------------------------------------------------
+# ServingScorer (in-process)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def scorer_parts(tmp_path):
+    model_dir = _build_model_dir(str(tmp_path))
+    model, imaps = load_scoring_model(model_dir, None, materialize=True)
+    records = _make_records()
+    return model, imaps, records
+
+
+class TestServingScorer:
+    def test_matches_batch_core_and_is_deterministic(self, scorer_parts):
+        model, imaps, records = scorer_parts
+        # a 2-row device budget forces promotion/eviction churn on
+        # every batch — the tiers must never change a single row's bits
+        scorer = ServingScorer(model, SECTIONS, imaps,
+                               hbm_budget_bytes=2 * 4 * 4,
+                               registry=MetricsRegistry())
+        data = game_dataset_from_records(
+            records, SECTIONS, imaps, id_types=("userId",),
+            response_required=False)
+        batch = np.asarray(score_game_dataset(model, data), np.float64)
+        first, uids = scorer.score_records(records)
+        # conftest enables x64, so the in-process batch core keeps f64
+        # partials the f32 serving fold rounds; the subprocess e2e below
+        # asserts EXACT equality under the production (f32) config
+        np.testing.assert_allclose(first, batch, rtol=1e-5, atol=1e-6)
+        assert list(uids) == [r["uid"] for r in records]
+        again, _ = scorer.score_records(records)
+        np.testing.assert_array_equal(first, again)
+
+    def test_chunk_boundaries_cannot_change_row_bits(self, scorer_parts):
+        model, imaps, records = scorer_parts
+        scorer = ServingScorer(model, SECTIONS, imaps,
+                               registry=MetricsRegistry())
+        full, _ = scorer.score_records(records)
+        for k in (1, 3, 5, len(records)):
+            part, _ = scorer.score_records(records[:k])
+            np.testing.assert_array_equal(part, full[:k])
+
+    def test_above_batch_cap_chunks_internally(self, scorer_parts):
+        model, imaps, records = scorer_parts
+        scorer = ServingScorer(model, SECTIONS, imaps, max_batch_rows=8,
+                               registry=MetricsRegistry())
+        wide = ServingScorer(model, SECTIONS, imaps,
+                             registry=MetricsRegistry())
+        chunked, _ = scorer.score_records(records)
+        whole, _ = wide.score_records(records)
+        np.testing.assert_array_equal(chunked, whole)
+
+
+class TestZeroRetraceWarmLoop:
+    @pytest.fixture(autouse=True)
+    def _compile_layer_isolation(self):
+        yield
+        obs_compile.disarm()
+        obs_compile.reset()
+
+    def test_warm_buckets_never_retrace(self, tmp_path):
+        model_dir = _build_model_dir(str(tmp_path))
+        model, imaps = load_scoring_model(model_dir, None,
+                                          materialize=True)
+        records = _make_records(n=16)
+        reg = MetricsRegistry()
+        obs_compile.arm(registry=reg)
+        scorer = ServingScorer(model, SECTIONS, imaps, registry=reg)
+        # warmup: batch sizes 1..8 share bucket 8; 9..16 share bucket 16
+        sizes = (1, 3, 8, 9, 16)
+        for n in sizes:
+            scorer.score_records(records[:n])
+        warm_compiles = reg.counter("compiles").total()
+        assert warm_compiles > 0
+        # hot loop: every size again, twice — same buckets, no compiles
+        for _ in range(2):
+            for n in sizes:
+                scorer.score_records(records[:n])
+        assert reg.counter("compiles").total() == warm_compiles
+        assert reg.counter("retrace_causes").total() == 0
+        serve_sites = [s for s in obs_compile._SITES
+                       if s.startswith("serve.")]
+        assert any("serve.combine[b8]" == s for s in serve_sites)
+        assert any("serve.combine[b16]" == s for s in serve_sites)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: real subprocesses
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def e2e_fixture(tmp_path_factory):
+    """Model dir + request rows + the batch-driver subprocess's scores
+    (uid → float64), computed under the production dtype config."""
+    root = str(tmp_path_factory.mktemp("serve_e2e"))
+    model_dir = _build_model_dir(root)
+    records = _make_records()
+    avro = os.path.join(root, "in.avro")
+    write_container(avro, GAME_SCHEMA, records)
+    out = os.path.join(root, "scores_out")
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.game_scoring_driver",
+         "--input-data-dirs", avro,
+         "--game-model-input-dir", model_dir,
+         "--output-dir", out,
+         "--feature-shard-id-to-feature-section-keys-map", SECTIONS_FLAG,
+         "--random-effect-id-set", "userId"],
+        env=_subprocess_env(), cwd=_REPO, text=True,
+        capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    by_uid = {}
+    for part in glob.glob(os.path.join(out, "scores", "*.avro")):
+        for rec in load_scored_items(part):
+            by_uid[rec["uid"]] = rec["predictionScore"]
+    assert len(by_uid) == len(records)
+    return {"root": root, "model_dir": model_dir, "records": records,
+            "batch_scores": by_uid}
+
+
+def _spawn_serve(args, extra_env=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serve.service", *args],
+        env=_subprocess_env(**(extra_env or {})), cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("PHOTON_SERVE ready endpoint="):
+        proc.kill()
+        _, err = proc.communicate()
+        raise RuntimeError(f"no ready line: {line!r}\n{err[-2000:]}")
+    return proc, line.split("endpoint=", 1)[1]
+
+
+def _serve_args(model_dir, listen, trace_dir, extra=()):
+    return ["--game-model-input-dir", model_dir,
+            "--listen", listen,
+            "--feature-shard-id-to-feature-section-keys-map",
+            SECTIONS_FLAG,
+            "--random-effect-id-set", "userId",
+            "--max-batch-rows", "64",
+            "--trace-dir", trace_dir,
+            "--trace-heartbeat-seconds", "0.2",
+            *extra]
+
+
+def _score_retry(endpoint, records, deadline_secs=120.0):
+    last: object = None
+    deadline = time.monotonic() + deadline_secs
+    while time.monotonic() < deadline:
+        try:
+            with ServeClient(endpoint) as client:
+                resp = client.score(records)
+                if resp.get("kind") == "scores":
+                    return resp
+                last = resp
+        except (ConnectionError, OSError) as e:
+            last = e
+        time.sleep(0.25)
+    raise RuntimeError(f"service never answered: {last!r}")
+
+
+class TestServeEndToEnd:
+    def test_acceptance_scenario(self, e2e_fixture, tmp_path):
+        """Concurrent clients bit-identical to the batch driver, dead
+        client survived, SLOs through photon_status, zero retraces
+        warm, SIGTERM drain to rc 75."""
+        records = e2e_fixture["records"]
+        batch = e2e_fixture["batch_scores"]
+        trace = str(tmp_path / "trace")
+        sock = str(tmp_path / "serve.sock")
+        proc, endpoint = _spawn_serve(_serve_args(
+            e2e_fixture["model_dir"], "unix:" + sock, trace,
+            extra=["--device-telemetry"]))
+        try:
+            # -- concurrent clients, every score bit-exact by uid -----
+            failures: list[str] = []
+
+            def client_loop(lo, hi):
+                try:
+                    with ServeClient(endpoint) as client:
+                        for _ in range(3):
+                            resp = client.score(records[lo:hi])
+                            scores = resp["scores"]
+                            uids = resp["uids"]
+                            for uid, s in zip(uids, scores):
+                                if batch[uid] != s:
+                                    failures.append(
+                                        f"{uid}: served {s!r} != batch "
+                                        f"{batch[uid]!r}")
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"client error: {e}")
+
+            threads = [threading.Thread(target=client_loop,
+                                        args=(lo, lo + 8))
+                       for lo in (0, 8, 16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not failures, failures[:5]
+
+            # -- a client that dies with replies owed ------------------
+            raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            raw.connect(sock)
+            reader = raw.makefile("rb")
+            reader.readline()  # hello
+            raw.sendall((json.dumps(
+                {"kind": "score", "id": "doomed",
+                 "rows": records}) + "\n").encode())
+            raw.shutdown(socket.SHUT_RDWR)
+            reader.close()
+            raw.close()
+            resp = _score_retry(endpoint, records, deadline_secs=30)
+            for uid, s in zip(resp["uids"], resp["scores"]):
+                assert batch[uid] == s
+
+            # -- stats + photon_status as the SLO monitor --------------
+            with ServeClient(endpoint) as client:
+                stats = client.stats()
+            assert stats["qps"] > 0 and stats["p99_ms"] > 0
+            assert stats["tiers"], "tier stats missing"
+            time.sleep(0.7)  # let a heartbeat carry the SLO gauges
+            status_proc = subprocess.run(
+                [sys.executable, os.path.join(_TOOLS, "photon_status.py"),
+                 "--run-dir", trace, "--json"],
+                capture_output=True, text=True, timeout=60)
+            assert status_proc.returncode == 0, (
+                status_proc.stdout + status_proc.stderr)
+            status = json.loads(status_proc.stdout)
+            serving = status["processes"]["0"]["serving"]
+            assert serving["qps"] > 0
+            assert serving["p99_ms"] is not None
+            assert serving["rows_scored"] > 0
+        finally:
+            proc.terminate()
+            try:
+                rc = proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+            _, err = proc.communicate()
+
+        # -- exit discipline + warm-loop retrace evidence --------------
+        assert rc == PREEMPTED_EXIT, err[-2000:]
+        assert "PHOTON_PREEMPTED" in err
+        assert "Traceback (most recent call last)" not in err
+        compile_spans = retrace_spans = 0
+        with open(os.path.join(trace, "spans.jsonl")) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                compile_spans += rec.get("name") == "xla.compile"
+                retrace_spans += rec.get("name") == "xla.retrace"
+        assert compile_spans > 0, "device telemetry recorded no compiles"
+        assert retrace_spans == 0, (
+            f"warm serving loop retraced {retrace_spans}x")
+
+    def test_shed_error_response_under_tiny_queue(self, e2e_fixture,
+                                                  tmp_path):
+        """A queue bound smaller than one request sheds with an error
+        response (never blocks) and the shed rides the metric totals."""
+        records = e2e_fixture["records"]
+        trace = str(tmp_path / "trace")
+        sock = str(tmp_path / "serve.sock")
+        proc, endpoint = _spawn_serve(_serve_args(
+            e2e_fixture["model_dir"], "unix:" + sock, trace,
+            extra=["--max-queue-rows", "8"]))
+        try:
+            with ServeClient(endpoint) as client:
+                resp = client.score(records)  # 24 rows > 8-row queue
+                assert resp["kind"] == "error"
+                assert "shed:queue_full" in resp["error"]
+                small = client.score(records[:4])
+                assert small["kind"] == "scores"
+        finally:
+            proc.terminate()
+            rc = proc.wait(timeout=90)
+            proc.communicate()
+        assert rc == PREEMPTED_EXIT
+        shed = None
+        with open(os.path.join(trace, "metrics.jsonl")) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                totals = rec.get("metric_totals") or {}
+                if "serve_shed" in totals:
+                    shed = totals["serve_shed"]
+        assert shed and shed >= 1
+
+    def test_kill_mid_batch_supervisor_relaunch_bit_exact(
+            self, e2e_fixture, tmp_path):
+        """The issue's relaunch drill: SIGKILL lands mid-batch (fault
+        budget claimed once across incarnations), photon_supervise
+        relaunches the service, the relaunched incarnation scores
+        bit-identically to the batch driver, and a stop file drains the
+        supervisor to PHOTON_SUPERVISE_OK."""
+        records = e2e_fixture["records"]
+        batch = e2e_fixture["batch_scores"]
+        trace = str(tmp_path / "trace")
+        sock = str(tmp_path / "serve.sock")
+        stop_file = str(tmp_path / "stop")
+        args = _serve_args(e2e_fixture["model_dir"], "unix:" + sock,
+                           trace, extra=["--stop-file", stop_file])
+        sup = subprocess.Popen(
+            [sys.executable, os.path.join(_TOOLS, "photon_supervise.py"),
+             "--module", "photon_ml_tpu.serve.service",
+             "--backoff-base", "0.2", "--run-dir", trace, "--", *args],
+            env=_subprocess_env(
+                PHOTON_FAULTS=f"serve.batch=kill:1:{KILL_EXIT}",
+                PHOTON_FAULTS_STATE_DIR=str(tmp_path / "fault_state"),
+                PHOTON_FAULTS_SEED="42"),
+            cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        try:
+            resp = _score_retry("unix:" + sock, records,
+                                deadline_secs=150)
+            for uid, s in zip(resp["uids"], resp["scores"]):
+                assert batch[uid] == s, f"{uid} diverged after relaunch"
+            with open(stop_file, "w") as fh:
+                fh.write("test done\n")
+            rc = sup.wait(timeout=120)
+        finally:
+            if sup.poll() is None:
+                sup.kill()
+            out, err = sup.communicate()
+        assert rc == 0, err[-3000:]
+        assert "PHOTON_SUPERVISE_OK" in out
+        restarts = [w for w in out.split() if w.startswith("restarts=")]
+        assert restarts and int(restarts[-1].split("=")[1]) >= 1, out

@@ -188,13 +188,13 @@ def build_cells(smoke: bool) -> list[CellDef]:
     def cell(point, mode, spec, expected, smoke_cell=False,
              pre_run=False, note="", bit_exact=False,
              expect_drops=False, variant="", extra_args=None,
-             bridge=False):
+             bridge=False, serve=False):
         return {"point": point, "mode": mode, "spec": spec,
                 "expected": expected, "smoke": smoke_cell,
                 "pre_run": pre_run, "note": note,
                 "bit_exact": bit_exact, "expect_drops": expect_drops,
                 "variant": variant, "extra_args": extra_args or [],
-                "bridge": bridge}
+                "bridge": bridge, "serve": serve}
 
     cells = [
         # --- I/O layer: retry → quarantine → coverage budget ----------
@@ -309,6 +309,29 @@ def build_cells(smoke: bool) -> list[CellDef]:
              bridge=True, bit_exact=True,
              note="laggy collector path: the bridge absorbs the "
                   "latency itself"),
+        # --- scoring service: the fault point fires in a real
+        # --- photon_serve subprocess; invariants are connection-scoped
+        # --- failure (the service outlives its worst request) and the
+        # --- batch-parity anchor (post-fault scores stay bit-identical
+        # --- to the shared batch scoring core) -------------------------
+        cell("serve.request", "io_error", "serve.request=io_error:1",
+             "ok", serve=True,
+             note="one request fails with an error response and drops "
+                  "its connection; a fresh connection scores bit-exact"),
+        cell("serve.batch", "io_error", "serve.batch=io_error:1", "ok",
+             serve=True,
+             note="one micro-batch fails, its requests get error "
+                  "responses; the next batch scores bit-exact"),
+        cell("serve.batch", "signal", "serve.batch=signal:1",
+             "preempted", serve=True,
+             note="SIGTERM lands during a batch: the batch completes "
+                  "and replies, the service drains and exits 75"),
+        cell("serve.batch", "kill",
+             f"serve.batch=kill:1:{KILL_EXIT}", "killed", serve=True,
+             note="killed mid-batch under photon_supervise --module: "
+                  "relaunched (kill budget claimed across "
+                  "incarnations), scores bit-exact after relaunch, "
+                  "stop-file drains the supervisor to done"),
     ]
     if smoke:
         cells = [c for c in cells if c["smoke"]]
@@ -419,6 +442,8 @@ def run_cell(c: CellDef, fixture: dict, workdir: str,
              reference_objective) -> dict:
     """One (point, mode) cell: arm via PHOTON_FAULTS, run the driver,
     assert the invariant matrix."""
+    if c.get("serve"):
+        return _run_serve_cell(c, workdir)
     name = f"{c['point']}={c['mode']}"
     if c.get("variant"):
         name += f"@{c['variant']}"
@@ -632,6 +657,395 @@ def _run_bridge_cell(c: CellDef, name: str, args: list[str],
             "failures": failures, "passed": not failures}
 
 
+# ---------------------------------------------------------------------------
+# Scoring-service cells
+# ---------------------------------------------------------------------------
+
+_SERVE_FIXTURE: dict = {}
+
+
+def build_serve_fixture(workdir: str) -> dict:
+    """Tiny GAME model on disk + request rows + the reference scores
+    computed HERE through the shared batch scoring core
+    (`serve.scoring`): the anchor every serve cell's bit-exactness
+    check compares against."""
+    if workdir in _SERVE_FIXTURE:
+        return _SERVE_FIXTURE[workdir]
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.game.models import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.io.data_format import game_dataset_from_records
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_ml_tpu.optimize.config import TaskType
+    from photon_ml_tpu.serve.scoring import (
+        load_scoring_model,
+        score_game_dataset,
+    )
+
+    d_g, d_u, n_users = 4, 2, 6
+    rng = np.random.default_rng(11)
+    imaps = {
+        "global": IndexMap.from_keys([f"g{j}" for j in range(d_g)],
+                                     add_intercept=True),
+        "user": IndexMap.from_keys([f"u{j}" for j in range(d_u)],
+                                   add_intercept=True),
+    }
+    fixed = FixedEffectModel(GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=len(imaps["global"])),
+                                 jnp.float32)),
+        TaskType.LINEAR_REGRESSION), "global")
+    vocab = np.asarray([f"user{u}" for u in range(n_users)])
+    re_model = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n_users),
+        coefficients=jnp.asarray(
+            rng.normal(size=(n_users, len(imaps["user"]))), jnp.float32))
+    model_dir = os.path.join(workdir, "serve_model")
+    save_game_model(GameModel({"fixed": fixed, "per-user": re_model}),
+                    model_dir, imaps, entity_vocabs={"userId": vocab})
+
+    records = []
+    for i in range(24):
+        u = int(rng.integers(0, n_users))
+        records.append({
+            "uid": f"req_{i}",
+            "metadataMap": {"userId": f"user{u}"},
+            "globalFeatures": [
+                {"name": f"g{j}", "term": "",
+                 "value": float(rng.normal())} for j in range(d_g)],
+            "userFeatures": [
+                {"name": f"u{j}", "term": "",
+                 "value": float(rng.normal())} for j in range(d_u)],
+        })
+    sections = {"global": ["globalFeatures"], "user": ["userFeatures"]}
+    # reload model AND index maps from disk — the exact load the serve
+    # subprocess performs, so the reference anchors the same mapping
+    model, loaded_maps = load_scoring_model(model_dir, None)
+    data = game_dataset_from_records(
+        records, sections, loaded_maps, id_types=("userId",),
+        response_required=False)
+    ref = np.asarray(score_game_dataset(model, data), np.float64)
+    fix = {"model_dir": model_dir, "records": records, "ref": ref}
+    _SERVE_FIXTURE[workdir] = fix
+    return fix
+
+
+def serve_args(model_dir: str, listen: str, trace_dir: str,
+               extra: list[str] | None = None) -> list[str]:
+    return [
+        "--game-model-input-dir", model_dir,
+        "--listen", listen,
+        "--feature-shard-id-to-feature-section-keys-map",
+        "global:globalFeatures|user:userFeatures",
+        "--random-effect-id-set", "userId",
+        "--max-batch-rows", "64",
+        "--trace-dir", trace_dir,
+        "--trace-heartbeat-seconds", "0.2",
+        *(extra or []),
+    ]
+
+
+def _spawn_serve(args: list[str], extra_env: dict | None = None):
+    """Start a real serve subprocess, wait for its ready line, return
+    ``(proc, endpoint)``."""
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serve.service", *args],
+        env=env, cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    line = proc.stdout.readline().strip()  # blocks through model load
+    if not line.startswith("PHOTON_SERVE ready endpoint="):
+        proc.kill()
+        _, err = proc.communicate()
+        raise RuntimeError(
+            f"serve subprocess never became ready: {line!r}\n{err[-2000:]}")
+    return proc, line.split("endpoint=", 1)[1]
+
+
+def _serve_score_once(endpoint: str, records) -> dict:
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    with ServeClient(endpoint) as client:
+        return client.score(records)
+
+
+def _serve_score_retry(endpoint: str, records, deadline_secs=120.0):
+    """Score with reconnect retries — rides out a dead/relaunching
+    service until the endpoint answers with real scores."""
+    last: object = None
+    deadline = time.monotonic() + deadline_secs
+    while time.monotonic() < deadline:
+        try:
+            resp = _serve_score_once(endpoint, records)
+            if resp.get("kind") == "scores":
+                return resp
+            last = resp
+        except (ConnectionError, OSError) as e:
+            last = e
+        time.sleep(0.25)
+    raise RuntimeError(f"service never answered with scores: {last!r}")
+
+
+def _serve_metric_total(trace_dir: str, name: str):
+    """The metric's value in the LAST ``metric_totals`` snapshot of the
+    serve run's metrics stream (run_end preferred by position)."""
+    path = os.path.join(trace_dir, "metrics.jsonl")
+    if not os.path.exists(path):
+        return None
+    total = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric_totals") and name in rec["metric_totals"]:
+                total = rec["metric_totals"][name]
+    return total
+
+
+def _run_serve_cell(c: CellDef, workdir: str) -> dict:
+    """One scoring-service (point, mode) cell against a real
+    photon_serve subprocess."""
+    import numpy as np
+
+    fix = build_serve_fixture(workdir)
+    name = f"{c['point']}={c['mode']}"
+    cell_dir = os.path.join(
+        workdir, "cells", name.replace("=", "_").replace(".", "_"))
+    shutil.rmtree(cell_dir, ignore_errors=True)
+    os.makedirs(cell_dir)
+    trace = os.path.join(cell_dir, "trace")
+    sock = os.path.join(cell_dir, "serve.sock")
+    failures: list[str] = []
+    t0 = time.monotonic()
+    ref = fix["ref"]
+    records = fix["records"]
+    expected = c["expected"]
+
+    if expected == "killed":
+        return _run_serve_kill_cell(c, name, fix, cell_dir, trace, sock,
+                                    failures, t0)
+
+    env = {"PHOTON_FAULTS": c["spec"],
+           "PHOTON_FAULTS_STATE_DIR": os.path.join(cell_dir, "fault_state"),
+           "PHOTON_FAULTS_SEED": "42"}
+    proc, endpoint = _spawn_serve(
+        serve_args(fix["model_dir"], "unix:" + sock, trace), extra_env=env)
+    rc = None
+    outcome = "?"
+    try:
+        if expected == "preempted":
+            # `signal` fires INSIDE the batch: the SIGTERM is latched,
+            # the batch still completes and replies, then the service
+            # drains and exits preempted
+            resp = _serve_score_once(endpoint, records)
+            if resp.get("kind") != "scores" or not np.array_equal(
+                    np.asarray(resp["scores"], np.float64), ref):
+                failures.append(
+                    f"signal cell: the in-flight batch must complete "
+                    f"bit-exact before the drain, got {str(resp)[:300]}")
+            rc = proc.wait(timeout=90)
+            if rc != PREEMPTED_EXIT:
+                failures.append(f"expected drain to rc={PREEMPTED_EXIT}, "
+                                f"got rc={rc}")
+            outcome = "preempted(batch completed)"
+        else:  # connection-scoped "ok" cells
+            first = None
+            try:
+                first = _serve_score_once(endpoint, records)
+            except (ConnectionError, OSError):
+                pass  # the faulted connection may just drop
+            if first is not None and first.get("kind") == "scores":
+                failures.append(
+                    f"fault {c['spec']} armed but the first score "
+                    f"request succeeded")
+            resp = _serve_score_retry(endpoint, records, deadline_secs=30)
+            if not np.array_equal(
+                    np.asarray(resp["scores"], np.float64), ref):
+                failures.append(
+                    "post-fault scores NOT bit-exact vs the shared "
+                    "batch scoring core")
+            proc.terminate()
+            rc = proc.wait(timeout=90)
+            if rc != PREEMPTED_EXIT:
+                failures.append(f"SIGTERM drain must exit "
+                                f"rc={PREEMPTED_EXIT}, got rc={rc}")
+            outcome = "survived+bit_exact"
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"serve cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        _, err = proc.communicate()
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    if rc == PREEMPTED_EXIT and "PHOTON_PREEMPTED" not in err:
+        failures.append(f"rc={PREEMPTED_EXIT} without a "
+                        f"PHOTON_PREEMPTED line")
+    _check_trace_survives(trace, failures)
+    return {"cell": name, "spec": c["spec"], "expected": expected,
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def _run_serve_kill_cell(c: CellDef, name: str, fix: dict, cell_dir: str,
+                         trace: str, sock: str, failures: list[str],
+                         t0: float) -> dict:
+    """The supervisor-relaunch drill: photon_supervise --module runs the
+    service; an injected kill lands mid-batch (budget claimed once via
+    PHOTON_FAULTS_STATE_DIR, so the relaunch runs clean); the client
+    rides the outage on reconnect retries; post-relaunch scores must be
+    bit-exact; a stop file drains the supervisor to PHOTON_SUPERVISE_OK."""
+    import numpy as np
+
+    stop_file = os.path.join(cell_dir, "stop")
+    args = serve_args(fix["model_dir"], "unix:" + sock, trace,
+                      extra=["--stop-file", stop_file])
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    env.update({
+        "PHOTON_FAULTS": c["spec"],
+        "PHOTON_FAULTS_STATE_DIR": os.path.join(cell_dir, "fault_state"),
+        "PHOTON_FAULTS_SEED": "42",
+    })
+    sup = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "photon_supervise.py"),
+         "--module", "photon_ml_tpu.serve.service",
+         "--backoff-base", "0.2", "--run-dir", trace, "--", *args],
+        env=env, cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    rc = None
+    outcome = "?"
+    try:
+        # the first scored batch trips the kill; keep retrying through
+        # the death + relaunch until the second incarnation answers
+        resp = _serve_score_retry("unix:" + sock, fix["records"],
+                                  deadline_secs=150)
+        if not np.array_equal(np.asarray(resp["scores"], np.float64),
+                              fix["ref"]):
+            failures.append("post-relaunch scores NOT bit-exact vs the "
+                            "shared batch scoring core")
+        with open(stop_file, "w") as fh:
+            fh.write("chaos cell done\n")
+        rc = sup.wait(timeout=120)
+        outcome = "killed+relaunched"
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"serve kill cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+        out, err = sup.communicate()
+    if rc != 0:
+        failures.append(f"supervisor must finish rc=0 after the "
+                        f"stop-file drain, got rc={rc}:\n{err[-1500:]}")
+    elif "PHOTON_SUPERVISE_OK" not in out:
+        failures.append(f"no PHOTON_SUPERVISE_OK line: {out[-400:]!r}")
+    else:
+        m = [w for w in out.split() if w.startswith("restarts=")]
+        restarts = int(m[-1].split("=", 1)[1]) if m else 0
+        if restarts < 1:
+            failures.append(
+                "supervisor reports restarts=0 — the injected kill "
+                "never cost an incarnation")
+        else:
+            outcome += f"(restarts={restarts})"
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    _check_trace_survives(trace, failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def run_serve_dead_client_scenario(workdir: str) -> dict:
+    """No injection: a client sends a score request and vanishes without
+    reading the reply. The service must count the dead client as shed
+    (`serve_shed{reason=dead_client}`) and keep serving — the next
+    connection scores bit-exact."""
+    import socket
+
+    import numpy as np
+
+    fix = build_serve_fixture(workdir)
+    cell_dir = os.path.join(workdir, "cells", "scenario_serve_dead_client")
+    shutil.rmtree(cell_dir, ignore_errors=True)
+    os.makedirs(cell_dir)
+    trace = os.path.join(cell_dir, "trace")
+    sock_path = os.path.join(cell_dir, "serve.sock")
+    failures: list[str] = []
+    t0 = time.monotonic()
+    proc, endpoint = _spawn_serve(
+        serve_args(fix["model_dir"], "unix:" + sock_path, trace))
+    rc = None
+    try:
+        raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        raw.connect(sock_path)
+        reader = raw.makefile("rb")
+        reader.readline()  # server hello
+        raw.sendall((json.dumps(
+            {"kind": "score", "id": "doomed",
+             "rows": fix["records"]}) + "\n").encode())
+        # vanish before the reply: shutdown() severs the socket even
+        # though the makefile() reader still holds a reference
+        raw.shutdown(socket.SHUT_RDWR)
+        reader.close()
+        raw.close()
+        resp = _serve_score_retry(endpoint, fix["records"],
+                                  deadline_secs=30)
+        if not np.array_equal(np.asarray(resp["scores"], np.float64),
+                              fix["ref"]):
+            failures.append("scores after the dead client NOT bit-exact "
+                            "vs the shared batch scoring core")
+        proc.terminate()
+        rc = proc.wait(timeout=90)
+        if rc != PREEMPTED_EXIT:
+            failures.append(f"SIGTERM drain must exit "
+                            f"rc={PREEMPTED_EXIT}, got rc={rc}")
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"dead-client scenario harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        _, err = proc.communicate()
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    shed = _serve_metric_total(trace, "serve_shed")
+    if not shed:
+        failures.append(f"expected serve_shed >= 1 in the final metric "
+                        f"totals, found {shed!r}")
+    _check_trace_survives(trace, failures)
+    return {"cell": "scenario.serve_dead_client",
+            "spec": "(client sends a score request and closes without "
+                    "reading — no injection)",
+            "expected": "ok", "rc": rc,
+            "outcome": f"survived+shed({shed})",
+            "note": "ISSUE acceptance scenario: the service outlives "
+                    "its worst client",
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
 def run_corrupt_shard_scenario(fixture: dict, workdir: str) -> dict:
     """The issue's acceptance scenario, with NO fault injection: one
     Avro shard's real bytes are flipped on disk; the training run must
@@ -730,13 +1144,16 @@ def run_campaign(workdir: str, smoke: bool,
         for f in r["failures"]:
             print(f"chaos:        {f}", flush=True)
     if not points:  # --points restricts to injection cells only
-        r = run_corrupt_shard_scenario(fixture, workdir)
-        results.append(r)
-        print(f"chaos: [{'PASS' if r['passed'] else 'FAIL'}] "
-              f"{r['cell']:<28} -> {r['outcome']} ({r['seconds']}s)",
-              flush=True)
-        for f in r["failures"]:
-            print(f"chaos:        {f}", flush=True)
+        scenarios = [run_corrupt_shard_scenario(fixture, workdir)]
+        if not smoke:  # the serve scenario needs no training fixture
+            scenarios.append(run_serve_dead_client_scenario(workdir))
+        for r in scenarios:
+            results.append(r)
+            print(f"chaos: [{'PASS' if r['passed'] else 'FAIL'}] "
+                  f"{r['cell']:<28} -> {r['outcome']} ({r['seconds']}s)",
+                  flush=True)
+            for f in r["failures"]:
+                print(f"chaos:        {f}", flush=True)
 
     results.extend(skipped)
     failed = [r for r in results if not r["passed"]]
@@ -761,6 +1178,10 @@ def run_campaign(workdir: str, smoke: bool,
             "a dead collector leaves the OTLP bridge exit-0 with its "
             "batches dropped+counted, and the run it watches exit-0 "
             "and bit-exact (obs.otlp cells)",
+            "a scoring-service fault is connection-scoped: the service "
+            "outlives its worst request/client, post-fault scores stay "
+            "bit-identical to the shared batch core, and an injected "
+            "kill costs one supervised incarnation (serve.* cells)",
         ],
         "cells": results,
     }

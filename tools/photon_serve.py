@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""photon-serve: the always-on GAME scoring service.
+
+Thin launcher for ``photon_ml_tpu.serve.service`` (see that module for
+the protocol, batching, and tier semantics, and the README "Serving"
+section for the operational recipe). Equivalent module form — the one
+``photon_supervise --module photon_ml_tpu.serve.service`` relaunches::
+
+    python -m photon_ml_tpu.serve.service \
+        --game-model-input-dir out/models \
+        --listen 127.0.0.1:7337 \
+        --feature-shard-id-to-feature-section-keys-map \
+            "global:globalFeatures|user:userFeatures" \
+        --random-effect-id-set userId \
+        --trace-dir out/serve-trace \
+        --telemetry-endpoint 127.0.0.1:9090
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from photon_ml_tpu.serve.service import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

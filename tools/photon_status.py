@@ -353,6 +353,20 @@ def compute_status(records: list[dict]) -> dict:
             "re_shard_hbm_live_bytes": p.pop("_shard_hbm", None),
             "stalls": totals.get("stalls", 0),
             "data_coverage": totals.get("data_coverage"),
+            # scoring-service SLOs (photon_ml_tpu/serve): the service's
+            # qps/latency gauges and shed/tier counters ride the same
+            # heartbeat metric_totals as training metrics, so a serve
+            # process monitors through this tool unchanged
+            "serving": ({
+                "qps": totals.get("serve_qps"),
+                "p50_ms": totals.get("serve_p50_ms"),
+                "p99_ms": totals.get("serve_p99_ms"),
+                "queue_depth": totals.get("serve_queue_depth"),
+                "rows_scored": totals.get("serve_rows_scored"),
+                "shed": totals.get("serve_shed", 0),
+                "tier_hits": totals.get("serve_tier_hits"),
+            } if totals.get("serve_rows_scored") is not None
+                or totals.get("serve_qps") is not None else None),
             "stalled": bool(hb and hb.get("stalled")),
             "last_heartbeat_uptime_s": (hb or {}).get("uptime_s"),
             "spans_seen": p["spans_seen"],
@@ -472,6 +486,15 @@ def format_status(status: dict, source: str) -> str:
             f"{p['retries']:>7.0f} {quar:>5.0f} "
             f"{p['telemetry_dropped']:>7.0f} "
             f"{'YES' if p['stalled'] else 'no':>7}")
+        if p.get("serving"):
+            s = p["serving"]
+            lines.append(
+                f"     └ serving: qps={s['qps'] or 0:.1f} "
+                f"p50={s['p50_ms'] or 0:.1f}ms "
+                f"p99={s['p99_ms'] or 0:.1f}ms "
+                f"queue={s['queue_depth'] or 0:.0f} "
+                f"rows={s['rows_scored'] or 0:.0f} "
+                f"shed={s['shed'] or 0:.0f}")
         if p["run_end"] and p["run_end"]["status"] != "ok":
             lines.append(f"     └ run_end: {p['run_end']['status']} "
                          f"{p['run_end']['reason']}")

@@ -71,8 +71,15 @@ photon_status = _load_tool("photon_status.py", "photon_status")
 
 CLEAN_ABORT_EXIT = 3
 PREEMPTED_EXIT = 75
+#: The default supervised module. ``--module`` swaps in any entrypoint
+#: that speaks the same exit-code contract (0/3/75/scripted-kill) — the
+#: scoring service (``photon_ml_tpu.serve.service``) is the other
+#: in-tree citizen.
+TRAIN_MODULE = "photon_ml_tpu.cli.game_training_driver"
 # the ladder: level 0 runs the operator's args untouched; each level
-# appends flags (argparse last-occurrence-wins, so appending overrides)
+# appends flags (argparse last-occurrence-wins, so appending overrides).
+# The flags are training-driver CD semantics — the ladder only engages
+# when the supervised module IS the training driver.
 DEGRADE_LADDER = (
     [],
     ["--cd-pipeline-depth", "0"],
@@ -178,7 +185,8 @@ def supervise(driver_args: list[str], *, max_restarts: int = 5,
               grace_seconds: float = 10.0, poll_seconds: float = 0.5,
               startup_grace_seconds: float = 5.0, degrade_after: int = 2,
               listen: str | None = None, run_dir: str | None = None,
-              python: str | None = None) -> int:
+              python: str | None = None,
+              module: str = TRAIN_MODULE) -> int:
     """Run the driver to completion through crashes, preemptions, and
     stalls. Returns the supervisor's exit code (see module docstring)."""
     from photon_ml_tpu.parallel.multihost import WorkerSupervisor
@@ -205,14 +213,14 @@ def supervise(driver_args: list[str], *, max_restarts: int = 5,
     try:
         while True:
             attempt += 1
-            args = list(driver_args) + DEGRADE_LADDER[ladder_level]
+            args = list(driver_args) + (DEGRADE_LADDER[ladder_level]
+                                        if module == TRAIN_MODULE else [])
             env = dict(os.environ)
             env["PHOTON_GAME_SUPERVISED"] = "1"
             record("launch", attempt=attempt, ladder_level=ladder_level,
                    restarts=restarts)
             proc = subprocess.Popen(
-                [python or sys.executable, "-m",
-                 "photon_ml_tpu.cli.game_training_driver", *args],
+                [python or sys.executable, "-m", module, *args],
                 env=env)
             source = StatusSource(run_dir, collector)
             spawn_t = time.monotonic()
@@ -258,8 +266,10 @@ def supervise(driver_args: list[str], *, max_restarts: int = 5,
                 record("abort", reason="driver clean abort", rc=rc)
                 return CLEAN_ABORT_EXIT
             # the degradation ladder tracks FAILURES pinned to one
-            # coordinate; an honored preemption is progress, not failure
-            if rc != PREEMPTED_EXIT:
+            # coordinate; an honored preemption is progress, not
+            # failure. Its rungs are training-only CD flags, so other
+            # modules restart at level 0 forever instead of climbing.
+            if rc != PREEMPTED_EXIT and module == TRAIN_MODULE:
                 if position == fail_position:
                     fails_at_position += 1
                 else:
@@ -330,6 +340,11 @@ def main(argv=None) -> int:
                    help="consume the run's --telemetry-endpoint stream "
                         "at HOST:PORT / unix:/path.sock instead of "
                         "tailing the run dir")
+    p.add_argument("--module", default=TRAIN_MODULE,
+                   help="the python -m entrypoint to supervise "
+                        "(default: the GAME training driver; "
+                        "photon_ml_tpu.serve.service keeps the scoring "
+                        "service alive through the same contract)")
     ns, driver_args = p.parse_known_args(argv)
     if driver_args and driver_args[0] == "--":
         driver_args = driver_args[1:]
@@ -341,7 +356,7 @@ def main(argv=None) -> int:
         grace_seconds=ns.grace_seconds, poll_seconds=ns.poll_seconds,
         startup_grace_seconds=ns.startup_grace_seconds,
         degrade_after=ns.degrade_after, listen=ns.listen,
-        run_dir=ns.run_dir)
+        run_dir=ns.run_dir, module=ns.module)
 
 
 if __name__ == "__main__":
