@@ -1225,7 +1225,16 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
     end. The HBM budget holds half the entities so the device tier
     churns under load; the probe reports client-observed rows/sec, the
     service's own SLO gauges, and the per-tier hit split read back from
-    the exit metrics snapshot."""
+    the exit metrics snapshot.
+
+    Halfway through, the probe hot-swaps the service to a freshly
+    "retrained" model while all clients keep scoring:
+    ``swap_blackout_ms`` is the worst client-observed latency in the
+    swap window (request admission → flip resolution) — the cost of a
+    live generation flip. The probe asserts the swap completes, that
+    NOTHING sheds across it, and that the warm loop never retraces
+    (the candidate generation reuses the boot generation's compiled
+    shapes)."""
     import signal
     import subprocess
     import tempfile
@@ -1271,13 +1280,30 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
                               "value": float(rng.normal())}
                              for j in range(d_u)],
         })
+    # the "retrained" hot-swap candidate: same structure and vocab,
+    # freshly drawn coefficients
+    fixed_b = FixedEffectModel(GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=len(imaps["global"])),
+                                 jnp.float32)),
+        TaskType.LINEAR_REGRESSION), "global")
+    re_model_b = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n_users),
+        coefficients=jnp.asarray(
+            rng.normal(size=(n_users, len(imaps["user"]))), jnp.float32))
     row_bytes = len(imaps["user"]) * 4
     budget_mb = (n_users // 2) * row_bytes / (1 << 20)
     rows_scored = [0] * n_clients
+    latencies: list[list] = [[] for _ in range(n_clients)]
+    swap_window = {}
     with tempfile.TemporaryDirectory() as tmp:
         model_dir = os.path.join(tmp, "model")
         save_game_model(GameModel({"fixed": fixed, "per-user": re_model}),
                         model_dir, imaps, entity_vocabs={"userId": vocab})
+        candidate_dir = os.path.join(tmp, "model_retrained")
+        save_game_model(
+            GameModel({"fixed": fixed_b, "per-user": re_model_b}),
+            candidate_dir, imaps, entity_vocabs={"userId": vocab})
         trace = os.path.join(tmp, "trace")
         sock = os.path.join(tmp, "serve.sock")
         # the serve subprocess is pinned to CPU so the probe never
@@ -1294,6 +1320,11 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
              "--random-effect-id-set", "userId",
              "--max-batch-rows", "256",
              "--serve-hbm-budget-mb", f"{budget_mb:.6f}",
+             # the candidate is a genuinely retrained model, so its
+             # scores differ by design: open the canary's score-diff
+             # gate (the probe measures the flip, not the gate)
+             "--swap-canary-threshold-pct", "1e9",
+             "--swap-probation-seconds", "0.5",
              "--trace-dir", trace,
              "--trace-heartbeat-seconds", "0.5"],
             env=env, cwd=_REPO_DIR, text=True,
@@ -1314,20 +1345,47 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
                 while time.perf_counter() < deadline:
                     n = int(sizes[crng.integers(0, len(sizes))])
                     lo = int(crng.integers(0, len(records) - n))
+                    sent = time.perf_counter()
                     resp = client.score(records[lo:lo + n])
+                    done = time.perf_counter()
                     if resp.get("kind") == "scores":
                         rows_scored[ci] += len(resp["scores"])
+                        latencies[ci].append(
+                            (sent, done, (done - sent) * 1000.0))
+
+        def swap_loop():
+            # the live flip, halfway through, under full client load
+            time.sleep(duration_secs / 2.0)
+            swap_window["start"] = time.perf_counter()
+            with ServeClient(endpoint) as client:
+                swap_window["result"] = client.swap(
+                    candidate_dir, model_id="retrained")
+            swap_window["end"] = time.perf_counter()
 
         threads = [threading.Thread(target=client_loop, args=(ci,))
                    for ci in range(n_clients)]
+        threads.append(threading.Thread(target=swap_loop))
         t0 = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
         dt = time.perf_counter() - t0
+        swap_result = swap_window.get("result") or {}
+        assert swap_result.get("outcome") == "ok", (
+            f"serve probe: the live hot-swap must complete, got "
+            f"{swap_result!r}")
+        # worst client-observed latency among requests IN FLIGHT or
+        # admitted anywhere in the swap window: the flip's blackout
+        s0, s1 = swap_window["start"], swap_window["end"]
+        in_window = [ms for lat in latencies for (sent, done, ms) in lat
+                     if done >= s0 and sent <= s1]
+        swap_blackout_ms = max(in_window) if in_window else 0.0
         with ServeClient(endpoint) as client:
             stats = client.stats()
+        assert stats.get("generation") == 2, (
+            f"serve probe: post-swap stats must report generation 2, "
+            f"got {stats.get('generation')!r}")
         proc.send_signal(signal.SIGTERM)
         proc.wait(timeout=60)
         # per-tier hit split: the exit snapshot is the only labeled view
@@ -1348,6 +1406,23 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
                         + rec.get("value", 0)
                 elif rec.get("name") == "serve_shed":
                     shed += rec.get("value", 0)
+        # the flip contract under load: nothing sheds across the swap,
+        # and the candidate generation reuses the boot generation's
+        # compiled shapes — a warm retrace would be a latency cliff
+        assert shed == 0, (
+            f"serve probe: {shed:.0f} request(s) shed across the live "
+            f"hot-swap — the flip must not drop load")
+        retrace_spans = 0
+        with open(os.path.join(trace, "spans.jsonl")) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    retrace_spans += (json.loads(line).get("name")
+                                      == "xla.retrace")
+        assert retrace_spans == 0, (
+            f"serve probe: {retrace_spans} warm retrace(s) across the "
+            f"hot-swap — the candidate generation must reuse the "
+            f"compiled shapes")
     total_rows = int(sum(rows_scored))
     total_hits = sum(tier_hits.values())
     return {
@@ -1362,6 +1437,9 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
         else None,
         "tier_hits": {k: int(v) for k, v in sorted(tier_hits.items())},
         "shed": int(shed),
+        "swap_blackout_ms": round(swap_blackout_ms, 2),
+        "swap_generation": int(stats.get("generation") or 0),
+        "swap_outcome": swap_result.get("outcome"),
     }
 
 

@@ -43,12 +43,20 @@ def bucket_rows(n: int, min_bucket: int = MIN_BUCKET,
 
 @dataclass
 class ScoreWork:
-    """One queued scoring request."""
+    """One queued scoring request.
+
+    ``generation`` is the model generation the request was admitted
+    under (``GenerationStore.pin``); 0 means untagged — score against
+    whatever is current. A batch never spans two generations (see
+    :meth:`MicroBatcher.next_batch`), so no response ever mixes scores
+    from two models.
+    """
 
     rows: list  # decoded records, Avro record shape
     request_id: object
     reply: Callable[[object], None]  # called with the response dict
     enqueued_at: float = field(default_factory=time.monotonic)
+    generation: int = 0
 
 
 class MicroBatcher:
@@ -98,7 +106,10 @@ class MicroBatcher:
         """Up to ``max_batch_rows`` rows of queued work, in arrival
         order ([] on timeout). Always yields at least one request when
         any is queued, even one wider than the batch cap — the scorer
-        chunks internally."""
+        chunks internally. A batch stops at a generation boundary:
+        work pinned to different model generations never shares a
+        batch (the atomic-flip invariant — every response is scored
+        entirely by the generation it was admitted under)."""
         with self._lock:
             if not self._items:
                 self._nonempty.wait(timeout)
@@ -106,7 +117,8 @@ class MicroBatcher:
             rows = 0
             while self._items:
                 head = self._items[0]
-                if batch and rows + len(head.rows) > self.max_batch_rows:
+                if batch and (rows + len(head.rows) > self.max_batch_rows
+                              or head.generation != batch[0].generation):
                     break
                 batch.append(self._items.pop(0))
                 rows += len(head.rows)

@@ -9,25 +9,42 @@ Grammar (one JSON object per line):
 
 - server → client on connect::
 
-    {"kind": "serve_hello", "proto": 1, "model_id": ..., "coordinates": [...]}
+    {"kind": "serve_hello", "proto": 1, "model_id": ...,
+     "generation": <int>, "coordinates": [...]}
 
 - client → server::
 
     {"kind": "score", "id": <echoed>, "rows": [<record>, ...]}
     {"kind": "ping"}
     {"kind": "stats"}
+    {"kind": "swap", "id": <echoed>, "model_dir": "...",
+     "model_id": <optional>}
 
   A ``score`` row is a GAME record in the Avro record shape the batch
   loader reads: feature sections of ``{"name", "term", "value"}``
   entries, entity ids top-level or under ``metadataMap``, optional
-  ``uid``/``offset``/``weight``.
+  ``uid``/``offset``/``weight``. A ``swap`` asks the service to
+  hot-swap to the candidate model under ``model_dir`` (load+validate
+  off the hot path, shadow-scoring canary, atomic generation flip —
+  see ``serve/service.py``); its reply arrives when the swap RESOLVES
+  (flipped or refused), which can be many batches later.
 
 - server → client::
 
     {"kind": "scores", "proto": 1, "id": ..., "scores": [...], "uids": [...]}
     {"kind": "pong",   "proto": 1}
-    {"kind": "stats",  "proto": 1, ...}
+    {"kind": "stats",  "proto": 1, "generation": ..., "last_swap": ..., ...}
     {"kind": "error",  "proto": 1, "id": ..., "error": "..."}
+    {"kind": "swap_result", "proto": 1, "id": ...,
+     "outcome": "ok"|"refused", "generation": <now current>,
+     "model_id": <now current>, "reason"?: "...", "canary"?: {...},
+     "error"?: "ModelSwapRefusedError: ..."}
+
+  A refused swap carries the typed error name in ``error`` (the
+  client-side exception is :class:`ModelSwapRefusedError`); a
+  post-flip probation ROLLBACK happens after the reply and is
+  reported through ``stats``/``photon_status`` (``last_swap``), not
+  the ``swap_result``.
 
 Endpoints reuse the telemetry grammar (``host:port`` /
 ``unix:/path.sock``); ``file:`` endpoints are rejected — a request
@@ -41,11 +58,34 @@ import socket
 from typing import Optional, Sequence
 
 from photon_ml_tpu.obs.export import parse_endpoint
+from photon_ml_tpu.utils.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+    call_with_retry,
+)
 
 #: Protocol version stamped on every server message. Bump on any
 #: incompatible message-shape change (same discipline as
 #: ``obs/export.TELEMETRY_PROTO``).
 SERVE_PROTO = 1
+
+#: Client connect/reconnect backoff: bounded exponential with the
+#: deterministic keyed jitter every retry site shares. ``permanent_on``
+#: is emptied because a unix socket that is not bound yet raises
+#: FileNotFoundError — for a connect that is transient, not permanent.
+CONNECT_RETRY_POLICY = RetryPolicy(
+    max_attempts=5, base_delay_seconds=0.05, max_delay_seconds=1.0,
+    retry_on=(OSError,), permanent_on=())
+
+
+class ModelSwapRefusedError(RuntimeError):
+    """A hot-swap candidate was refused (unreadable/corrupt model,
+    canary score-diff violation, flip fault, or service draining) —
+    the service keeps serving its current generation."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 def parse_serve_endpoint(endpoint: str) -> tuple[str, object]:
@@ -62,9 +102,11 @@ def encode(obj: dict) -> bytes:
     return (json.dumps(obj, separators=(",", ":")) + "\n").encode()
 
 
-def hello(model_id: str, coordinates: Sequence[str]) -> dict:
+def hello(model_id: str, coordinates: Sequence[str],
+          generation: int = 1) -> dict:
     return {"kind": "serve_hello", "proto": SERVE_PROTO,
-            "model_id": model_id, "coordinates": list(coordinates)}
+            "model_id": model_id, "generation": int(generation),
+            "coordinates": list(coordinates)}
 
 
 def error_response(request_id, message: str) -> dict:
@@ -80,22 +122,86 @@ def scores_response(request_id, scores, uids=None) -> dict:
     return out
 
 
+def swap_response(request_id, outcome: str, generation: int,
+                  model_id: str, reason: Optional[str] = None,
+                  canary: Optional[dict] = None) -> dict:
+    """``swap_result`` reply; ``generation``/``model_id`` are what is
+    CURRENT after resolution (the candidate's on ``ok``, unchanged on
+    ``refused``)."""
+    out = {"kind": "swap_result", "proto": SERVE_PROTO,
+           "id": request_id, "outcome": outcome,
+           "generation": int(generation), "model_id": model_id}
+    if reason is not None:
+        out["reason"] = reason
+        if outcome == "refused":
+            out["error"] = f"ModelSwapRefusedError: {reason}"
+    if canary is not None:
+        out["canary"] = canary
+    return out
+
+
 class ServeClient:
     """Blocking convenience client (tests, bench, chaos drills).
 
     One request in flight at a time; responses are matched by arrival
-    order, which the single-connection protocol guarantees."""
+    order, which the single-connection protocol guarantees. Connecting
+    goes through ``utils/retry`` (site ``serve.connect``): a service
+    mid-restart costs a bounded, deterministically-jittered backoff
+    instead of an immediate ConnectionError. :meth:`reconnect`
+    re-dials the same endpoint and re-verifies the hello
+    ``generation`` — ``generation_changed`` records whether a
+    hot-swap happened while the client was away.
+    """
 
-    def __init__(self, endpoint: str, timeout: float = 30.0):
-        scheme, addr = parse_serve_endpoint(endpoint)
-        if scheme == "unix":
-            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        else:
-            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.settimeout(timeout)
-        self._sock.connect(addr)
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 connect_policy: Optional[RetryPolicy] = None):
+        self._endpoint = endpoint
+        self._timeout = timeout
+        self._scheme, self._addr = parse_serve_endpoint(endpoint)
+        self._policy = connect_policy or CONNECT_RETRY_POLICY
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self.hello: Optional[dict] = None
+        self.generation: Optional[int] = None
+        self.generation_changed = False
+        self._connect()
+
+    def _connect(self) -> None:
+        def attempt() -> socket.socket:
+            family = (socket.AF_UNIX if self._scheme == "unix"
+                      else socket.AF_INET)
+            sock = socket.socket(family, socket.SOCK_STREAM)
+            sock.settimeout(self._timeout)
+            try:
+                sock.connect(self._addr)
+            except BaseException:
+                sock.close()
+                raise
+            return sock
+
+        try:
+            self._sock = call_with_retry(attempt, "serve.connect",
+                                         policy=self._policy)
+        except RetryExhaustedError as e:
+            # keep the pre-backoff exception contract: callers (chaos
+            # drills, tests) dispatch on ConnectionError/OSError
+            raise e.__cause__ from e
+
+
         self._file = self._sock.makefile("rb")
         self.hello = self._read()
+        self.generation = self.hello.get("generation")
+
+    def reconnect(self) -> dict:
+        """Drop the connection and re-dial (same bounded backoff).
+        Returns the fresh hello; ``generation_changed`` is True when
+        the service's generation moved while we were away."""
+        previous = self.generation
+        self.close()
+        self._connect()
+        self.generation_changed = (
+            previous is not None and self.generation != previous)
+        return self.hello
 
     def _read(self) -> dict:
         line = self._file.readline()
@@ -118,11 +224,26 @@ class ServeClient:
     def stats(self) -> dict:
         return self.request({"kind": "stats"})
 
+    def swap(self, model_dir: str, model_id: Optional[str] = None,
+             request_id: Optional[str] = None) -> dict:
+        """Request a hot-swap; blocks until the swap RESOLVES (the
+        reply rides the same connection, after load + canary + flip).
+        Returns the ``swap_result`` dict — check ``outcome``."""
+        msg = {"kind": "swap", "id": request_id or "0",
+               "model_dir": model_dir}
+        if model_id:
+            msg["model_id"] = model_id
+        return self.request(msg)
+
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._file.close()
         finally:
             self._sock.close()
+            self._sock = None
+            self._file = None
 
     def __enter__(self) -> "ServeClient":
         return self

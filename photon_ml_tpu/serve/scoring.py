@@ -28,6 +28,7 @@ sliced off before they can touch a real row).
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -111,7 +112,18 @@ def _make_fold(num_coordinates: int):
     therefore the exact f32 bits) of :meth:`GameModel.score`, which
     starts from ``jnp.zeros`` and adds coordinate scores in model
     order. Elementwise adds are lane-local, so pad lanes never
-    influence real rows."""
+    influence real rows.
+
+    Cached per coordinate count: the fold depends only on ``C``, and
+    the ``obs/compile`` signature includes function identity, so a
+    fresh ``jax.jit`` per scorer instance would read as a
+    ``function_identity`` retrace at the shared ``serve.combine[bN]``
+    sites when a hot-swap builds the candidate generation's scorer —
+    sharing the jitted fold keeps warmed buckets warm across a flip.
+    """
+    fn = _FOLD_CACHE.get(num_coordinates)
+    if fn is not None:
+        return fn
 
     def fold(stacked):
         total = jnp.zeros_like(stacked[0])
@@ -119,7 +131,12 @@ def _make_fold(num_coordinates: int):
             total = total + stacked[i]
         return total
 
-    return jax.jit(fold)
+    fn = jax.jit(fold)
+    _FOLD_CACHE[num_coordinates] = fn
+    return fn
+
+
+_FOLD_CACHE: dict[int, object] = {}
 
 
 class ServingScorer:
@@ -160,6 +177,16 @@ class ServingScorer:
                 host_capacity=host_tier_entities, registry=registry)
             for cid in tiered}
         self._fold_fn = _make_fold(len(model.models))
+        #: Generation tag, assigned by :class:`GenerationStore` when the
+        #: scorer is activated (1 for a scorer that was never swapped).
+        self.generation = 1
+
+    def release_device(self) -> None:
+        """Release every tier store's device rows (generation
+        retirement — called only once no in-flight batch is pinned to
+        this generation). Reversible: a rollback re-warms on demand."""
+        for store in self.stores.values():
+            store.release()
 
     # -- per-batch path --------------------------------------------------
 
@@ -213,3 +240,171 @@ class ServingScorer:
 
     def stats(self) -> dict:
         return {"tiers": [s.stats() for s in self.stores.values()]}
+
+
+class _GenerationEntry:
+    __slots__ = ("scorer", "model_id", "pins", "retained", "released")
+
+    def __init__(self, scorer: ServingScorer, model_id: str):
+        self.scorer = scorer
+        self.model_id = model_id
+        self.pins = 0          # batches admitted, not yet replied
+        self.retained = False  # kept as the rollback target
+        self.released = False  # device rows dropped
+
+
+class GenerationStore:
+    """Versioned :class:`ServingScorer` registry with pinned-batch
+    accounting — the atomic-flip half of the hot-swap contract.
+
+    Reader threads :meth:`pin` the CURRENT generation per request at
+    admission; the device loop scores each micro-batch against the
+    generation its work is pinned to and :meth:`unpin`\\ s when the
+    reply (or error/shed) resolves. :meth:`activate` flips the current
+    generation in one lock-held assignment — new requests pin the
+    candidate, in-flight work keeps its old pin, and since the batcher
+    never mixes generations in a batch, no score ever mixes
+    generations. The previous generation is RETAINED as the rollback
+    target until probation passes; :meth:`rollback` re-activates it.
+    Old-generation device rows are freed only by :meth:`reap` — called
+    from the device loop (the only device-touching thread) once a
+    retired generation's last pinned batch has drained.
+
+    Generation numbers are monotonic (``_seq``) and never reused, so a
+    relaunch or rollback can always be audited to exactly one
+    consistent generation.
+    """
+
+    def __init__(self, scorer: ServingScorer, model_id: str,
+                 registry: MetricsRegistry = REGISTRY):
+        self._lock = threading.Lock()
+        self._entries: dict[int, _GenerationEntry] = {
+            1: _GenerationEntry(scorer, model_id)}
+        self._current = 1
+        self._seq = 1
+        self._previous: Optional[int] = None
+        self._registry = registry
+        scorer.generation = 1
+        registry.gauge("serve_generation").set(1)
+
+    # -- reads ----------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._current
+
+    @property
+    def next_generation(self) -> int:
+        """The number the next :meth:`activate` will assign (stable
+        while at most one swap is in flight — the service serializes
+        swaps)."""
+        with self._lock:
+            return self._seq + 1
+
+    def model_id(self, generation: Optional[int] = None) -> str:
+        with self._lock:
+            gen = self._current if generation is None else generation
+            return self._entries[gen].model_id
+
+    def scorer(self, generation: int = 0) -> ServingScorer:
+        """The scorer for ``generation`` (0 = current)."""
+        with self._lock:
+            gen = generation or self._current
+            return self._entries[gen].scorer
+
+    # -- pin accounting (reader threads / device loop) -------------------
+
+    def pin(self) -> int:
+        """Admit one request under the current generation."""
+        with self._lock:
+            self._entries[self._current].pins += 1
+            return self._current
+
+    def unpin(self, generation: int) -> None:
+        with self._lock:
+            entry = self._entries.get(generation)
+            if entry is not None and entry.pins > 0:
+                entry.pins -= 1
+
+    # -- the flip --------------------------------------------------------
+
+    def activate(self, scorer: ServingScorer, model_id: str) -> int:
+        """Atomic generation flip: the candidate becomes current, the
+        old current becomes the retained rollback target (displacing —
+        and thereby releasing — any older retained generation)."""
+        with self._lock:
+            self._seq += 1
+            new_gen = self._seq
+            scorer.generation = new_gen
+            old = self._current
+            self._entries[new_gen] = _GenerationEntry(scorer, model_id)
+            self._entries[old].retained = True
+            if self._previous is not None:
+                prev = self._entries.get(self._previous)
+                if prev is not None:
+                    prev.retained = False
+            self._previous = old
+            self._current = new_gen
+            self._registry.gauge("serve_generation").set(new_gen)
+            return new_gen
+
+    def rollback(self) -> int:
+        """Re-activate the retained previous generation (probation
+        failed). The rolled-back generation is retired un-retained —
+        reaped once its last pinned batch drains."""
+        with self._lock:
+            if self._previous is None:
+                raise RuntimeError("no retained generation to roll "
+                                   "back to")
+            failed = self._current
+            back = self._previous
+            self._current = back
+            self._previous = None
+            self._entries[back].retained = False
+            # the store re-warms on demand; a future retirement must
+            # release it again
+            self._entries[back].released = False
+            self._entries[failed].retained = False
+            self._registry.gauge("serve_generation").set(back)
+            return back
+
+    def release_previous(self) -> None:
+        """Probation passed: stop retaining the previous generation
+        (reaped once drained)."""
+        with self._lock:
+            if self._previous is None:
+                return
+            prev = self._entries.get(self._previous)
+            if prev is not None:
+                prev.retained = False
+            self._previous = None
+
+    # -- device-loop cleanup ---------------------------------------------
+
+    def reap(self) -> list[ServingScorer]:
+        """Retired generations whose last pinned batch has drained —
+        the caller (the device loop) releases their device rows. A
+        rollback-retained generation is device-released but its entry
+        (host/model state) survives; anything else is forgotten."""
+        out: list[ServingScorer] = []
+        with self._lock:
+            for gen in list(self._entries):
+                entry = self._entries[gen]
+                if gen == self._current or entry.pins > 0:
+                    continue
+                if not entry.released:
+                    entry.released = True
+                    out.append(entry.scorer)
+                if not entry.retained:
+                    del self._entries[gen]
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "generation": self._current,
+                "model_id": self._entries[self._current].model_id,
+                "retained_generation": self._previous,
+                "pins": {g: e.pins for g, e in self._entries.items()},
+            }

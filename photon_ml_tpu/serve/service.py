@@ -26,6 +26,37 @@ it); ``--max-serve-seconds``/``--stop-file`` drain the same way but
 exit ``0`` (a scheduled stop is a finished run); recognized terminal
 faults exit ``3`` with a ``PHOTON_ABORT`` line.
 
+**Zero-downtime hot-swap.** A ``swap`` request walks a state machine
+that never blocks the hot path:
+
+1. *load* — a loader thread reads + validates the candidate model dir
+   through ``utils/retry`` at the ``serve.model_load`` fault point; a
+   corrupt/truncated/unreadable candidate is REFUSED
+   (``ModelSwapRefusedError`` in the ``swap_result``) and the service
+   stays on its current generation;
+2. *canary* — the device loop replays the last N live request batches
+   (``--swap-canary-batches``) against the candidate, one replayed
+   batch interleaved per loop iteration so live latency stays bounded,
+   and gates the flip on trace_diff-style noise-aware score-diff
+   bounds: a row only violates when its relative diff exceeds
+   ``--swap-canary-threshold-pct`` AND its absolute diff clears
+   ``--swap-canary-min-delta``; rows where both scores sit under
+   ``--swap-canary-min-score`` are sub-noise and ignored;
+3. *flip* — the atomic generation flip (``serve.swap`` fault point):
+   new requests pin the new generation, in-flight batches complete
+   and reply on the old one, and the old generation's device rows are
+   released only after its last pinned batch drains;
+4. *probation* — for ``--swap-probation-seconds`` after the flip, a
+   p99 regression past the pre-flip watermark
+   (``--swap-p99-regression-pct`` + ``--swap-p99-min-delta-ms``) or
+   more than ``--swap-max-probation-sheds`` sheds trigger automatic
+   ROLLBACK to the retained previous generation (reported via
+   ``serve_swap{outcome=rolled_back}``, stats, and photon_status —
+   the ``swap_result`` reply already went out at flip time).
+
+A SIGTERM that races an in-flight swap refuses the swap during the
+drain and still exits 75 cleanly.
+
 Run as ``python -m photon_ml_tpu.serve.service`` (the module form
 ``photon_supervise --module`` relaunches) or via
 ``tools/photon_serve.py``. On readiness the process prints one
@@ -43,26 +74,87 @@ import socket
 import sys
 import threading
 import time
-from typing import Optional, Sequence
+from collections import deque
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.obs import trace
 from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from photon_ml_tpu.serve.batcher import MicroBatcher, ScoreWork
 from photon_ml_tpu.serve.protocol import (
     SERVE_PROTO,
+    ModelSwapRefusedError,
     encode,
     error_response,
     hello,
     parse_serve_endpoint,
     scores_response,
+    swap_response,
 )
-from photon_ml_tpu.serve.scoring import ServingScorer
+from photon_ml_tpu.serve.scoring import GenerationStore, ServingScorer
 from photon_ml_tpu.utils.faults import InjectedFault, fault_point
+from photon_ml_tpu.utils.retry import RetryPolicy, call_with_retry
 
 #: Completed-request horizon for the p50/p99/qps gauges.
 _LATENCY_WINDOW = 1024
 _QPS_HORIZON_SECS = 30.0
+
+#: Candidate-model load retries (the swap loader thread): transient
+#: I/O backs off and retries; a missing or corrupt candidate is
+#: permanent and refuses the swap immediately.
+_MODEL_LOAD_POLICY = RetryPolicy(max_attempts=4,
+                                 base_delay_seconds=0.05,
+                                 max_delay_seconds=1.0)
+
+
+def _candidate_fault_path(model_dir: str) -> str:
+    """A REGULAR FILE inside the candidate dir for the path-taking
+    fault modes (``corrupt``/``partial`` flip bytes in a file; the
+    model's artifacts live in nested coordinate dirs). Prefers the
+    first coefficient Avro so an armed corruption breaks the load —
+    or, failing that, the canary — deterministically."""
+    files = []
+    for root, dirs, names in os.walk(model_dir):
+        dirs.sort()
+        files.extend(os.path.join(root, n) for n in sorted(names))
+    avro = [p for p in files if p.endswith(".avro")]
+    if avro:
+        return avro[0]
+    return files[0] if files else model_dir
+
+
+class _SwapTask:
+    """One in-flight hot-swap walking load → canary → flip. Fields are
+    filled progressively; ``state`` is written LAST by whichever thread
+    advances it (loader thread: loading → loaded/load_failed; device
+    loop: everything after)."""
+
+    def __init__(self, request_id, send: Callable[[dict], bool],
+                 model_dir: str, model_id: str):
+        self.request_id = request_id
+        self.send = send
+        self.model_dir = model_dir
+        self.model_id = model_id
+        self.state = "loading"
+        self.candidate = None        # (model, index_maps) once loaded
+        self.error: Optional[BaseException] = None
+        self.scorer: Optional[ServingScorer] = None
+        self.replay: Optional[list] = None  # [(rows, base_scores)]
+        self.canary_idx = 0
+        self.checked_rows = 0
+        self.violations: list[str] = []
+        self.max_rel_pct = 0.0
+        self.max_abs = 0.0
+
+    def canary_report(self) -> Optional[dict]:
+        if self.replay is None:
+            return None
+        return {"batches": self.canary_idx,
+                "checked_rows": self.checked_rows,
+                "max_rel_pct": round(self.max_rel_pct, 6),
+                "max_abs": round(self.max_abs, 9),
+                "violations": list(self.violations)}
 
 
 class ServeService:
@@ -70,10 +162,20 @@ class ServeService:
 
     def __init__(self, scorer: ServingScorer, batcher: MicroBatcher,
                  listen: str, model_id: str = "game-model",
-                 registry: MetricsRegistry = REGISTRY, warn=None):
-        self.scorer = scorer
+                 registry: MetricsRegistry = REGISTRY, warn=None,
+                 loader: Optional[Callable] = None,
+                 make_scorer: Optional[Callable] = None,
+                 canary_batches: int = 8,
+                 canary_threshold_pct: float = 100.0,
+                 canary_min_delta: float = 1e-3,
+                 canary_min_score: float = 1e-3,
+                 probation_secs: float = 5.0,
+                 probation_p99_pct: float = 100.0,
+                 probation_p99_min_ms: float = 50.0,
+                 probation_max_sheds: int = 0):
+        self.gens = GenerationStore(scorer, model_id, registry=registry)
         self.batcher = batcher
-        self.model_id = model_id
+        self.model_id = model_id  # the BOOT model id; stats track gens
         self._registry = registry
         self._warn = warn or (lambda msg: None)
         self._lock = threading.Lock()
@@ -83,6 +185,26 @@ class ServeService:
         self._started_at = time.monotonic()
         self._latencies_ms: list[float] = []
         self._done_times: list[float] = []
+        # -- hot-swap state (device loop unless noted) -------------------
+        self._loader = loader          # model_dir -> (model, index_maps)
+        self._make_scorer = make_scorer  # (model, maps, gen) -> scorer
+        self._canary_threshold_pct = float(canary_threshold_pct)
+        self._canary_min_delta = float(canary_min_delta)
+        self._canary_min_score = float(canary_min_score)
+        self._probation_secs = float(probation_secs)
+        self._probation_p99_pct = float(probation_p99_pct)
+        self._probation_p99_min_ms = float(probation_p99_min_ms)
+        self._probation_max_sheds = int(probation_max_sheds)
+        self._replay: deque = deque(maxlen=max(int(canary_batches), 0))
+        self._swap_lock = threading.Lock()  # guards _swap hand-off
+        self._swap: Optional[_SwapTask] = None
+        self._probation: Optional[dict] = None
+        self.last_swap: Optional[dict] = None
+        # boot marker for the status plane: generation + model id ride
+        # a span (strings cannot ride the label-summed heartbeat totals)
+        with trace.span("serve.generation", generation=1,
+                        model_id=model_id):
+            pass
         scheme, addr = parse_serve_endpoint(listen)
         if scheme == "unix":
             try:
@@ -149,7 +271,10 @@ class ServeService:
                         reason="dead_client")
                     return False
 
-        send(hello(self.model_id, list(self.scorer.model.models)))
+        gen = self.gens.generation
+        send(hello(self.gens.model_id(gen),
+                   list(self.gens.scorer(gen).model.models),
+                   generation=gen))
         try:
             reader = conn.makefile("rb")
             for line in reader:
@@ -177,11 +302,19 @@ class ServeService:
                     send({"kind": "stats", "proto": SERVE_PROTO,
                           **self.stats()})
                 elif kind == "score":
+                    # pin at admission: the response is scored entirely
+                    # by the generation that was current RIGHT NOW,
+                    # even if a flip lands while the work is queued
+                    pin = self.gens.pin()
                     work = ScoreWork(rows=list(msg.get("rows") or []),
-                                     request_id=rid, reply=send)
+                                     request_id=rid, reply=send,
+                                     generation=pin)
                     shed = self.batcher.submit(work)
                     if shed is not None:
+                        self.gens.unpin(pin)
                         send(error_response(rid, f"shed:{shed}"))
+                elif kind == "swap":
+                    self._request_swap(msg, send)
                 else:
                     send(error_response(rid, f"unknown kind {kind!r}"))
         except OSError:
@@ -196,9 +329,18 @@ class ServeService:
 
     # -- the device loop ------------------------------------------------
 
+    @property
+    def scorer(self) -> ServingScorer:
+        """The CURRENT generation's scorer (live view)."""
+        return self.gens.scorer()
+
     def serve_loop(self, stop) -> Optional[str]:
         """Score until ``stop`` fires, then drain the queue and return
-        the stop reason. The caller owns the exit code."""
+        the stop reason. The caller owns the exit code. Each iteration
+        interleaves one hot-swap step (loader hand-off, one canary
+        batch, the flip, probation checks, retired-generation reaping)
+        between live batches — the swap machinery shares the device
+        thread, which is what bounds the flip's latency blackout."""
         reason: Optional[str] = None
         draining = False
         while True:
@@ -207,21 +349,34 @@ class ServeService:
                 if reason is not None:
                     draining = True
                     self.batcher.close()  # shed new work, keep the queue
+                    # a swap racing the drain is refused, never flipped
+                    self._abort_swap("service draining")
             batch = self.batcher.next_batch(
                 timeout=0.02 if draining else 0.2)
-            if not batch:
-                if draining:
-                    return reason
-                continue
-            self._score_batch(batch)
+            if batch:
+                self._score_batch(batch)
+            elif draining:
+                return reason
+            if not draining:
+                self._step_swap()
+                self._check_probation()
+            for scorer in self.gens.reap():
+                # the retired generation's last pinned batch drained:
+                # release its device rows (device loop = the only
+                # device-touching thread)
+                scorer.release_device()
 
     def _score_batch(self, batch: list[ScoreWork]) -> None:
         from photon_ml_tpu.cli import clean_abort_types
 
+        # the batcher never mixes generations in one batch, so the
+        # head's pin names the scorer for every work item (0 =
+        # untagged direct submission: score against current)
+        scorer = self.gens.scorer(batch[0].generation)
         try:
             fault_point("serve.batch", tag=str(len(batch)))
             all_rows = [r for w in batch for r in w.rows]
-            scores, uids = self.scorer.score_records(all_rows)
+            scores, uids = scorer.score_records(all_rows)
         except InjectedFault:
             raise  # process-scoped: the clean-abort contract applies
         except clean_abort_types():
@@ -232,7 +387,13 @@ class ServeService:
             for w in batch:
                 w.reply(error_response(w.request_id,
                                        f"{type(e).__name__}: {e}"))
+                if w.generation:
+                    self.gens.unpin(w.generation)
             return
+        # retain the batch for the shadow-scoring canary: the next
+        # swap candidate replays these rows against these base scores
+        if self._replay.maxlen:
+            self._replay.append((all_rows, np.asarray(scores)))
         # gauges BEFORE replies: a client that reads stats right after
         # its scores must see its own request reflected in the SLOs
         now = time.monotonic()
@@ -247,6 +408,8 @@ class ServeService:
             w.reply(scores_response(
                 w.request_id, scores[off:off + k],
                 uids[off:off + k] if uids is not None else None))
+            if w.generation:
+                self.gens.unpin(w.generation)
             off += k
 
     def _update_slo_gauges(self, now: float) -> None:
@@ -265,19 +428,256 @@ class ServeService:
         self._registry.gauge("serve_p99_ms").set(
             float(np.percentile(lat, 99)))
 
+    # -- the hot-swap state machine -------------------------------------
+
+    def _request_swap(self, msg: dict, send: Callable[[dict], bool]
+                      ) -> None:
+        """Reader-thread entry: validate, register the task, and hand
+        the load to a loader thread (never the hot path)."""
+        rid = msg.get("id")
+        model_dir = msg.get("model_dir")
+
+        def refuse(reason: str) -> None:
+            send(swap_response(rid, "refused", self.gens.generation,
+                               self.gens.model_id(), reason=reason))
+
+        if not model_dir:
+            refuse("swap request carries no model_dir")
+            return
+        if self._loader is None or self._make_scorer is None:
+            refuse("this service was started without swap support")
+            return
+        task = _SwapTask(rid, send, model_dir,
+                         msg.get("model_id")
+                         or os.path.basename(os.path.normpath(model_dir)))
+        with self._swap_lock:
+            if self._swap is not None:
+                # a busy refusal is not a swap OUTCOME: last_swap and
+                # the counters keep the in-flight swap's story
+                refuse("a swap is already in progress")
+                return
+            self._swap = task
+        t = threading.Thread(target=self._swap_load, args=(task,),
+                             name="serve-swap-load", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _swap_load(self, task: _SwapTask) -> None:
+        """Loader thread: disk I/O + validation only — no device work.
+        ``serve.model_load`` fires inside the retry wrapper, so
+        transient injected I/O errors retry exactly like real ones."""
+        def load():
+            fault_point("serve.model_load", tag=task.model_id,
+                        path=_candidate_fault_path(task.model_dir))
+            return self._loader(task.model_dir)
+
+        try:
+            task.candidate = call_with_retry(
+                load, "serve.model_load", policy=_MODEL_LOAD_POLICY,
+                warn=self._warn)
+            task.state = "loaded"
+        except Exception as e:
+            task.error = e
+            task.state = "load_failed"
+
+    def _step_swap(self) -> None:
+        """One swap step per device-loop iteration: resolve a finished
+        load, score ONE canary batch, or flip — live batches run
+        between steps, which bounds the swap's latency blackout."""
+        with self._swap_lock:  # the reader-thread hand-off point
+            task = self._swap
+        if task is None:
+            return
+        if task.state == "load_failed":
+            self._finish_swap(task, "refused",
+                              reason=f"model load failed: "
+                                     f"{type(task.error).__name__}: "
+                                     f"{task.error}")
+            return
+        if task.state == "loaded":
+            # candidate scorer construction touches the device → here
+            model, index_maps = task.candidate
+            try:
+                task.scorer = self._make_scorer(
+                    model, index_maps, self.gens.next_generation)
+            except Exception as e:
+                self._finish_swap(task, "refused",
+                                  reason=f"candidate scorer: "
+                                         f"{type(e).__name__}: {e}")
+                return
+            task.replay = list(self._replay)
+            task.state = "canary"
+        if task.state == "canary":
+            if task.canary_idx < len(task.replay):
+                rows, base = task.replay[task.canary_idx]
+                task.canary_idx += 1
+                try:
+                    cand, _ = task.scorer.score_records(rows)
+                except Exception as e:
+                    self._finish_swap(task, "refused",
+                                      reason=f"canary scoring failed: "
+                                             f"{type(e).__name__}: {e}")
+                    return
+                self._canary_check(task, base, cand)
+                if task.violations:
+                    self._finish_swap(
+                        task, "refused",
+                        reason=f"canary: {task.violations[0]}")
+                    return
+                if task.canary_idx < len(task.replay):
+                    return  # next canary batch next iteration
+            task.state = "flip"
+        if task.state == "flip":
+            self._flip(task)
+
+    def _canary_check(self, task: _SwapTask, base, cand) -> None:
+        """trace_diff's noise-aware verdict, applied per score: a row
+        only violates when its RELATIVE diff exceeds the threshold AND
+        its ABSOLUTE diff clears the floor; rows where both scores sit
+        under the sub-noise floor are ignored entirely."""
+        base = np.asarray(base, np.float64)
+        cand = np.asarray(cand, np.float64)
+        ref = np.maximum(np.abs(base), np.abs(cand))
+        live = ref >= self._canary_min_score
+        task.checked_rows += int(live.sum())
+        if not live.any():
+            return
+        abs_diff = np.abs(cand - base)[live]
+        rel_pct = 100.0 * abs_diff / ref[live]
+        task.max_rel_pct = max(task.max_rel_pct, float(rel_pct.max()))
+        task.max_abs = max(task.max_abs, float(abs_diff.max()))
+        bad = ((rel_pct > self._canary_threshold_pct)
+               & (abs_diff > self._canary_min_delta))
+        if bad.any():
+            task.violations.append(
+                f"{int(bad.sum())} row(s) beyond "
+                f"{self._canary_threshold_pct}% relative + "
+                f"{self._canary_min_delta} absolute score-diff bounds "
+                f"(max {float(rel_pct.max()):.3f}% / "
+                f"{float(abs_diff.max()):.6g})")
+
+    def _flip(self, task: _SwapTask) -> None:
+        """The atomic generation flip + probation arming."""
+        try:
+            fault_point("serve.swap",
+                        tag=str(self.gens.next_generation),
+                        path=_candidate_fault_path(task.model_dir))
+        except (InjectedFault, OSError) as e:
+            self._finish_swap(task, "refused",
+                              reason=f"flip: {type(e).__name__}: {e}")
+            return
+        baseline_p99 = float(
+            self._registry.gauge("serve_p99_ms").value() or 0.0)
+        from_gen = self.gens.generation
+        self.gens.activate(task.scorer, task.model_id)
+        self._probation = {
+            "until": time.monotonic() + self._probation_secs,
+            "from_generation": from_gen,
+            "p99_baseline_ms": baseline_p99,
+            "shed_baseline": self._registry.counter(
+                "serve_shed").total(),
+        }
+        self._finish_swap(task, "ok")
+
+    def _finish_swap(self, task: _SwapTask, outcome: str,
+                     reason: Optional[str] = None) -> None:
+        """Resolve the swap: reply, count, span, clear. Runs on the
+        device loop, so a refused candidate's device rows are released
+        here safely."""
+        if outcome == "refused" and task.scorer is not None:
+            task.scorer.release_device()
+        gen = self.gens.generation
+        # record BEFORE replying: a client that reads stats right
+        # after its swap_result must see the outcome in last_swap
+        self._record_swap(outcome, gen, reason=reason)
+        task.send(swap_response(task.request_id, outcome, gen,
+                                self.gens.model_id(), reason=reason,
+                                canary=task.canary_report()))
+        with self._swap_lock:
+            self._swap = None
+
+    def _abort_swap(self, reason: str) -> None:
+        """Refuse whatever swap is in flight (drain/shutdown path). The
+        loader thread may still be running; its task is orphaned and
+        nothing steps it again."""
+        with self._swap_lock:
+            task, self._swap = self._swap, None
+        if task is None:
+            return
+        if task.scorer is not None:
+            task.scorer.release_device()
+        gen = self.gens.generation
+        self._record_swap("refused", gen, reason=reason)
+        task.send(swap_response(task.request_id, "refused", gen,
+                                self.gens.model_id(), reason=reason,
+                                canary=task.canary_report()))
+
+    def _check_probation(self) -> None:
+        """Post-flip SLO watch: a p99 regression past the pre-flip
+        watermark (noise-aware: relative AND absolute, the trace_diff
+        rule again) or sheds beyond the budget roll back to the
+        retained previous generation; surviving the window releases
+        it."""
+        p = self._probation
+        if p is None:
+            return
+        sheds = (self._registry.counter("serve_shed").total()
+                 - p["shed_baseline"])
+        p99 = float(self._registry.gauge("serve_p99_ms").value() or 0.0)
+        base = p["p99_baseline_ms"]
+        regression: Optional[str] = None
+        if sheds > self._probation_max_sheds:
+            regression = (f"shed {int(sheds)} request(s) during "
+                          f"probation (budget "
+                          f"{self._probation_max_sheds})")
+        elif (base > 0.0
+              and p99 > base * (1.0 + self._probation_p99_pct / 100.0)
+              and p99 - base > self._probation_p99_min_ms):
+            regression = (f"p99 {p99:.1f}ms regressed past the "
+                          f"{base:.1f}ms pre-flip watermark")
+        if regression is not None:
+            self._probation = None
+            back = self.gens.rollback()
+            self._warn(f"hot-swap probation failed ({regression}): "
+                       f"rolled back to generation {back}")
+            self._record_swap("rolled_back", back, reason=regression)
+        elif time.monotonic() >= p["until"]:
+            self._probation = None
+            self.gens.release_previous()
+
+    def _record_swap(self, outcome: str, generation: int,
+                     reason: Optional[str] = None) -> None:
+        """Count + span + ``last_swap``: the counter rides heartbeat
+        totals (numeric), the span carries the strings photon_status
+        renders (model id, outcome, reason) — spans spill live every
+        heartbeat, so the status plane sees swaps while the service
+        runs."""
+        self._registry.counter("serve_swap").inc(outcome=outcome)
+        self.last_swap = {"outcome": outcome, "reason": reason or "",
+                          "generation": generation,
+                          "model_id": self.gens.model_id()}
+        with trace.span("serve.swap", outcome=outcome,
+                        generation=generation,
+                        model_id=self.gens.model_id(),
+                        reason=reason or ""):
+            pass
+
     # -- introspection / shutdown ---------------------------------------
 
     def stats(self) -> dict:
         g = self._registry.gauge
+        gen = self.gens.generation
         return {
-            "model_id": self.model_id,
+            "model_id": self.gens.model_id(gen),
+            "generation": gen,
+            "last_swap": self.last_swap,
             "endpoint": self.endpoint,
             "queue_depth": self.batcher.queue_depth(),
             "qps": g("serve_qps").value(),
             "p50_ms": g("serve_p50_ms").value(),
             "p99_ms": g("serve_p99_ms").value(),
             "uptime_secs": time.monotonic() - self._started_at,
-            **self.scorer.stats(),
+            **self.gens.scorer(gen).stats(),
         }
 
     def shutdown(self) -> None:
@@ -341,6 +741,35 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--min-bucket", type=int, default=8,
                    help="smallest power-of-two pad bucket (batches of "
                         "1..min-bucket rows share one compiled shape)")
+    p.add_argument("--swap-canary-batches", type=int, default=8,
+                   help="live request batches retained and replayed "
+                        "against a hot-swap candidate before the flip "
+                        "(0 disables the canary)")
+    p.add_argument("--swap-canary-threshold-pct", type=float,
+                   default=100.0,
+                   help="relative per-row score diff (percent) a "
+                        "canary row must exceed to violate the gate")
+    p.add_argument("--swap-canary-min-delta", type=float, default=1e-3,
+                   help="absolute score-diff floor a violation must "
+                        "ALSO clear (noise guard, trace_diff-style)")
+    p.add_argument("--swap-canary-min-score", type=float, default=1e-3,
+                   help="rows where |base| and |candidate| both sit "
+                        "under this are sub-noise: ignored entirely")
+    p.add_argument("--swap-probation-seconds", type=float, default=5.0,
+                   help="post-flip window during which an SLO "
+                        "regression rolls back to the previous "
+                        "generation")
+    p.add_argument("--swap-p99-regression-pct", type=float,
+                   default=100.0,
+                   help="relative p99 growth past the pre-flip "
+                        "watermark that (with the absolute floor) "
+                        "triggers rollback")
+    p.add_argument("--swap-p99-min-delta-ms", type=float, default=50.0,
+                   help="absolute p99 growth floor a probation "
+                        "regression must also clear")
+    p.add_argument("--swap-max-probation-sheds", type=int, default=0,
+                   help="sheds tolerated during probation before "
+                        "rollback")
     p.add_argument("--max-serve-seconds", type=float, default=None,
                    help="scheduled stop: drain and exit 0 (SIGTERM "
                         "drains and exits 75 instead — requeue me)")
@@ -412,16 +841,44 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             offheap_partitions=ns.offheap_indexmap_num_partitions)
         model, index_maps = load_scoring_model(
             ns.game_model_input_dir, index_maps, materialize=True)
-        scorer = ServingScorer(
-            model, section_keys, index_maps, id_types=id_types,
-            hbm_budget_bytes=int(ns.serve_hbm_budget_mb * (1 << 20)),
-            host_tier_entities=ns.host_tier_entities,
-            min_bucket=ns.min_bucket,
-            max_batch_rows=ns.max_batch_rows)
+
+        def build_scorer(model, index_maps, generation=1):
+            scorer = ServingScorer(
+                model, section_keys, index_maps, id_types=id_types,
+                hbm_budget_bytes=int(
+                    ns.serve_hbm_budget_mb * (1 << 20)),
+                host_tier_entities=ns.host_tier_entities,
+                min_bucket=ns.min_bucket,
+                max_batch_rows=ns.max_batch_rows)
+            scorer.generation = generation
+            return scorer
+
+        def load_candidate(model_dir):
+            # the same flag-driven index-map resolution + materialized
+            # load the boot model went through — candidate and boot
+            # generations are built by one code path
+            maps = resolve_index_maps(
+                section_keys, intercept_map,
+                feature_set_path=ns.feature_name_and_term_set_path,
+                offheap_dir=ns.offheap_indexmap_dir,
+                offheap_partitions=ns.offheap_indexmap_num_partitions)
+            return load_scoring_model(model_dir, maps, materialize=True)
+
+        scorer = build_scorer(model, index_maps)
         batcher = MicroBatcher(max_queue_rows=ns.max_queue_rows,
                                max_batch_rows=ns.max_batch_rows)
-        service = ServeService(scorer, batcher, ns.listen,
-                               model_id=ns.model_id, warn=logger.warn)
+        service = ServeService(
+            scorer, batcher, ns.listen, model_id=ns.model_id,
+            warn=logger.warn, loader=load_candidate,
+            make_scorer=build_scorer,
+            canary_batches=ns.swap_canary_batches,
+            canary_threshold_pct=ns.swap_canary_threshold_pct,
+            canary_min_delta=ns.swap_canary_min_delta,
+            canary_min_score=ns.swap_canary_min_score,
+            probation_secs=ns.swap_probation_seconds,
+            probation_p99_pct=ns.swap_p99_regression_pct,
+            probation_p99_min_ms=ns.swap_p99_min_delta_ms,
+            probation_max_sheds=ns.swap_max_probation_sheds)
         service.start()
         logger.info(f"serving {ns.model_id} on {service.endpoint} "
                     f"({len(scorer.stores)} tiered coordinate(s))")

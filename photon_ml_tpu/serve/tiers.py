@@ -43,6 +43,15 @@ from photon_ml_tpu.obs import compile as obs_compile
 from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from photon_ml_tpu.serve.batcher import bucket_rows
 
+#: Jitted gather/scatter shared by EVERY store instance — and therefore
+#: every model generation. They are pure functions of their operands,
+#: and the ``obs/compile`` signature includes function identity, so
+#: per-instance ``jax.jit`` objects would read as ``function_identity``
+#: retraces at the shared per-bucket sites on a hot-swap; sharing them
+#: keeps a warmed bucket warm across a generation flip.
+_GATHER_FN = jax.jit(lambda block, slots: block[slots])
+_PROMOTE_FN = jax.jit(lambda block, rows, slots: block.at[slots].set(rows))
+
 
 class TieredCoefficientStore:
     """Per-coordinate tiered store over one :class:`RandomEffectModel`.
@@ -79,11 +88,27 @@ class TieredCoefficientStore:
         self._slot_of: "OrderedDict[str, int]" = OrderedDict()  # LRU
         self._free = list(range(self.capacity))
         self._host: "OrderedDict[str, int]" = OrderedDict()  # id → row
-        self._gather_fn = jax.jit(lambda block, slots: block[slots])
-        self._promote_fn = jax.jit(
-            lambda block, rows, slots: block.at[slots].set(rows))
+        self._gather_fn = _GATHER_FN
+        self._promote_fn = _PROMOTE_FN
+        self.released = False
         registry.gauge("serve_tier_device_bytes").set(
             self.capacity * self.row_bytes, coordinate=coordinate_id)
+
+    # -- generation retirement ------------------------------------------
+
+    def release(self) -> None:
+        """Drop the device block and both LRU tiers (generation
+        retirement: called only after the last batch pinned to this
+        store's generation has drained). The store stays scoreable —
+        the next :meth:`lookup` re-warms from the model block exactly
+        like a cold start (rollback re-promotes on demand). The
+        ``serve_tier_device_bytes`` gauge is left to the ACTIVE
+        generation's store, whose constructor owns the label."""
+        self._device_block = None
+        self._slot_of.clear()
+        self._host.clear()
+        self._free = list(range(self.capacity))
+        self.released = True
 
     # -- internals ------------------------------------------------------
 
@@ -114,6 +139,10 @@ class TieredCoefficientStore:
 
     def _write_device(self, slots: list, rows: list) -> None:
         """Bucketed jitted scatter of promoted rows into the block."""
+        if self._device_block is None:  # re-warm after release()
+            self._device_block = jnp.zeros((self.capacity, self.dim),
+                                           jnp.float32)
+            self.released = False
         k = len(slots)
         bucket = bucket_rows(k, min_bucket=1)
         rows_np = np.asarray(rows, np.float32)
@@ -216,4 +245,5 @@ class TieredCoefficientStore:
             "host_entities": len(self._host),
             "host_capacity": self.host_capacity,
             "device_bytes": self.capacity * self.row_bytes,
+            "released": self.released,
         }

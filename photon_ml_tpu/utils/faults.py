@@ -174,6 +174,27 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
         "signal drains and exits preempted; kill scripts it dead for "
         "the supervisor-relaunch drill",
         modes=("raise", "io_error", "delay", "kill", "signal")),
+    "serve.model_load": FaultPointInfo(
+        "in the scoring-service swap loader thread, on the CANDIDATE "
+        "model dir before it is read (serve/service.py, off the hot "
+        "path, wrapped in utils/retry); tag = requested model id; path "
+        "= the candidate's first coefficient artifact. io_error retries "
+        "then refuses; corrupt flips bytes in the candidate so the load "
+        "(or the canary) refuses the swap — the service keeps serving "
+        "the current generation either way; slow stalls only the "
+        "loader thread, never live scoring",
+        modes=("io_error", "corrupt", "slow", "kill"), has_path=True),
+    "serve.swap": FaultPointInfo(
+        "in the scoring-service device loop, at the atomic generation "
+        "flip after the canary gate passes (serve/service.py); tag = "
+        "candidate generation; path = the candidate's first coefficient "
+        "artifact. io_error refuses the flip (the old generation keeps "
+        "serving); slow stalls the flip (SIGTERM during the stall still "
+        "drains and exits 75); kill dies mid-flip for the "
+        "supervisor-relaunch drill (the relaunch serves exactly one "
+        "consistent generation); corrupt flips candidate bytes on disk "
+        "AFTER load — the flip is insensitive, it serves from memory",
+        modes=("io_error", "corrupt", "slow", "kill"), has_path=True),
 }
 
 

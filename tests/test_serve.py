@@ -15,6 +15,14 @@ Layers:
   client, reporting SLOs through ``photon_status --json``, draining on
   SIGTERM (rc 75), and riding an injected SIGKILL through
   ``photon_supervise --module`` relaunch
+- hot-swap: ``GenerationStore`` pin/flip/rollback/reap accounting, the
+  batcher's never-mix-generations batch boundary, the in-process swap
+  state machine (canary refusal, probation rollback, concurrent
+  submits partitioning strictly by generation), and the subprocess
+  e2e — ``photon_serve swap`` under live clients with zero drops,
+  responses partitioning exactly into boot/candidate reference score
+  sets, a SIGTERM racing the swap draining to rc 75, and the
+  photonlint W702 trace-evidence gate over the run's real trace
 """
 
 from __future__ import annotations
@@ -50,10 +58,12 @@ from photon_ml_tpu.optimize.config import TaskType
 from photon_ml_tpu.serve.batcher import MicroBatcher, ScoreWork, bucket_rows
 from photon_ml_tpu.serve.protocol import ServeClient
 from photon_ml_tpu.serve.scoring import (
+    GenerationStore,
     ServingScorer,
     load_scoring_model,
     score_game_dataset,
 )
+from photon_ml_tpu.serve.service import ServeService
 from photon_ml_tpu.serve.tiers import TieredCoefficientStore
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -182,6 +192,18 @@ class TestMicroBatcher:
         assert reg.counter("serve_shed").value(reason="queue_full") == 1
         assert b.queue_depth() == 8  # the shed request left no residue
 
+    def test_next_batch_never_mixes_generations(self):
+        b = MicroBatcher(1000, 100, registry=MetricsRegistry())
+        for rid, gen in (("a", 1), ("b", 1), ("c", 2), ("d", 2),
+                         ("e", 3)):
+            b.submit(ScoreWork(rows=[{}], request_id=rid,
+                               reply=lambda _obj: None, generation=gen))
+        # the 100-row cap would fit all five — the generation boundary
+        # is what ends each batch (a batch scores on ONE scorer)
+        assert [w.request_id for w in b.next_batch(0.01)] == ["a", "b"]
+        assert [w.request_id for w in b.next_batch(0.01)] == ["c", "d"]
+        assert [w.request_id for w in b.next_batch(0.01)] == ["e"]
+
     def test_close_sheds_new_work_but_drains_queued(self):
         reg = MetricsRegistry()
         b = MicroBatcher(100, 100, registry=reg)
@@ -280,6 +302,21 @@ class TestTieredCoefficientStore:
         np.testing.assert_array_equal(got, block[list(users)])
         assert store.stats()["device_entities"] <= store.capacity
 
+    def test_release_then_rewarm_is_bit_exact(self):
+        """A retired generation's store releases its device rows; a
+        rollback re-warms the same store on demand with identical
+        bits."""
+        m = _tier_model(n=12, d=3)
+        block = np.asarray(m.coefficients, np.float32)
+        store = TieredCoefficientStore("c", m, hbm_budget_bytes=4 * 12,
+                                       registry=MetricsRegistry())
+        store.lookup(_ids(0, 1, 2, 3))
+        store.release()
+        assert store.stats()["released"]
+        got = store.lookup(_ids(0, 1, 2, 3))
+        np.testing.assert_array_equal(got, block[[0, 1, 2, 3]])
+        assert not store.stats()["released"]
+
     def test_host_tier_capacity_bounds_demotions(self):
         m = _tier_model(n=12, d=3)
         reg = MetricsRegistry()
@@ -377,6 +414,341 @@ class TestZeroRetraceWarmLoop:
                        if s.startswith("serve.")]
         assert any("serve.combine[b8]" == s for s in serve_sites)
         assert any("serve.combine[b16]" == s for s in serve_sites)
+
+
+# ---------------------------------------------------------------------------
+# GenerationStore: the atomic-flip half of the hot-swap contract
+# ---------------------------------------------------------------------------
+
+
+class _FakeScorer:
+    """Pin-accounting tests need only the attributes the store touches."""
+
+    def __init__(self):
+        self.generation = 0
+        self.device_released = 0
+
+    def release_device(self):
+        self.device_released += 1
+
+
+class TestGenerationStore:
+    def test_pin_at_admission_survives_the_flip(self):
+        reg = MetricsRegistry()
+        f1, f2 = _FakeScorer(), _FakeScorer()
+        store = GenerationStore(f1, "boot", registry=reg)
+        old_pin = store.pin()
+        assert old_pin == 1
+        assert store.activate(f2, "cand") == 2
+        # in-flight work keeps its old pin; new admissions get the new
+        assert store.pin() == 2
+        assert store.scorer(old_pin) is f1
+        assert store.scorer() is f2
+        assert store.model_id() == "cand"
+        assert f2.generation == 2
+        assert reg.gauge("serve_generation").value() == 2
+
+    def test_reap_waits_for_the_last_pin_and_keeps_the_retained(self):
+        f1, f2 = _FakeScorer(), _FakeScorer()
+        store = GenerationStore(f1, "boot", registry=MetricsRegistry())
+        pin = store.pin()
+        store.activate(f2, "cand")
+        assert store.reap() == []  # gen 1 still has a pinned batch
+        store.unpin(pin)
+        # drained: device rows go, but the entry survives as the
+        # rollback target until probation releases it
+        assert store.reap() == [f1]
+        assert store.stats()["retained_generation"] == 1
+        store.release_previous()
+        assert store.reap() == []  # already device-released
+        assert 1 not in store.stats()["pins"]
+
+    def test_rollback_reactivates_and_never_reuses_numbers(self):
+        reg = MetricsRegistry()
+        f1, f2, f3 = _FakeScorer(), _FakeScorer(), _FakeScorer()
+        store = GenerationStore(f1, "boot", registry=reg)
+        store.activate(f2, "cand")
+        assert store.rollback() == 1
+        assert store.generation == 1
+        assert store.model_id() == "boot"
+        assert reg.gauge("serve_generation").value() == 1
+        # the failed candidate retires un-retained and is forgotten
+        assert store.reap() == [f2]
+        assert 2 not in store.stats()["pins"]
+        # generation numbers are monotonic: the next flip is 3, not 2,
+        # so any relaunch audits to exactly one consistent generation
+        assert store.activate(f3, "cand2") == 3
+
+
+# ---------------------------------------------------------------------------
+# Hot-swap (in-process): swap machine, canary gate, probation rollback
+# ---------------------------------------------------------------------------
+
+
+class _StopFlag:
+    """serve_loop stop shim: fire by assigning ``reason``."""
+
+    def __init__(self):
+        self.reason = None
+
+    def should_stop(self):
+        return self.reason
+
+
+def _swap_parts(root: str, **service_kw):
+    """A live in-process service with swap support (loader +
+    make_scorer mirroring ``service.main``) plus boot/candidate model
+    dirs and their reference scorers."""
+    boot_dir = _build_model_dir(os.path.join(root, "boot"))
+    cand_dir = _build_model_dir(os.path.join(root, "cand"), seed=11)
+    reg = MetricsRegistry()
+
+    def loader(model_dir):
+        return load_scoring_model(model_dir, None, materialize=True)
+
+    def make_scorer(model, index_maps, generation=1):
+        scorer = ServingScorer(model, SECTIONS, index_maps,
+                               registry=reg)
+        scorer.generation = generation
+        return scorer
+
+    model, imaps = loader(boot_dir)
+    scorer = make_scorer(model, imaps)
+    batcher = MicroBatcher(100000, 64, registry=reg)
+    sock = os.path.join(root, "serve.sock")
+    service = ServeService(scorer, batcher, "unix:" + sock,
+                           model_id="boot-model", registry=reg,
+                           loader=loader, make_scorer=make_scorer,
+                           **service_kw)
+    return {"service": service, "registry": reg, "boot_dir": boot_dir,
+            "candidate_dir": cand_dir,
+            "ref_boot": make_scorer(model, imaps),
+            "ref_candidate": make_scorer(*loader(cand_dir))}
+
+
+def _run_service(parts):
+    """Start the accept + device loops; returns a stop() finalizer."""
+    service = parts["service"]
+    stop = _StopFlag()
+    service.start()
+    t = threading.Thread(target=service.serve_loop, args=(stop,),
+                         daemon=True)
+    t.start()
+
+    def finish():
+        stop.reason = "test done"
+        t.join(timeout=60)
+        service.shutdown()
+        assert not t.is_alive(), "serve_loop failed to drain"
+
+    return finish
+
+
+# the canary gate that lets a GENUINELY different model through (its
+# whole job is refusing score drift) vs the tight gate that must refuse
+_OPEN_GATE = dict(canary_threshold_pct=1e9, probation_secs=0.2)
+_TIGHT_GATE = dict(canary_threshold_pct=5.0, canary_min_delta=1e-4,
+                   probation_secs=0.2)
+
+
+class TestHotSwapInProcess:
+    def test_swap_flips_generation_and_scores_the_candidate(
+            self, tmp_path):
+        parts = _swap_parts(str(tmp_path), **_OPEN_GATE)
+        service = parts["service"]
+        records = _make_records()
+        ref_cand, _ = parts["ref_candidate"].score_records(records)
+        finish = _run_service(parts)
+        try:
+            with ServeClient(service.endpoint) as client:
+                assert client.generation == 1
+                client.score(records)
+                result = client.swap(parts["candidate_dir"],
+                                     model_id="retrained")
+                assert result["outcome"] == "ok", result
+                assert result["generation"] == 2
+                assert result["model_id"] == "retrained"
+                assert result["canary"]["violations"] == []
+                after = client.score(records)
+                np.testing.assert_array_equal(
+                    np.asarray(after["scores"]), ref_cand)
+                stats = client.stats()
+                assert stats["generation"] == 2
+                assert stats["last_swap"]["outcome"] == "ok"
+                # satellite: reconnect re-verifies the hello generation
+                client.reconnect()
+                assert client.generation == 2
+                assert client.generation_changed
+        finally:
+            finish()
+
+    def test_unreadable_candidate_refused_and_still_serving(
+            self, tmp_path):
+        parts = _swap_parts(str(tmp_path), **_OPEN_GATE)
+        service = parts["service"]
+        records = _make_records()
+        ref_boot, _ = parts["ref_boot"].score_records(records)
+        finish = _run_service(parts)
+        try:
+            with ServeClient(service.endpoint) as client:
+                result = client.swap(
+                    os.path.join(str(tmp_path), "no_such_model"))
+                assert result["outcome"] == "refused", result
+                assert result["error"].startswith(
+                    "ModelSwapRefusedError")
+                assert result["generation"] == 1
+                # the service never stopped answering, on the boot model
+                resp = client.score(records)
+                np.testing.assert_array_equal(
+                    np.asarray(resp["scores"]), ref_boot)
+                stats = client.stats()
+                assert stats["generation"] == 1
+                assert stats["last_swap"]["outcome"] == "refused"
+                client.reconnect()
+                assert not client.generation_changed
+        finally:
+            finish()
+
+    def test_canary_violation_never_flips(self, tmp_path):
+        parts = _swap_parts(str(tmp_path), **_TIGHT_GATE)
+        service = parts["service"]
+        records = _make_records()
+        ref_boot, _ = parts["ref_boot"].score_records(records)
+        finish = _run_service(parts)
+        try:
+            with ServeClient(service.endpoint) as client:
+                client.score(records)  # the replay the canary shadows
+                result = client.swap(parts["candidate_dir"])
+                assert result["outcome"] == "refused", result
+                assert "canary" in result["reason"]
+                assert len(result["canary"]["violations"]) >= 1
+                assert result["canary"]["checked_rows"] > 0
+                stats = client.stats()
+                assert stats["generation"] == 1
+                assert stats["last_swap"]["outcome"] == "refused"
+                resp = client.score(records)
+                np.testing.assert_array_equal(
+                    np.asarray(resp["scores"]), ref_boot)
+        finally:
+            finish()
+
+    def test_concurrent_submits_partition_strictly_by_generation(
+            self, tmp_path):
+        """Clients hammering the service across the flip: every single
+        response matches the boot reference exactly or the candidate
+        reference exactly — never a blend — and both sides occur."""
+        parts = _swap_parts(str(tmp_path), **_OPEN_GATE)
+        service = parts["service"]
+        records = _make_records()
+        ref_boot, _ = parts["ref_boot"].score_records(records)
+        ref_cand, _ = parts["ref_candidate"].score_records(records)
+        assert not np.array_equal(ref_boot, ref_cand)
+        finish = _run_service(parts)
+        swap_done = threading.Event()
+        responses: list[np.ndarray] = []
+        failures: list[str] = []
+
+        def client_loop():
+            out = []
+            try:
+                with ServeClient(service.endpoint) as client:
+                    tail = 2
+                    while tail:
+                        if swap_done.is_set():
+                            tail -= 1
+                        resp = client.score(records)
+                        if resp.get("kind") != "scores":
+                            failures.append(f"non-score reply: {resp}")
+                            return
+                        out.append(np.asarray(resp["scores"]))
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"client error: {e!r}")
+            responses.extend(out)  # list.extend is atomic under the GIL
+
+        threads = [threading.Thread(target=client_loop)
+                   for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # let every client land pre-flip scores
+            with ServeClient(service.endpoint) as client:
+                result = client.swap(parts["candidate_dir"])
+            assert result["outcome"] == "ok", result
+            swap_done.set()
+            for t in threads:
+                t.join(timeout=60)
+        finally:
+            swap_done.set()
+            finish()
+        assert not failures, failures[:5]
+        boot_n = cand_n = 0
+        for scores in responses:
+            if np.array_equal(scores, ref_boot):
+                boot_n += 1
+            elif np.array_equal(scores, ref_cand):
+                cand_n += 1
+            else:
+                raise AssertionError(
+                    "a response mixes generations: matches neither "
+                    "reference bit-exactly")
+        assert boot_n > 0 and cand_n > 0, (boot_n, cand_n)
+
+
+class TestProbationRollback:
+    """_check_probation drives gens.rollback — exercised directly on a
+    non-looping service so each verdict is deterministic."""
+
+    def _flipped_service(self, tmp_path, **kw):
+        parts = _swap_parts(str(tmp_path), **kw)
+        service, reg = parts["service"], parts["registry"]
+        service.gens.activate(parts["ref_candidate"], "cand")
+        service._probation = {
+            "until": time.monotonic() + 300.0,
+            "from_generation": 1,
+            "p99_baseline_ms": 5.0,
+            "shed_baseline": reg.counter("serve_shed").total(),
+        }
+        return parts
+
+    def test_p99_regression_rolls_back(self, tmp_path):
+        parts = self._flipped_service(tmp_path, probation_secs=300.0,
+                                      probation_p99_pct=50.0,
+                                      probation_p99_min_ms=1.0)
+        service, reg = parts["service"], parts["registry"]
+        try:
+            reg.gauge("serve_p99_ms").set(100.0)  # 20x the watermark
+            service._check_probation()
+            assert service.gens.generation == 1
+            assert service.last_swap["outcome"] == "rolled_back"
+            assert "p99" in service.last_swap["reason"]
+            assert reg.counter("serve_swap").value(
+                outcome="rolled_back") == 1
+        finally:
+            service.shutdown()
+
+    def test_shed_budget_rolls_back(self, tmp_path):
+        parts = self._flipped_service(tmp_path, probation_max_sheds=0)
+        service, reg = parts["service"], parts["registry"]
+        try:
+            reg.counter("serve_shed").inc(reason="queue_full")
+            service._check_probation()
+            assert service.gens.generation == 1
+            assert "shed" in service.last_swap["reason"]
+        finally:
+            service.shutdown()
+
+    def test_quiet_probation_releases_the_previous_generation(
+            self, tmp_path):
+        parts = self._flipped_service(tmp_path)
+        service = parts["service"]
+        try:
+            service._probation["until"] = time.monotonic() - 1.0
+            service._check_probation()
+            assert service.gens.generation == 2
+            assert service._probation is None
+            assert service.gens.stats()["retained_generation"] is None
+        finally:
+            service.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -626,3 +998,200 @@ class TestServeEndToEnd:
         assert "PHOTON_SUPERVISE_OK" in out
         restarts = [w for w in out.split() if w.startswith("restarts=")]
         assert restarts and int(restarts[-1].split("=")[1]) >= 1, out
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: zero-downtime hot-swap
+# ---------------------------------------------------------------------------
+
+
+# a swap that must COMPLETE opens the canary gate (its whole job is
+# refusing genuinely-different scores); short probation keeps tests fast
+_SWAP_FLAGS = ["--swap-canary-threshold-pct", "1e9",
+               "--swap-probation-seconds", "0.3"]
+
+
+@pytest.fixture(scope="module")
+def swap_e2e(e2e_fixture, tmp_path_factory):
+    """A retrained candidate model dir plus its batch-driver reference
+    scores (uid → float64) over the same request rows."""
+    root = str(tmp_path_factory.mktemp("serve_swap_e2e"))
+    candidate_dir = _build_model_dir(root, seed=11)
+    out = os.path.join(root, "scores_out")
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.game_scoring_driver",
+         "--input-data-dirs", os.path.join(e2e_fixture["root"],
+                                           "in.avro"),
+         "--game-model-input-dir", candidate_dir,
+         "--output-dir", out,
+         "--feature-shard-id-to-feature-section-keys-map", SECTIONS_FLAG,
+         "--random-effect-id-set", "userId"],
+        env=_subprocess_env(), cwd=_REPO, text=True,
+        capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    by_uid = {}
+    for part in glob.glob(os.path.join(out, "scores", "*.avro")):
+        for rec in load_scored_items(part):
+            by_uid[rec["uid"]] = rec["predictionScore"]
+    assert len(by_uid) == len(e2e_fixture["records"])
+    return {"candidate_dir": candidate_dir, "candidate_scores": by_uid}
+
+
+class TestHotSwapEndToEnd:
+    def test_swap_under_live_clients_zero_drops(self, e2e_fixture,
+                                                swap_e2e, tmp_path):
+        """The acceptance scenario: ``photon_serve swap`` lands while
+        concurrent clients score — zero drops or sheds, every response
+        bit-exact against exactly one of the two batch-driver
+        references, and the photonlint W702 trace-evidence gate stays
+        green over the run's REAL trace (zero warm retraces across the
+        flip)."""
+        records = e2e_fixture["records"]
+        boot_ref = e2e_fixture["batch_scores"]
+        cand_ref = swap_e2e["candidate_scores"]
+        trace = str(tmp_path / "trace")
+        sock = str(tmp_path / "serve.sock")
+        proc, endpoint = _spawn_serve(_serve_args(
+            e2e_fixture["model_dir"], "unix:" + sock, trace,
+            extra=["--device-telemetry", *_SWAP_FLAGS]))
+        swap_done = threading.Event()
+        responses: list[dict] = []
+        failures: list[str] = []
+
+        def client_loop():
+            out = []
+            try:
+                with ServeClient(endpoint) as client:
+                    tail = 2  # keep scoring past the flip
+                    while tail:
+                        if swap_done.is_set():
+                            tail -= 1
+                        resp = client.score(records)
+                        if resp.get("kind") != "scores":
+                            failures.append(f"dropped/shed: {resp}")
+                            return
+                        out.append(dict(zip(resp["uids"],
+                                            resp["scores"])))
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"client error: {e!r}")
+            responses.extend(out)
+
+        threads = [threading.Thread(target=client_loop)
+                   for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.5)  # warm pre-flip traffic (and the replay)
+            # the operator-facing verb, as a real subprocess
+            swap = subprocess.run(
+                [sys.executable, os.path.join(_TOOLS,
+                                              "photon_serve.py"),
+                 "swap", "--endpoint", endpoint,
+                 "--model-dir", swap_e2e["candidate_dir"],
+                 "--model-id", "retrained"],
+                env=_subprocess_env(), cwd=_REPO, text=True,
+                capture_output=True, timeout=120)
+            swap_done.set()
+            assert swap.returncode == 0, swap.stdout + swap.stderr
+            result = json.loads(swap.stdout)
+            assert result["outcome"] == "ok"
+            assert result["generation"] == 2
+            assert result["model_id"] == "retrained"
+            for t in threads:
+                t.join(timeout=60)
+            assert not failures, failures[:5]
+            boot_n = cand_n = 0
+            for scored in responses:
+                if all(boot_ref[u] == s for u, s in scored.items()):
+                    boot_n += 1
+                elif all(cand_ref[u] == s for u, s in scored.items()):
+                    cand_n += 1
+                else:
+                    raise AssertionError(
+                        "a response matches neither the boot nor the "
+                        "candidate batch reference bit-exactly")
+            assert boot_n > 0 and cand_n > 0, (boot_n, cand_n)
+            with ServeClient(endpoint) as client:
+                assert client.generation == 2
+                stats = client.stats()
+            assert stats["generation"] == 2
+            assert stats["model_id"] == "retrained"
+            assert stats["last_swap"]["outcome"] == "ok"
+        finally:
+            swap_done.set()
+            proc.terminate()
+            try:
+                rc = proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+            _, err = proc.communicate()
+        assert rc == PREEMPTED_EXIT, err[-2000:]
+        assert "Traceback (most recent call last)" not in err
+        # -- zero sheds, zero retraces across the flip -----------------
+        shed = retraces = 0
+        with open(os.path.join(trace, "metrics.jsonl")) as fh:
+            for line in fh:
+                if line.strip():
+                    totals = json.loads(line).get("metric_totals") or {}
+                    shed = totals.get("serve_shed", shed)
+        assert shed == 0, f"swap shed {shed} request(s)"
+        with open(os.path.join(trace, "spans.jsonl")) as fh:
+            for line in fh:
+                if line.strip():
+                    retraces += (json.loads(line).get("name")
+                                 == "xla.retrace")
+        assert retraces == 0, f"the flip retraced {retraces}x"
+        # -- satellite: photonlint W702 CI wiring over this real trace -
+        lint = subprocess.run(
+            [sys.executable, os.path.join(_TOOLS, "photonlint.py"),
+             "--trace-evidence", trace, "photon_ml_tpu"],
+            env=_subprocess_env(), cwd=_REPO, text=True,
+            capture_output=True, timeout=300)
+        assert lint.returncode == 0, lint.stdout + lint.stderr
+        assert "W702" not in lint.stdout, lint.stdout
+
+    def test_sigterm_racing_a_swap_drains_preempted(self, e2e_fixture,
+                                                    swap_e2e,
+                                                    tmp_path):
+        """SIGTERM lands while the candidate load crawls (injected
+        ``serve.model_load=slow``): the swap is refused on drain —
+        never half-flipped — and the service exits rc 75 with the
+        preemption marker."""
+        records = e2e_fixture["records"]
+        trace = str(tmp_path / "trace")
+        sock = str(tmp_path / "serve.sock")
+        proc, endpoint = _spawn_serve(
+            _serve_args(e2e_fixture["model_dir"], "unix:" + sock,
+                        trace, extra=_SWAP_FLAGS),
+            extra_env={"PHOTON_FAULTS": "serve.model_load=slow:1:3"})
+        swap_result: dict = {}
+        try:
+            resp = _score_retry(endpoint, records, deadline_secs=60)
+            assert resp["kind"] == "scores"
+
+            def do_swap():
+                try:
+                    with ServeClient(endpoint) as client:
+                        swap_result.update(client.swap(
+                            swap_e2e["candidate_dir"]))
+                except (ConnectionError, OSError) as e:
+                    swap_result["exception"] = repr(e)
+
+            t = threading.Thread(target=do_swap)
+            t.start()
+            time.sleep(0.7)  # the loader is mid-sleep; the swap is live
+            proc.terminate()
+            t.join(timeout=60)
+        finally:
+            try:
+                rc = proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+            _, err = proc.communicate()
+        assert rc == PREEMPTED_EXIT, err[-2000:]
+        assert "PHOTON_PREEMPTED" in err
+        assert "Traceback (most recent call last)" not in err
+        assert swap_result.get("outcome") == "refused", swap_result
+        assert "drain" in swap_result.get("reason", ""), swap_result

@@ -332,6 +332,40 @@ def build_cells(smoke: bool) -> list[CellDef]:
                   "relaunched (kill budget claimed across "
                   "incarnations), scores bit-exact after relaunch, "
                   "stop-file drains the supervisor to done"),
+        # --- hot-swap: the swap state machine under fault; invariants
+        # --- are "a refused swap leaves the CURRENT generation serving
+        # --- bit-exact" and "a completed swap serves the candidate
+        # --- bit-exact vs the shared batch core" -----------------------
+        cell("serve.model_load", "io_error",
+             "serve.model_load=io_error:1", "ok", serve=True,
+             variant="swap_retry",
+             note="one transient I/O error in the swap loader thread: "
+                  "retried (utils/retry), the swap completes, the new "
+                  "generation scores bit-exact"),
+        cell("serve.model_load", "corrupt",
+             "serve.model_load=corrupt:1", "ok", serve=True,
+             variant="swap_refused",
+             note="candidate coefficient bytes flipped on disk before "
+                  "the load: the swap is REFUSED (load failure or "
+                  "canary violation) and the service keeps serving "
+                  "generation 1 bit-exact"),
+        cell("serve.model_load", "slow", "serve.model_load=slow:1:3",
+             "preempted", serve=True, variant="swap_drain_race",
+             note="SIGTERM lands while the loader thread is stalled: "
+                  "the drain refuses the in-flight swap and the "
+                  "service still exits 75 cleanly"),
+        cell("serve.swap", "io_error", "serve.swap=io_error:1", "ok",
+             serve=True, variant="swap_flip_refused",
+             note="I/O error at the atomic flip itself: the flip is "
+                  "refused, the old generation keeps serving "
+                  "bit-exact, and a RE-REQUESTED swap (budget spent) "
+                  "completes"),
+        cell("serve.swap", "kill",
+             f"serve.swap=kill:1:{KILL_EXIT}", "killed", serve=True,
+             note="killed mid-flip under photon_supervise --module: "
+                  "the relaunch serves exactly one consistent "
+                  "generation (the boot model) bit-exact; stop-file "
+                  "drains the supervisor to done"),
     ]
     if smoke:
         cells = [c for c in cells if c["smoke"]]
@@ -668,7 +702,10 @@ def build_serve_fixture(workdir: str) -> dict:
     """Tiny GAME model on disk + request rows + the reference scores
     computed HERE through the shared batch scoring core
     (`serve.scoring`): the anchor every serve cell's bit-exactness
-    check compares against."""
+    check compares against. Also saves a second, "retrained" model
+    (same structure, different coefficients) as the hot-swap
+    candidate, with its own reference scores — the post-flip
+    bit-exactness anchor."""
     if workdir in _SERVE_FIXTURE:
         return _SERVE_FIXTURE[workdir]
     import jax.numpy as jnp
@@ -711,6 +748,22 @@ def build_serve_fixture(workdir: str) -> dict:
     save_game_model(GameModel({"fixed": fixed, "per-user": re_model}),
                     model_dir, imaps, entity_vocabs={"userId": vocab})
 
+    # the "retrained" candidate: identical structure/vocab, freshly
+    # drawn coefficients (scores genuinely differ from the boot model)
+    fixed_b = FixedEffectModel(GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=len(imaps["global"])),
+                                 jnp.float32)),
+        TaskType.LINEAR_REGRESSION), "global")
+    re_model_b = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n_users),
+        coefficients=jnp.asarray(
+            rng.normal(size=(n_users, len(imaps["user"]))), jnp.float32))
+    candidate_dir = os.path.join(workdir, "serve_model_retrained")
+    save_game_model(
+        GameModel({"fixed": fixed_b, "per-user": re_model_b}),
+        candidate_dir, imaps, entity_vocabs={"userId": vocab})
+
     records = []
     for i in range(24):
         u = int(rng.integers(0, n_users))
@@ -732,7 +785,13 @@ def build_serve_fixture(workdir: str) -> dict:
         records, sections, loaded_maps, id_types=("userId",),
         response_required=False)
     ref = np.asarray(score_game_dataset(model, data), np.float64)
-    fix = {"model_dir": model_dir, "records": records, "ref": ref}
+    model_b, maps_b = load_scoring_model(candidate_dir, None)
+    data_b = game_dataset_from_records(
+        records, sections, maps_b, id_types=("userId",),
+        response_required=False)
+    ref_b = np.asarray(score_game_dataset(model_b, data_b), np.float64)
+    fix = {"model_dir": model_dir, "records": records, "ref": ref,
+           "candidate_dir": candidate_dir, "ref_candidate": ref_b}
     _SERVE_FIXTURE[workdir] = fix
     return fix
 
@@ -836,6 +895,12 @@ def _run_serve_cell(c: CellDef, workdir: str) -> dict:
     records = fix["records"]
     expected = c["expected"]
 
+    if c["point"] in ("serve.model_load", "serve.swap"):
+        if expected == "killed":
+            return _run_serve_swap_kill_cell(c, name, fix, cell_dir,
+                                             trace, sock, failures, t0)
+        return _run_serve_swap_cell(c, name, fix, cell_dir, trace, sock,
+                                    failures, t0)
     if expected == "killed":
         return _run_serve_kill_cell(c, name, fix, cell_dir, trace, sock,
                                     failures, t0)
@@ -973,6 +1038,359 @@ def _run_serve_kill_cell(c: CellDef, name: str, fix: dict, cell_dir: str,
     _check_trace_survives(trace, failures)
     return {"cell": name, "spec": c["spec"], "expected": c["expected"],
             "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+#: Hot-swap cells where the swap must COMPLETE open the canary gate —
+#: the fixture candidate is a genuinely retrained model, so its scores
+#: differ from the boot model's by design. Probation is kept short so
+#: cells finish fast.
+_SWAP_OPEN_GATE = ["--swap-canary-threshold-pct", "1e9",
+                   "--swap-probation-seconds", "0.2"]
+
+#: Refusal cells pair the fault with a TIGHT gate instead: a corrupt
+#: candidate that still decodes to garbage coefficients must trip the
+#: score-diff canary even when the load itself survives.
+_SWAP_TIGHT_GATE = ["--swap-canary-threshold-pct", "5",
+                    "--swap-canary-min-delta", "1e-4",
+                    "--swap-probation-seconds", "0.2"]
+
+
+def _serve_swap_once(endpoint: str, model_dir: str,
+                     model_id: str = "retrained",
+                     timeout: float = 120.0) -> dict:
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    with ServeClient(endpoint, timeout=timeout) as client:
+        return client.swap(model_dir, model_id=model_id)
+
+
+def _serve_stats_once(endpoint: str) -> dict:
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    with ServeClient(endpoint) as client:
+        return client.stats()
+
+
+def _run_serve_swap_cell(c: CellDef, name: str, fix: dict,
+                         cell_dir: str, trace: str, sock: str,
+                         failures: list[str], t0: float) -> dict:
+    """Hot-swap (point, mode) cells: the fault fires somewhere in the
+    load → canary → flip machine; the invariant is always that score
+    traffic lands bit-exact on exactly ONE model — the boot model when
+    the swap refuses, the candidate when it completes."""
+    import threading
+
+    import numpy as np
+
+    # `corrupt` mutates the candidate ON DISK: every swap cell works
+    # on a private copy so the shared fixture stays pristine
+    candidate = os.path.join(cell_dir, "candidate_model")
+    shutil.copytree(fix["candidate_dir"], candidate)
+    env = {"PHOTON_FAULTS": c["spec"],
+           "PHOTON_FAULTS_STATE_DIR": os.path.join(cell_dir,
+                                                   "fault_state"),
+           "PHOTON_FAULTS_SEED": "42"}
+    variant = c["variant"]
+    gate = (_SWAP_TIGHT_GATE if variant == "swap_refused"
+            else _SWAP_OPEN_GATE)
+    proc, endpoint = _spawn_serve(
+        serve_args(fix["model_dir"], "unix:" + sock, trace, extra=gate),
+        extra_env=env)
+    rc = None
+    outcome = "?"
+    try:
+        first = _serve_score_once(endpoint, fix["records"])
+        if not np.array_equal(np.asarray(first["scores"], np.float64),
+                              fix["ref"]):
+            failures.append("pre-swap scores NOT bit-exact vs the "
+                            "shared batch scoring core")
+        if variant == "swap_drain_race":
+            # the loader thread is stalled on the injected slow fault;
+            # a SIGTERM during the stall must refuse the in-flight
+            # swap and still drain to the documented exit
+            result: dict = {}
+
+            def _swap_in_background() -> None:
+                try:
+                    result["resp"] = _serve_swap_once(endpoint,
+                                                      candidate)
+                except (ConnectionError, OSError) as e:
+                    result["error"] = e
+
+            th = threading.Thread(target=_swap_in_background,
+                                  daemon=True)
+            th.start()
+            time.sleep(0.8)  # well inside the 3 s injected stall
+            proc.terminate()
+            rc = proc.wait(timeout=90)
+            th.join(timeout=30)
+            resp = result.get("resp")
+            if not isinstance(resp, dict) \
+                    or resp.get("outcome") != "refused":
+                failures.append(f"a swap racing the drain must resolve "
+                                f"refused, got {result!r}")
+            if rc != PREEMPTED_EXIT:
+                failures.append(f"expected drain to "
+                                f"rc={PREEMPTED_EXIT}, got rc={rc}")
+            outcome = "preempted(swap refused on drain)"
+        elif variant == "swap_refused":
+            resp = _serve_swap_once(endpoint, candidate)
+            if resp.get("outcome") != "refused":
+                failures.append(f"corrupt candidate must be refused, "
+                                f"got {str(resp)[:300]}")
+            elif "ModelSwapRefusedError" not in resp.get("error", ""):
+                failures.append(f"refusal carries no typed error: "
+                                f"{str(resp)[:300]}")
+            stats = _serve_stats_once(endpoint)
+            if stats.get("generation") != 1:
+                failures.append(f"refused swap must leave generation 1 "
+                                f"current, got "
+                                f"{stats.get('generation')!r}")
+            after = _serve_score_once(endpoint, fix["records"])
+            if not np.array_equal(
+                    np.asarray(after["scores"], np.float64),
+                    fix["ref"]):
+                failures.append("scores after the refused swap NOT "
+                                "bit-exact vs the boot model")
+            proc.terminate()
+            rc = proc.wait(timeout=90)
+            if rc != PREEMPTED_EXIT:
+                failures.append(f"SIGTERM drain must exit "
+                                f"rc={PREEMPTED_EXIT}, got rc={rc}")
+            outcome = f"refused({resp.get('reason', '')[:40]}...)"
+        else:  # swap_retry / swap_flip_refused: the swap COMPLETES
+            resp = _serve_swap_once(endpoint, candidate)
+            if variant == "swap_flip_refused":
+                # the injected flip fault refuses the FIRST attempt;
+                # the re-request (budget spent) must complete
+                if resp.get("outcome") != "refused" \
+                        or "flip" not in resp.get("reason", ""):
+                    failures.append(f"flip fault must refuse the first "
+                                    f"swap, got {str(resp)[:300]}")
+                mid = _serve_score_once(endpoint, fix["records"])
+                if not np.array_equal(
+                        np.asarray(mid["scores"], np.float64),
+                        fix["ref"]):
+                    failures.append("scores after the refused flip NOT "
+                                    "bit-exact vs the boot model")
+                resp = _serve_swap_once(endpoint, candidate)
+            if resp.get("outcome") != "ok" \
+                    or resp.get("generation") != 2:
+                failures.append(f"swap must complete onto generation "
+                                f"2, got {str(resp)[:300]}")
+            after = _serve_score_once(endpoint, fix["records"])
+            if not np.array_equal(
+                    np.asarray(after["scores"], np.float64),
+                    fix["ref_candidate"]):
+                failures.append("post-swap scores NOT bit-exact vs the "
+                                "candidate's batch reference")
+            proc.terminate()
+            rc = proc.wait(timeout=90)
+            if rc != PREEMPTED_EXIT:
+                failures.append(f"SIGTERM drain must exit "
+                                f"rc={PREEMPTED_EXIT}, got rc={rc}")
+            outcome = ("swapped(load retried)"
+                       if variant == "swap_retry"
+                       else "refused-then-swapped")
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"serve swap cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        _, err = proc.communicate()
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    if rc == PREEMPTED_EXIT and "PHOTON_PREEMPTED" not in err:
+        failures.append(f"rc={PREEMPTED_EXIT} without a "
+                        f"PHOTON_PREEMPTED line")
+    if variant == "swap_retry" and not failures:
+        retried = _serve_metric_total(trace, "retries")
+        if not retried:
+            failures.append(f"expected retries >= 1 in the final "
+                            f"metric totals, found {retried!r}")
+    _check_trace_survives(trace, failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def _run_serve_swap_kill_cell(c: CellDef, name: str, fix: dict,
+                              cell_dir: str, trace: str, sock: str,
+                              failures: list[str], t0: float) -> dict:
+    """Killed mid-flip under photon_supervise: the injected kill fires
+    at the atomic-flip fault point, the supervisor relaunches, and the
+    relaunch must serve exactly ONE consistent generation — the boot
+    model, bit-exact, reporting generation 1."""
+    import numpy as np
+
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    candidate = os.path.join(cell_dir, "candidate_model")
+    shutil.copytree(fix["candidate_dir"], candidate)
+    stop_file = os.path.join(cell_dir, "stop")
+    args = serve_args(fix["model_dir"], "unix:" + sock, trace,
+                      extra=[*_SWAP_OPEN_GATE,
+                             "--stop-file", stop_file])
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    env.update({
+        "PHOTON_FAULTS": c["spec"],
+        "PHOTON_FAULTS_STATE_DIR": os.path.join(cell_dir,
+                                                "fault_state"),
+        "PHOTON_FAULTS_SEED": "42",
+    })
+    sup = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "photon_supervise.py"),
+         "--module", "photon_ml_tpu.serve.service",
+         "--backoff-base", "0.2", "--run-dir", trace, "--", *args],
+        env=env, cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    rc = None
+    outcome = "?"
+    try:
+        resp = _serve_score_retry("unix:" + sock, fix["records"],
+                                  deadline_secs=150)
+        if not np.array_equal(np.asarray(resp["scores"], np.float64),
+                              fix["ref"]):
+            failures.append("pre-swap scores NOT bit-exact")
+        try:
+            swap_resp = _serve_swap_once("unix:" + sock, candidate)
+            # a reply at all means the kill never fired at the flip
+            failures.append(f"injected kill at the flip never fired: "
+                            f"swap resolved {str(swap_resp)[:200]}")
+        except (ConnectionError, OSError):
+            pass  # the process died mid-flip, as drilled
+        # ride the relaunch: the second incarnation must come back on
+        # the BOOT model — one consistent generation, bit-exact
+        deadline = time.monotonic() + 150
+        relaunch = None
+        while time.monotonic() < deadline:
+            try:
+                with ServeClient("unix:" + sock) as client:
+                    relaunch = (client.generation,
+                                client.score(fix["records"]))
+                break
+            except (ConnectionError, OSError):
+                time.sleep(0.25)
+        if relaunch is None:
+            failures.append("service never relaunched after the "
+                            "mid-flip kill")
+        else:
+            gen, resp = relaunch
+            if gen != 1:
+                failures.append(f"relaunch must serve generation 1 "
+                                f"(the boot model), got {gen!r}")
+            if not np.array_equal(
+                    np.asarray(resp["scores"], np.float64),
+                    fix["ref"]):
+                failures.append("post-relaunch scores NOT bit-exact vs "
+                                "the boot model — the kill left a "
+                                "mixed generation behind")
+        with open(stop_file, "w") as fh:
+            fh.write("chaos cell done\n")
+        rc = sup.wait(timeout=120)
+        outcome = "killed mid-flip+relaunched(gen 1)"
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"serve swap kill cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+        out, err = sup.communicate()
+    if rc != 0:
+        failures.append(f"supervisor must finish rc=0 after the "
+                        f"stop-file drain, got rc={rc}:\n{err[-1500:]}")
+    elif "PHOTON_SUPERVISE_OK" not in out:
+        failures.append(f"no PHOTON_SUPERVISE_OK line: {out[-400:]!r}")
+    else:
+        m = [w for w in out.split() if w.startswith("restarts=")]
+        restarts = int(m[-1].split("=", 1)[1]) if m else 0
+        if restarts < 1:
+            failures.append("supervisor reports restarts=0 — the "
+                            "injected kill never cost an incarnation")
+        else:
+            outcome += f"(restarts={restarts})"
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    _check_trace_survives(trace, failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def run_serve_canary_violation_scenario(workdir: str) -> dict:
+    """No injection: a hot-swap to a genuinely different model under a
+    TIGHT canary gate. The shadow-scoring canary must refuse the flip
+    — the service never leaves generation 1, and keeps scoring the
+    boot model bit-exact."""
+    import numpy as np
+
+    fix = build_serve_fixture(workdir)
+    cell_dir = os.path.join(workdir, "cells",
+                            "scenario_serve_canary_violation")
+    shutil.rmtree(cell_dir, ignore_errors=True)
+    os.makedirs(cell_dir)
+    trace = os.path.join(cell_dir, "trace")
+    sock = os.path.join(cell_dir, "serve.sock")
+    failures: list[str] = []
+    t0 = time.monotonic()
+    proc, endpoint = _spawn_serve(
+        serve_args(fix["model_dir"], "unix:" + sock, trace,
+                   extra=_SWAP_TIGHT_GATE))
+    rc = None
+    reason = ""
+    try:
+        first = _serve_score_once(endpoint, fix["records"])
+        if not np.array_equal(np.asarray(first["scores"], np.float64),
+                              fix["ref"]):
+            failures.append("pre-swap scores NOT bit-exact")
+        resp = _serve_swap_once(endpoint, fix["candidate_dir"])
+        reason = resp.get("reason", "")
+        if resp.get("outcome") != "refused" or "canary" not in reason:
+            failures.append(f"the canary gate must refuse the flip, "
+                            f"got {str(resp)[:300]}")
+        stats = _serve_stats_once(endpoint)
+        if stats.get("generation") != 1:
+            failures.append(f"a canary-refused service must stay on "
+                            f"generation 1, got "
+                            f"{stats.get('generation')!r}")
+        if (stats.get("last_swap") or {}).get("outcome") != "refused":
+            failures.append(f"last_swap must record the refusal, got "
+                            f"{stats.get('last_swap')!r}")
+        after = _serve_score_once(endpoint, fix["records"])
+        if not np.array_equal(np.asarray(after["scores"], np.float64),
+                              fix["ref"]):
+            failures.append("scores after the refused swap NOT "
+                            "bit-exact vs the boot model")
+        proc.terminate()
+        rc = proc.wait(timeout=90)
+        if rc != PREEMPTED_EXIT:
+            failures.append(f"SIGTERM drain must exit "
+                            f"rc={PREEMPTED_EXIT}, got rc={rc}")
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"canary scenario harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        _, err = proc.communicate()
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    _check_trace_survives(trace, failures)
+    return {"cell": "scenario.serve_canary_violation",
+            "spec": "(retrained candidate under a tight canary gate — "
+                    "no injection)",
+            "expected": "refused", "rc": rc,
+            "outcome": f"refused({reason[:48]})",
+            "note": "ISSUE acceptance scenario: a seeded canary "
+                    "violation never flips",
             "seconds": round(time.monotonic() - t0, 1),
             "failures": failures, "passed": not failures}
 
@@ -1145,8 +1563,10 @@ def run_campaign(workdir: str, smoke: bool,
             print(f"chaos:        {f}", flush=True)
     if not points:  # --points restricts to injection cells only
         scenarios = [run_corrupt_shard_scenario(fixture, workdir)]
-        if not smoke:  # the serve scenario needs no training fixture
+        if not smoke:  # the serve scenarios need no training fixture
             scenarios.append(run_serve_dead_client_scenario(workdir))
+            scenarios.append(
+                run_serve_canary_violation_scenario(workdir))
         for r in scenarios:
             results.append(r)
             print(f"chaos: [{'PASS' if r['passed'] else 'FAIL'}] "
@@ -1182,6 +1602,12 @@ def run_campaign(workdir: str, smoke: bool,
             "outlives its worst request/client, post-fault scores stay "
             "bit-identical to the shared batch core, and an injected "
             "kill costs one supervised incarnation (serve.* cells)",
+            "a hot-swap lands on exactly one model: refused swaps "
+            "(corrupt candidate, canary violation, flip fault, drain "
+            "race) leave the current generation serving bit-exact, "
+            "completed swaps serve the candidate bit-exact, and a "
+            "kill mid-flip relaunches onto one consistent generation "
+            "(serve.model_load / serve.swap cells)",
         ],
         "cells": results,
     }

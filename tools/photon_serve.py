@@ -14,10 +14,22 @@ section for the operational recipe). Equivalent module form — the one
         --random-effect-id-set userId \
         --trace-dir out/serve-trace \
         --telemetry-endpoint 127.0.0.1:9090
+
+One control verb rides the same script — ``swap`` asks a RUNNING
+service to hot-swap to a retrained model (load + shadow-scoring
+canary + atomic generation flip; see the README)::
+
+    tools/photon_serve.py swap --endpoint 127.0.0.1:7337 \
+        --model-dir out/models-retrained [--model-id v2]
+
+It blocks until the swap resolves, prints the ``swap_result`` JSON,
+and exits 0 on ``ok`` / 1 on ``refused``.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import sys
 
@@ -26,7 +38,35 @@ _REPO = os.path.dirname(_HERE)
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from photon_ml_tpu.serve.protocol import ServeClient  # noqa: E402
 from photon_ml_tpu.serve.service import main  # noqa: E402
 
+
+def swap_main(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="photon-serve swap",
+        description="hot-swap a running scoring service to a new model")
+    p.add_argument("--endpoint", required=True,
+                   help="the service's listen endpoint (host:port or "
+                        "unix:/path.sock)")
+    p.add_argument("--model-dir", required=True,
+                   help="candidate model dir (same layout the service "
+                        "booted from)")
+    p.add_argument("--model-id", default=None,
+                   help="id the new generation reports (default: the "
+                        "model dir's basename)")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="seconds to wait for the swap to resolve "
+                        "(load + canary can span many batches)")
+    ns = p.parse_args(argv)
+    with ServeClient(ns.endpoint, timeout=ns.timeout) as client:
+        result = client.swap(os.path.abspath(ns.model_dir),
+                             model_id=ns.model_id)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0 if result.get("outcome") == "ok" else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "swap":
+        sys.exit(swap_main(sys.argv[2:]))
     main()

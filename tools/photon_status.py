@@ -247,6 +247,41 @@ def _as_int_label(value) -> int | None:
         return None
 
 
+def _serving_status(p: dict, totals: dict):
+    """The scoring-service sub-dict (photon_ml_tpu/serve): SLO gauges
+    and shed/tier counters ride the heartbeat metric_totals; the model
+    generation, model id, and last hot-swap outcome ride the
+    ``serve.generation`` / ``serve.swap`` spans (strings can't live in
+    the label-summed totals). None for processes that aren't serving."""
+    gen_span = p.pop("_serve_gen", None)
+    swap_span = p.pop("_serve_swap", None)
+    if (totals.get("serve_rows_scored") is None
+            and totals.get("serve_qps") is None
+            and totals.get("serve_generation") is None
+            and gen_span is None):
+        return None
+    generation = totals.get("serve_generation")
+    if generation is None and swap_span is not None:
+        generation = _as_int_label(swap_span.get("generation"))
+    if generation is None and gen_span is not None:
+        generation = _as_int_label(gen_span.get("generation"))
+    model_id = (swap_span or gen_span or {}).get("model_id")
+    return {
+        "qps": totals.get("serve_qps"),
+        "p50_ms": totals.get("serve_p50_ms"),
+        "p99_ms": totals.get("serve_p99_ms"),
+        "queue_depth": totals.get("serve_queue_depth"),
+        "rows_scored": totals.get("serve_rows_scored"),
+        "shed": totals.get("serve_shed", 0),
+        "tier_hits": totals.get("serve_tier_hits"),
+        "generation": int(generation) if generation is not None else None,
+        "model_id": model_id,
+        "last_swap": ({"outcome": swap_span.get("outcome"),
+                       "reason": swap_span.get("reason") or ""}
+                      if swap_span else None),
+    }
+
+
 def compute_status(records: list[dict]) -> dict:
     """Fold a record stream into the run-status document. Pure function
     of the records — the run-dir and socket paths share it."""
@@ -283,6 +318,13 @@ def compute_status(records: list[dict]) -> dict:
                 if sweep is not None and (p["sweep"] is None
                                           or sweep > p["sweep"]):
                     p["sweep"] = sweep
+            # scoring-service markers: the boot generation span and
+            # every hot-swap resolution span carry the strings (model
+            # id, outcome, reason) the numeric heartbeat totals can't
+            if rec.get("name") == "serve.generation":
+                p["_serve_gen"] = labels
+            elif rec.get("name") == "serve.swap":
+                p["_serve_swap"] = labels
         elif kind == "heartbeat":
             p["heartbeat"] = rec
             p["totals"].update(rec.get("metric_totals") or {})
@@ -357,16 +399,7 @@ def compute_status(records: list[dict]) -> dict:
             # qps/latency gauges and shed/tier counters ride the same
             # heartbeat metric_totals as training metrics, so a serve
             # process monitors through this tool unchanged
-            "serving": ({
-                "qps": totals.get("serve_qps"),
-                "p50_ms": totals.get("serve_p50_ms"),
-                "p99_ms": totals.get("serve_p99_ms"),
-                "queue_depth": totals.get("serve_queue_depth"),
-                "rows_scored": totals.get("serve_rows_scored"),
-                "shed": totals.get("serve_shed", 0),
-                "tier_hits": totals.get("serve_tier_hits"),
-            } if totals.get("serve_rows_scored") is not None
-                or totals.get("serve_qps") is not None else None),
+            "serving": _serving_status(p, totals),
             "stalled": bool(hb and hb.get("stalled")),
             "last_heartbeat_uptime_s": (hb or {}).get("uptime_s"),
             "spans_seen": p["spans_seen"],
@@ -488,13 +521,20 @@ def format_status(status: dict, source: str) -> str:
             f"{'YES' if p['stalled'] else 'no':>7}")
         if p.get("serving"):
             s = p["serving"]
+            swap = s.get("last_swap")
+            swap_col = (f" swap={swap['outcome']}"
+                        f"{'(' + swap['reason'][:40] + ')' if swap.get('reason') else ''}"
+                        if swap else "")
+            gen_col = (f" gen={s['generation']}"
+                       f"[{s['model_id']}]" if s.get("generation")
+                       is not None else "")
             lines.append(
-                f"     └ serving: qps={s['qps'] or 0:.1f} "
+                f"     └ serving:{gen_col} qps={s['qps'] or 0:.1f} "
                 f"p50={s['p50_ms'] or 0:.1f}ms "
                 f"p99={s['p99_ms'] or 0:.1f}ms "
                 f"queue={s['queue_depth'] or 0:.0f} "
                 f"rows={s['rows_scored'] or 0:.0f} "
-                f"shed={s['shed'] or 0:.0f}")
+                f"shed={s['shed'] or 0:.0f}{swap_col}")
         if p["run_end"] and p["run_end"]["status"] != "ok":
             lines.append(f"     └ run_end: {p['run_end']['status']} "
                          f"{p['run_end']['reason']}")
