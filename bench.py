@@ -1443,6 +1443,210 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
     }
 
 
+def bench_fleet(n_users=512, d_g=16, d_u=8, n_clients=8,
+                duration_secs=3.0, fleet_sizes=(1, 4)) -> dict:
+    """Aggregate capacity scaling of the entity-sharded scorer fleet:
+    the same concurrent-client load against the fleet router at each
+    fleet size. Every member owns a disjoint contiguous slice of the
+    keyed-hash entity axis (``serve/fleet.py``), so device-tier budgets
+    never overlap and AGGREGATE hot-tier capacity scales linearly with
+    members. The probe pins each member's HBM budget to hold exactly
+    ``n_users // max(fleet_sizes)`` entities — a lone member can keep
+    only that fraction of the axis hot and thrashes, while at the
+    largest fleet every member's disjoint slice fits — and records the
+    aggregate ``device_tier_hit_rate`` per size as the capacity-scaling
+    signal. Rows/sec ``scaling_x`` is recorded alongside with
+    ``host_cores`` for context: member scoring is CPU-bound, so the
+    throughput dimension can only scale when the host has at least as
+    many cores as members (on a 1-core host the fleet overhead
+    dominates and scaling_x < 1 is expected). Recorded, not asserted —
+    BENCH.md tracks the trend."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (
+        FixedEffectModel, GameModel, RandomEffectModel)
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.models.glm import (
+        Coefficients, GeneralizedLinearModel)
+    from photon_ml_tpu.optimize.config import TaskType
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    rng = np.random.default_rng(23)
+    imaps = {
+        "global": IndexMap.from_keys([f"g{j}" for j in range(d_g)],
+                                     add_intercept=True),
+        "user": IndexMap.from_keys([f"u{j}" for j in range(d_u)],
+                                   add_intercept=True),
+    }
+    fixed = FixedEffectModel(GeneralizedLinearModel(
+        Coefficients(jnp.asarray(rng.normal(size=len(imaps["global"])),
+                                 jnp.float32)),
+        TaskType.LINEAR_REGRESSION), "global")
+    vocab = np.asarray([f"user{u}" for u in range(n_users)])
+    re_model = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="user",
+        entity_codes=np.arange(n_users),
+        coefficients=jnp.asarray(
+            rng.normal(size=(n_users, len(imaps["user"]))), jnp.float32))
+    records = []
+    for i in range(512):
+        u = int(rng.integers(0, n_users))
+        records.append({
+            "uid": f"r{i}", "metadataMap": {"userId": f"user{u}"},
+            "globalFeatures": [{"name": f"g{j}", "term": "",
+                                "value": float(rng.normal())}
+                               for j in range(d_g)],
+            "userFeatures": [{"name": f"u{j}", "term": "",
+                              "value": float(rng.normal())}
+                             for j in range(d_u)],
+        })
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # member CPUs, not the chip, are probed
+    # one member's hot tier holds its fair share of the entity axis at
+    # the LARGEST fleet size (plus headroom for hash-split imbalance) —
+    # so a lone member must thrash while a full fleet's disjoint slices
+    # all fit
+    hot_entities = max(1, int(1.25 * n_users / max(fleet_sizes)))
+    budget_mb = hot_entities * (d_u + 1) * 4 / float(1 << 20)
+
+    def _spawn_ready(cmd):
+        proc = subprocess.Popen(cmd, env=env, cwd=_REPO_DIR, text=True,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        ready = proc.stdout.readline().strip()
+        if "ready endpoint=" not in ready:
+            proc.kill()
+            raise RuntimeError(f"fleet probe: no ready line: {ready!r}")
+        return proc, ready.split("endpoint=", 1)[1]
+
+    per_size: dict[int, dict] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = os.path.join(tmp, "model")
+        save_game_model(GameModel({"fixed": fixed, "per-user": re_model}),
+                        model_dir, imaps, entity_vocabs={"userId": vocab})
+        for size in fleet_sizes:
+            procs = []
+            endpoints = []
+            try:
+                for k in range(size):
+                    proc, ep = _spawn_ready(
+                        [sys.executable, "-m",
+                         "photon_ml_tpu.serve.service",
+                         "--game-model-input-dir", model_dir,
+                         "--listen",
+                         f"unix:{tmp}/f{size}m{k}.sock",
+                         "--feature-shard-id-to-feature-"
+                         "section-keys-map",
+                         "global:globalFeatures|user:userFeatures",
+                         "--random-effect-id-set", "userId",
+                         "--max-batch-rows", "256",
+                         "--serve-hbm-budget-mb", f"{budget_mb:.6f}",
+                         "--trace-dir", f"{tmp}/f{size}m{k}"])
+                    procs.append(proc)
+                    endpoints.append(ep)
+                router, endpoint = _spawn_ready(
+                    [sys.executable, "-m", "photon_ml_tpu.serve.router",
+                     "--listen", f"unix:{tmp}/f{size}router.sock",
+                     "--members", ",".join(endpoints),
+                     "--route-id", "userId",
+                     "--trace-dir", f"{tmp}/f{size}router"])
+                procs.append(router)
+
+                def member_tier_hits() -> dict:
+                    agg: dict[str, float] = {}
+                    for ep in endpoints:
+                        with ServeClient(ep) as mc:
+                            hits = mc.stats().get("tier_hits") or {}
+                        for tier, v in hits.items():
+                            agg[tier] = agg.get(tier, 0) + v
+                    return agg
+
+                # warm the tiers through the router (two full passes of
+                # the entity axis), then difference the members'
+                # tier-hit counters across the timed window so the
+                # capacity signal is steady-state, not cold-start
+                with ServeClient(endpoint) as client:
+                    for _ in range(2):
+                        for lo in range(0, len(records), 64):
+                            client.score(records[lo:lo + 64])
+                hits_before = member_tier_hits()
+                rows_scored = [0] * n_clients
+
+                def client_loop(ci):
+                    sizes = (1, 4, 13, 64)
+                    crng = np.random.default_rng(100 + ci)
+                    with ServeClient(endpoint) as client:
+                        deadline = time.perf_counter() + duration_secs
+                        while time.perf_counter() < deadline:
+                            n = int(sizes[crng.integers(0, len(sizes))])
+                            lo = int(crng.integers(0,
+                                                   len(records) - n))
+                            resp = client.score(records[lo:lo + n])
+                            if resp.get("kind") == "scores":
+                                rows_scored[ci] += len(resp["scores"])
+
+                threads = [threading.Thread(target=client_loop,
+                                            args=(ci,))
+                           for ci in range(n_clients)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+                with ServeClient(endpoint) as client:
+                    stats = client.stats()
+                route = stats.get("route") or {}
+                assert not route.get("error") and not route.get("shed"), (
+                    f"fleet probe: fault-free load must not shed or "
+                    f"error: {route}")
+                hits_after = member_tier_hits()
+                window = {t: hits_after.get(t, 0) - hits_before.get(t, 0)
+                          for t in hits_after}
+                total_hits = sum(window.values())
+                per_size[size] = {
+                    "rows_scored": int(sum(rows_scored)),
+                    "rows_per_sec": round(sum(rows_scored) / dt, 0),
+                    "p99_ms": round(float(stats.get("p99_ms") or 0.0),
+                                    2),
+                    "device_tier_hit_rate": round(
+                        window.get("device", 0) / total_hits, 3)
+                    if total_hits else None,
+                }
+            finally:
+                for proc in procs:
+                    if proc.poll() is None:
+                        proc.send_signal(signal.SIGTERM)
+                for proc in procs:
+                    try:
+                        proc.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+    lo, hi = min(fleet_sizes), max(fleet_sizes)
+    base = per_size[lo]["rows_per_sec"] or 1.0
+    return {
+        "clients": n_clients,
+        "host_cores": os.cpu_count(),
+        "hot_tier_entities_per_member": hot_entities,
+        "members": {str(s): per_size[s] for s in fleet_sizes},
+        "scaling_x": round(per_size[hi]["rows_per_sec"] / base, 2),
+        "capacity_scaling_x": (
+            round(per_size[hi]["device_tier_hit_rate"]
+                  / max(per_size[lo]["device_tier_hit_rate"] or 1e-9,
+                        1e-9), 2)
+            if per_size[hi].get("device_tier_hit_rate") is not None
+            and per_size[lo].get("device_tier_hit_rate") is not None
+            else None),
+    }
+
+
 def bench_ingest(n=10_000_000, d=100_000, nnz_per_row=8,
                  n_entities=50_000) -> dict:
     """10M-row ingestion: vectorized ELL pack + random-effect block build
@@ -1737,6 +1941,8 @@ def main():
     avro_ingest = bench_avro_ingest()
     _progress("serve probe")
     serve = bench_serve()
+    _progress("fleet probe")
+    fleet = bench_fleet()
     _progress("ingest bench")
     ingest = _bench_ingest_isolated()
     _progress("streamed ingest bench")
@@ -1772,6 +1978,7 @@ def main():
         "game_full": game_full,
         "avro_ingest": avro_ingest,
         "serve": serve,
+        "fleet": fleet,
         "ingest": ingest,
         "ingest_streamed": ingest_streamed,
     }

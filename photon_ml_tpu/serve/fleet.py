@@ -1,0 +1,556 @@
+"""Fleet membership for the entity-sharded scorer fleet.
+
+The router (``serve/router.py``) holds a small pool of persistent
+back-end connections per scorer member (so concurrent routed
+sub-requests overlap inside the member's micro-batcher instead of
+lock-stepping on one socket) and routes each request row to the member
+that OWNS the row's entity shard. Ownership is the serving analogue of
+the training layout: ``game/dataset.py``'s ``entity_shard=(k, K)``
+splits the sorted entity axis into K contiguous slices, one per mesh
+shard (``parallel/mesh.py`` ENTITY_AXIS). A serving request stream is
+open-vocabulary — the router cannot know the model's sorted entity
+axis — so :func:`entity_shard` takes the k-th of K contiguous slices
+of the *keyed-hash* entity axis instead: the same stable, disjoint,
+exhaustive partition discipline (every entity has exactly one owner,
+ownership is a pure function of (entity, K)), which is what keeps
+per-member device-tier budgets from overlapping and makes aggregate
+hot-tier capacity scale linearly with fleet size.
+
+Membership is a health-state machine per member, driven by the
+router's hello/ping/stats traffic plus a heartbeat ping each tick::
+
+    (boot) --verified hello--> healthy
+    healthy  --suspect_after consecutive failures--> suspect
+    suspect  --dead_after consecutive failures----> dead
+    suspect  --any success-------------------------> healthy
+    dead     --verified hello (generation check)---> healthy
+
+Thresholds are FAILURE COUNTS, not wall-clock, so the machine is
+deterministic under test. A dead member's socket is kicked closed so
+every dispatch blocked on it fails immediately (and is then retried,
+failed over to the shard's fallback member, or shed with a typed
+error — never black-holed). Re-admission requires a fresh verified
+hello whose ``model_id`` matches the fleet's live identity: a member
+relaunched mid-hot-swap with yesterday's model is refused until it
+catches up, so one fleet never serves two model generations.
+
+Lock discipline (photonlint W901/W904): ``Fleet._lock`` guards every
+piece of member health/identity/in-flight metadata; each member's
+``wire`` lock guards only the pool/clients *references* (connection
+checkout is the pool queue's own lock, and each checked-out client
+serializes itself). The two are never held together — metadata is read
+under ``_lock``, released, then the wire is taken — so there is no
+lock order to invert.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+from typing import Optional, Sequence
+
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.serve.protocol import (
+    ServeClient,
+    ShardUnavailableError,
+    typed_error,
+)
+from photon_ml_tpu.utils.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+    call_with_retry,
+)
+
+#: Per-dispatch bounded retry (site ``serve.route``): a transiently
+#: failing member costs a couple of deterministically-jittered
+#: backoffs before the router fails over to the shard's fallback.
+ROUTE_RETRY_POLICY = RetryPolicy(
+    max_attempts=3, base_delay_seconds=0.02, max_delay_seconds=0.25,
+    retry_on=(OSError,), permanent_on=())
+
+#: Boot admission: members launched alongside the router (e.g. by
+#: ``photon_supervise --fleet``) take seconds to import jax and bind,
+#: so the first hello is patient.
+BOOT_CONNECT_POLICY = RetryPolicy(
+    max_attempts=60, base_delay_seconds=0.25, max_delay_seconds=1.0,
+    deadline_seconds=120.0, retry_on=(OSError,), permanent_on=())
+
+#: Re-admission probe: one connect attempt per heartbeat tick — the
+#: tick cadence IS the backoff.
+READMIT_CONNECT_POLICY = RetryPolicy(
+    max_attempts=1, base_delay_seconds=0.05, max_delay_seconds=0.05,
+    retry_on=(OSError,), permanent_on=())
+
+
+def entity_shard(entity_id: str, num_shards: int) -> int:
+    """Shard owning ``entity_id``: the k-th of ``num_shards`` contiguous
+    slices of the 64-bit keyed-hash entity axis (see module docstring
+    for how this mirrors the ENTITY_AXIS training split). Stable across
+    processes and runs — blake2b, not ``hash()``, which is seeded per
+    process."""
+    if num_shards <= 1:
+        return 0
+    h = int.from_bytes(
+        hashlib.blake2b(str(entity_id).encode("utf-8", "replace"),
+                        digest_size=8).digest(), "big")
+    return min((h * num_shards) >> 64, num_shards - 1)
+
+
+def entity_of_row(row: dict, route_key: Optional[str] = None) -> str:
+    """The routing entity of a request row: ``route_key``'s value when
+    configured (top-level or under ``metadataMap``), else the first
+    metadataMap id in sorted-key order (deterministic for rows carrying
+    several id types), else the row's ``uid`` — so entity-less rows
+    still route deterministically."""
+    md = row.get("metadataMap") or {}
+    if route_key:
+        v = md.get(route_key, row.get(route_key))
+        if v is not None:
+            return str(v)
+        return ""
+    if md:
+        return str(md[sorted(md)[0]])
+    uid = row.get("uid")
+    return "" if uid is None else str(uid)
+
+
+class FleetAdmissionError(RuntimeError):
+    """A member's verified-hello admission was refused (bad handshake
+    or generation-check mismatch); the member stays out of rotation."""
+
+
+class MemberReplyError(OSError):
+    """A member answered a routed sub-request with an error response.
+    An OSError so ``ROUTE_RETRY_POLICY`` retries it like a transport
+    failure — a member that consumed an injected fault budget answers
+    clean on the retry."""
+
+
+class HealthPolicy:
+    """Deterministic health thresholds (consecutive-failure counts)."""
+
+    def __init__(self, suspect_after: int = 1, dead_after: int = 3,
+                 heartbeat_seconds: float = 0.5):
+        self.suspect_after = int(suspect_after)
+        self.dead_after = int(dead_after)
+        self.heartbeat_seconds = float(heartbeat_seconds)
+
+
+class FleetMember:
+    """One scorer member: endpoint, its connection pool, and the
+    health/identity metadata the :class:`Fleet` tracks for it. All
+    mutable fields are owned by the Fleet's locks (module docstring);
+    the member itself only carries them."""
+
+    def __init__(self, index: int, endpoint: str):
+        self.index = index
+        self.endpoint = endpoint
+        self.wire = threading.Lock()  # guards pool/clients swaps
+        # the connection POOL: several persistent member-role
+        # connections so concurrent routed sub-requests overlap inside
+        # the member's micro-batcher instead of lock-stepping on one
+        # socket. ``pool`` is the FIFO checkout queue; ``clients`` is
+        # the full set (for kick/close). Both guarded by ``wire``;
+        # checkout itself is the queue's own lock.
+        self.pool: Optional["queue.Queue[ServeClient]"] = None
+        self.clients: list[ServeClient] = []
+        # guarded by Fleet._lock:
+        self.state = "dead"  # healthy | suspect | dead
+        self.failures = 0
+        self.generation: Optional[int] = None
+        self.model_id: Optional[str] = None
+        self.coordinates: list = []
+        self.admissions = 0
+
+    def kick(self) -> None:
+        """Fail any dispatch blocked on this member's sockets NOW
+        (mark-dead path). Read-only on the client references: the next
+        admission swaps in a fresh pool under the wire lock."""
+        for client in list(self.clients):
+            client.kick()
+
+
+class Fleet:
+    """Membership + routing for N scorer members behind one router.
+
+    ``dispatch`` is called from the router's per-connection reader
+    threads; ``heartbeat_tick`` and admission run on the router's main
+    thread. Every outcome on the request plane lands in the
+    ``serve_route{outcome}`` counter — summing ``ok`` + ``error`` +
+    ``shed`` accounts for every routed sub-request, which is the
+    no-black-hole ledger the chaos cells audit.
+    """
+
+    def __init__(self, endpoints: Sequence[str],
+                 health: Optional[HealthPolicy] = None,
+                 registry: MetricsRegistry = REGISTRY,
+                 warn=None, route_key: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 member_timeout: float = 30.0,
+                 fallbacks: Optional[dict] = None,
+                 connections_per_member: int = 4):
+        if not endpoints:
+            raise ValueError("a fleet needs at least one member endpoint")
+        self.members = [FleetMember(i, ep)
+                        for i, ep in enumerate(endpoints)]
+        self.health = health or HealthPolicy()
+        self.route_key = route_key
+        self._registry = registry
+        self._warn = warn or (lambda msg: None)
+        self._retry = retry_policy or ROUTE_RETRY_POLICY
+        self._member_timeout = float(member_timeout)
+        self._connections = int(max(1, connections_per_member))
+        # shard k's fallback member (hedged re-dispatch target when the
+        # owner is down); default: the ring successor
+        n = len(self.members)
+        self._fallback_of = {
+            k: (fallbacks.get(k, (k + 1) % n) if fallbacks
+                else (k + 1) % n)
+            for k in range(n)}
+        self._lock = threading.Lock()
+        self._live_model_id: Optional[str] = None
+        self._inflight: dict[tuple, float] = {}  # token → dispatch start
+        self._dispatch_seq = 0
+        self._update_member_gauge_locked()
+
+    # -- routing --------------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.members)
+
+    def shard_of_row(self, row: dict) -> int:
+        return entity_shard(entity_of_row(row, self.route_key),
+                            self.num_shards)
+
+    def route_chain(self, shard: int) -> list:
+        """Members eligible to serve ``shard``, in dispatch order:
+        the owner, then its configured fallback — dead members are
+        skipped. Empty means the shard is dark (degraded mode: the
+        caller sheds with :class:`ShardUnavailableError`)."""
+        owner = shard % len(self.members)
+        order = [owner]
+        fb = self._fallback_of[owner]
+        if fb != owner:
+            order.append(fb)
+        with self._lock:
+            return [self.members[i] for i in order
+                    if self.members[i].state != "dead"]
+
+    # -- dispatch (router reader threads) -------------------------------
+
+    def dispatch(self, shard: int, msg: dict) -> dict:
+        """Route one sub-request to the shard's owner with bounded
+        retry, failing over to the fallback member, shedding typed when
+        the shard has no live member. Raises on failure — the caller
+        turns the exception into a typed error reply, so every routed
+        request resolves one way or another."""
+        chain = self.route_chain(shard)
+        if not chain:
+            self._count("shed")
+            raise ShardUnavailableError(
+                f"shard {shard} has no live member "
+                f"(owner and fallback are dead)")
+        last: Optional[BaseException] = None
+        for hop, member in enumerate(chain):
+            if hop:
+                self._count("failover")
+            try:
+                resp = call_with_retry(
+                    lambda m=member: self._dispatch_once(m, msg),
+                    "serve.route", policy=self._retry, warn=self._warn)
+            except RetryExhaustedError as e:
+                self._record_failure(member)
+                self._count("member_failed")
+                last = e.__cause__ or e
+                continue
+            self._record_success(member)
+            self._count("ok")
+            return resp
+        self._count("error")
+        raise OSError(
+            f"shard {shard}: every route attempt failed "
+            f"(last: {type(last).__name__}: {last})")
+
+    def _dispatch_once(self, member: FleetMember, msg: dict) -> dict:
+        with self._lock:
+            if member.state == "dead":
+                raise OSError(f"member {member.index} is dead")
+            self._dispatch_seq += 1
+            token = (member.index, msg.get("id"), self._dispatch_seq)
+            self._inflight[token] = time.monotonic()
+        try:
+            with member.wire:
+                pool = member.pool
+            if pool is None:
+                raise OSError(f"member {member.index} is not connected")
+            try:
+                client = pool.get(timeout=self._member_timeout)
+            except queue.Empty:
+                raise OSError(
+                    f"member {member.index}: every pooled connection "
+                    f"busy for {self._member_timeout:.0f}s") from None
+            try:
+                resp = client.request(msg)
+            except BaseException:
+                # a request that died mid-wire leaves the framing
+                # desynced — close before returning so the slot still
+                # exists (pool size is invariant) but the next draw of
+                # THIS connection fails fast instead of mis-pairing
+                # replies; re-admission swaps in a fresh pool
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                pool.put(client)
+                raise
+            else:
+                pool.put(client)
+        finally:
+            with self._lock:
+                self._inflight.pop(token, None)
+        err = typed_error(resp)
+        if err is not None:
+            raise MemberReplyError(
+                f"member {member.index} replied: {resp.get('error')}")
+        return resp
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    # -- health state machine -------------------------------------------
+
+    def _record_failure(self, member: FleetMember) -> None:
+        with self._lock:
+            if member.state == "dead":
+                return
+            member.failures += 1
+            previous = member.state
+            if member.failures >= self.health.dead_after:
+                member.state = "dead"
+            elif member.failures >= self.health.suspect_after:
+                member.state = "suspect"
+            became_dead = (member.state == "dead"
+                           and previous != "dead")
+            failures = member.failures
+            self._update_member_gauge_locked()
+        if became_dead:
+            self._count_member("dead")
+            self._warn(f"fleet member {member.index} "
+                       f"({member.endpoint}) marked dead after "
+                       f"{failures} consecutive failures")
+            # fail every dispatch blocked on its socket immediately —
+            # in-flight work re-routes or sheds instead of hanging
+            member.kick()
+
+    def _record_success(self, member: FleetMember) -> None:
+        with self._lock:
+            if member.state == "dead":
+                return  # only a verified hello re-admits
+            member.failures = 0
+            member.state = "healthy"
+            self._update_member_gauge_locked()
+
+    def _update_member_gauge_locked(self) -> None:
+        counts = {"healthy": 0, "suspect": 0, "dead": 0}
+        for m in self.members:
+            counts[m.state] += 1
+        g = self._registry.gauge("serve_fleet_members")
+        for state, n in counts.items():
+            g.set(n, state=state)
+
+    def _count(self, outcome: str) -> None:
+        self._registry.counter("serve_route").inc(outcome=outcome)
+
+    def _count_member(self, event: str) -> None:
+        self._registry.counter("serve_fleet_events").inc(event=event)
+
+    # -- admission (router main thread) ---------------------------------
+
+    def admit_all(self, policy: Optional[RetryPolicy] = None) -> int:
+        """Boot admission: verified hello + member-role handshake for
+        every member (patient connect — members may still be
+        importing). Members that fail stay dead; returns the live
+        count. At least one member must admit."""
+        live = 0
+        for member in self.members:
+            try:
+                self.admit(member,
+                           policy=policy or BOOT_CONNECT_POLICY)
+                live += 1
+            except (OSError, FleetAdmissionError) as e:
+                self._warn(f"fleet member {member.index} "
+                           f"({member.endpoint}) failed boot "
+                           f"admission: {type(e).__name__}: {e}")
+        if not live:
+            raise FleetAdmissionError(
+                "no fleet member completed a verified hello")
+        return live
+
+    def admit(self, member: FleetMember,
+              policy: Optional[RetryPolicy] = None) -> None:
+        """Connect, verify the hello, run the member-role handshake,
+        and generation-check the member's model identity against the
+        fleet's live identity before putting it (back) in rotation.
+
+        Builds a pool of ``connections_per_member`` back-end
+        connections (each with its own verified hello + member-role
+        handshake, so ``serve.route`` covers every wire) — concurrent
+        router requests then reach the member in parallel and its
+        micro-batcher can actually coalesce them."""
+        clients: list[ServeClient] = []
+        first_ack: Optional[dict] = None
+        try:
+            for i in range(self._connections):
+                client = ServeClient(
+                    member.endpoint, timeout=self._member_timeout,
+                    connect_policy=(policy or READMIT_CONNECT_POLICY)
+                    if i == 0 else None)
+                clients.append(client)
+                if (client.hello or {}).get("kind") != "serve_hello":
+                    raise FleetAdmissionError(
+                        f"member {member.index}: bad hello "
+                        f"{client.hello!r}")
+                ack = client.request({"kind": "member",
+                                      "member": member.index,
+                                      "fleet": len(self.members)})
+                if ack.get("kind") != "member_ack":
+                    raise FleetAdmissionError(
+                        f"member {member.index}: member-role handshake "
+                        f"refused: {ack!r}")
+                model_id = ack.get("model_id")
+                with self._lock:
+                    live = self._live_model_id
+                if live is not None and model_id != live:
+                    # the generation check: a member relaunched
+                    # mid-swap with a stale model must not split the
+                    # fleet
+                    raise FleetAdmissionError(
+                        f"member {member.index} serves model "
+                        f"{model_id!r} but the fleet is live on "
+                        f"{live!r} — re-admission refused until it "
+                        f"catches up")
+                if first_ack is None:
+                    first_ack = ack
+                elif model_id != first_ack.get("model_id"):
+                    raise FleetAdmissionError(
+                        f"member {member.index} swapped mid-admission "
+                        f"({first_ack.get('model_id')!r} → "
+                        f"{model_id!r}) — retry next tick")
+        except BaseException:
+            for client in clients:
+                client.close()
+            raise
+        ack = first_ack or {}
+        model_id = ack.get("model_id")
+        pool: "queue.Queue[ServeClient]" = queue.Queue()
+        for client in clients:
+            pool.put(client)
+        with member.wire:
+            old = member.clients
+            member.clients = clients
+            member.pool = pool
+        with self._lock:
+            member.state = "healthy"
+            member.failures = 0
+            member.generation = ack.get("generation")
+            member.model_id = model_id
+            member.coordinates = list(
+                (clients[0].hello or {}).get("coordinates") or [])
+            member.admissions += 1
+            readmission = member.admissions > 1
+            if self._live_model_id is None:
+                self._live_model_id = model_id
+            self._update_member_gauge_locked()
+        for client in old:
+            try:
+                client.close()
+            except OSError:
+                pass
+        self._count_member("readmitted" if readmission else "admitted")
+
+    def heartbeat_tick(self) -> None:
+        """One health round (router main thread): ping live members,
+        probe dead ones for re-admission. A member whose every pooled
+        connection is busy with a dispatch is skipped this tick — the
+        dispatch results themselves feed the state machine."""
+        for member in self.members:
+            with self._lock:
+                state = member.state
+            if state == "dead":
+                try:
+                    self.admit(member)
+                except (OSError, FleetAdmissionError):
+                    pass  # still down (or still stale) — next tick
+                continue
+            with member.wire:
+                pool = member.pool
+            if pool is None:
+                self._record_failure(member)
+                continue
+            try:
+                client = pool.get_nowait()
+            except queue.Empty:
+                continue  # all connections mid-dispatch — busy ≠ sick
+            try:
+                pong = client.ping()
+                if pong.get("kind") != "pong":
+                    raise OSError(f"bad pong: {pong!r}")
+            except (OSError, ConnectionError):
+                pool.put(client)
+                self._record_failure(member)
+            else:
+                pool.put(client)
+                self._record_success(member)
+
+    # -- introspection / shutdown ---------------------------------------
+
+    def live_model_id(self) -> Optional[str]:
+        with self._lock:
+            return self._live_model_id
+
+    def live_generation(self) -> int:
+        """The fleet's serving generation: the max over live members'
+        last verified generation (generation counters are per-process;
+        ``model_id`` is the cross-process identity)."""
+        with self._lock:
+            gens = [m.generation for m in self.members
+                    if m.state != "dead" and m.generation is not None]
+        return max(gens) if gens else 1
+
+    def coordinates(self) -> list:
+        with self._lock:
+            for m in self.members:
+                if m.state != "dead" and m.coordinates:
+                    return list(m.coordinates)
+        return []
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "shards": len(self.members),
+                "live_model_id": self._live_model_id,
+                "inflight": len(self._inflight),
+                "members": [
+                    {"member": m.index, "endpoint": m.endpoint,
+                     "state": m.state, "failures": m.failures,
+                     "generation": m.generation,
+                     "model_id": m.model_id,
+                     "admissions": m.admissions}
+                    for m in self.members],
+            }
+
+    def close(self) -> None:
+        for member in self.members:
+            with member.wire:
+                clients = member.clients
+                member.clients = []
+                member.pool = None
+            for client in clients:
+                try:
+                    client.close()
+                except OSError:
+                    pass

@@ -19,6 +19,7 @@ Grammar (one JSON object per line):
     {"kind": "stats"}
     {"kind": "swap", "id": <echoed>, "model_dir": "...",
      "model_id": <optional>}
+    {"kind": "member", "member": <int>, "fleet": <int>}
 
   A ``score`` row is a GAME record in the Avro record shape the batch
   loader reads: feature sections of ``{"name", "term", "value"}``
@@ -45,6 +46,15 @@ Grammar (one JSON object per line):
   post-flip probation ROLLBACK happens after the reply and is
   reported through ``stats``/``photon_status`` (``last_swap``), not
   the ``swap_result``.
+
+  ``member`` is the fleet router's member-role handshake
+  (``serve/fleet.py``): the service acknowledges with
+  ``{"kind": "member_ack", "proto": 1, "member": <echoed>,
+  "generation": ..., "model_id": ...}`` and marks the connection as
+  router-originated, which arms the ``serve.route`` fault point on
+  that connection's score requests. ``error`` strings follow a typed
+  grammar — ``shed:<reason>`` or ``<TypeName>: <message>`` — parsed
+  back into exceptions by :func:`typed_error`.
 
 Endpoints reuse the telemetry grammar (``host:port`` /
 ``unix:/path.sock``); ``file:`` endpoints are rejected — a request
@@ -78,7 +88,34 @@ CONNECT_RETRY_POLICY = RetryPolicy(
     retry_on=(OSError,), permanent_on=())
 
 
-class ModelSwapRefusedError(RuntimeError):
+class ServeRequestError(RuntimeError):
+    """Base of the typed client-side view of a server ``error``
+    response. :func:`typed_error` parses the wire ``error`` string into
+    the matching subclass; unknown error shapes land here so callers
+    can always catch the base."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class ShedError(ServeRequestError):
+    """The service shed the request at admission (``shed:queue_full``
+    when the bounded queue is over budget, ``shed:closed`` while
+    draining) — retry against a less loaded or live endpoint."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"shed:{reason}")
+        self.reason = reason
+
+
+class ShardUnavailableError(ServeRequestError):
+    """The fleet router's degraded mode: the entity shard owning these
+    rows has no live member (owner and fallback both dead), so the
+    request is shed typed instead of hanging (``serve/fleet.py``)."""
+
+
+class ModelSwapRefusedError(ServeRequestError):
     """A hot-swap candidate was refused (unreadable/corrupt model,
     canary score-diff violation, flip fault, or service draining) —
     the service keeps serving its current generation."""
@@ -86,6 +123,33 @@ class ModelSwapRefusedError(RuntimeError):
     def __init__(self, reason: str):
         super().__init__(reason)
         self.reason = reason
+
+
+#: Typed-error names recognized on the wire (``"Name: message"``).
+_TYPED_ERRORS = {
+    "ShardUnavailableError": ShardUnavailableError,
+    "ModelSwapRefusedError": ModelSwapRefusedError,
+}
+
+
+def typed_error(resp: dict) -> Optional[ServeRequestError]:
+    """The typed exception a response carries, or None for non-errors.
+
+    Parses the ``error`` field's wire grammar: ``shed:<reason>`` for
+    admission sheds, ``<TypeName>: <message>`` for typed errors
+    (:data:`_TYPED_ERRORS`), anything else as the generic
+    :class:`ServeRequestError`. Works on ``error`` responses and on
+    refused ``swap_result`` replies alike (both carry ``error``)."""
+    message = resp.get("error")
+    if message is None:
+        return None
+    message = str(message)
+    if message.startswith("shed:"):
+        return ShedError(message[len("shed:"):])
+    name, sep, rest = message.partition(":")
+    if sep and name in _TYPED_ERRORS:
+        return _TYPED_ERRORS[name](rest.strip())
+    return ServeRequestError(message)
 
 
 def parse_serve_endpoint(endpoint: str) -> tuple[str, object]:
@@ -151,14 +215,22 @@ class ServeClient:
     re-dials the same endpoint and re-verifies the hello
     ``generation`` — ``generation_changed`` records whether a
     hot-swap happened while the client was away.
+
+    With ``raise_errors=True`` every response carrying an ``error``
+    field raises its typed exception (:func:`typed_error`:
+    :class:`ShedError` / :class:`ShardUnavailableError` /
+    :class:`ModelSwapRefusedError` / :class:`ServeRequestError`)
+    instead of returning the raw dict.
     """
 
     def __init__(self, endpoint: str, timeout: float = 30.0,
-                 connect_policy: Optional[RetryPolicy] = None):
+                 connect_policy: Optional[RetryPolicy] = None,
+                 raise_errors: bool = False):
         self._endpoint = endpoint
         self._timeout = timeout
         self._scheme, self._addr = parse_serve_endpoint(endpoint)
         self._policy = connect_policy or CONNECT_RETRY_POLICY
+        self._raise_errors = bool(raise_errors)
         self._sock: Optional[socket.socket] = None
         self._file = None
         self.hello: Optional[dict] = None
@@ -210,8 +282,19 @@ class ServeClient:
         return json.loads(line)
 
     def request(self, obj: dict) -> dict:
+        if self._sock is None:
+            # an OSError, not AttributeError: a closed client must fail
+            # like a dead wire so retry/failover/health paths treat it
+            # uniformly (the fleet pool returns closed clients to their
+            # slot — the next draw lands here)
+            raise ConnectionError("client is closed")
         self._sock.sendall(encode(obj))
-        return self._read()
+        resp = self._read()
+        if self._raise_errors:
+            err = typed_error(resp)
+            if err is not None:
+                raise err
+        return resp
 
     def score(self, rows: Sequence[dict],
               request_id: Optional[str] = None) -> dict:
@@ -234,6 +317,23 @@ class ServeClient:
         if model_id:
             msg["model_id"] = model_id
         return self.request(msg)
+
+    def kick(self) -> None:
+        """Fail any request blocked on this connection NOW by shutting
+        the socket under it (the fleet health machine's mark-dead
+        path). Deliberately leaves the client's state alone — the
+        owner reconnects or replaces the client afterwards."""
+        sock = self._sock
+        if sock is None:
+            return
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def close(self) -> None:
         if self._sock is None:

@@ -239,7 +239,9 @@ class ServingScorer:
         return np.asarray(total)[:n].astype(np.float64)
 
     def stats(self) -> dict:
-        return {"tiers": [s.stats() for s in self.stores.values()]}
+        return {"tiers": [s.stats() for s in self.stores.values()],
+                "tier_hits": self._registry.counter(
+                    "serve_tier_hits").by_label("tier")}
 
 
 class _GenerationEntry:

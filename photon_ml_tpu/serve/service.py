@@ -255,6 +255,7 @@ class ServeService:
     def _conn_loop(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
         alive = [True]
+        member_role: Optional[int] = None  # fleet-router connection?
 
         def send(obj: dict) -> bool:
             with wlock:
@@ -301,7 +302,29 @@ class ServeService:
                 elif kind == "stats":
                     send({"kind": "stats", "proto": SERVE_PROTO,
                           **self.stats()})
+                elif kind == "member":
+                    # fleet-router member-role handshake: the ack is
+                    # the router's verified hello (generation-checked
+                    # admission happens on the router side)
+                    member_role = int(msg.get("member") or 0)
+                    gen = self.gens.generation
+                    send({"kind": "member_ack", "proto": SERVE_PROTO,
+                          "member": member_role, "generation": gen,
+                          "model_id": self.gens.model_id(gen)})
                 elif kind == "score":
+                    if member_role is not None:
+                        try:
+                            # routed-plane faults fire in the member,
+                            # per routed sub-request — the router must
+                            # retry/fail over/shed, never black-hole
+                            fault_point("serve.route",
+                                        tag=str(member_role))
+                        except (InjectedFault, OSError) as e:
+                            self._registry.counter("serve_errors").inc(
+                                kind=type(e).__name__)
+                            send(error_response(
+                                rid, f"{type(e).__name__}: {e}"))
+                            continue
                     # pin at admission: the response is scored entirely
                     # by the generation that was current RIGHT NOW,
                     # even if a flip lands while the work is queued

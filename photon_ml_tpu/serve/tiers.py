@@ -31,6 +31,7 @@ tier served a row.
 
 from __future__ import annotations
 
+import weakref
 from collections import OrderedDict
 
 import numpy as np
@@ -51,6 +52,24 @@ from photon_ml_tpu.serve.batcher import bucket_rows
 #: keeps a warmed bucket warm across a generation flip.
 _GATHER_FN = jax.jit(lambda block, slots: block[slots])
 _PROMOTE_FN = jax.jit(lambda block, rows, slots: block.at[slots].set(rows))
+
+#: ``serve_tier_device_bytes`` is the SUM of live device blocks per
+#: (registry, coordinate) — during a hot-swap two generations' stores
+#: briefly share a coordinate label, and per-store ``gauge.set`` would
+#: clobber: a refused candidate's release used to leave the gauge
+#: reporting a block that was already dropped. Each store adds its
+#: contribution on warm and subtracts it on release, so the gauge
+#: returns to its pre-warm value after a full release. Only the device
+#: loop warms/releases stores, so the running sums need no lock.
+_DEVICE_BYTES_LIVE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _account_device_bytes(registry, coordinate: str, delta: int) -> None:
+    per_coord = _DEVICE_BYTES_LIVE.setdefault(registry, {})
+    total = per_coord.get(coordinate, 0) + delta
+    per_coord[coordinate] = total
+    registry.gauge("serve_tier_device_bytes").set(
+        total, coordinate=coordinate)
 
 
 class TieredCoefficientStore:
@@ -91,8 +110,8 @@ class TieredCoefficientStore:
         self._gather_fn = _GATHER_FN
         self._promote_fn = _PROMOTE_FN
         self.released = False
-        registry.gauge("serve_tier_device_bytes").set(
-            self.capacity * self.row_bytes, coordinate=coordinate_id)
+        _account_device_bytes(registry, coordinate_id,
+                              self.capacity * self.row_bytes)
 
     # -- generation retirement ------------------------------------------
 
@@ -101,9 +120,13 @@ class TieredCoefficientStore:
         retirement: called only after the last batch pinned to this
         store's generation has drained). The store stays scoreable —
         the next :meth:`lookup` re-warms from the model block exactly
-        like a cold start (rollback re-promotes on demand). The
-        ``serve_tier_device_bytes`` gauge is left to the ACTIVE
-        generation's store, whose constructor owns the label."""
+        like a cold start (rollback re-promotes on demand). This
+        store's contribution leaves the ``serve_tier_device_bytes``
+        gauge, which therefore returns to its pre-warm value — the
+        ACTIVE generation's store (if any) keeps its own share."""
+        if not self.released:
+            _account_device_bytes(self._registry, self.coordinate_id,
+                                  -(self.capacity * self.row_bytes))
         self._device_block = None
         self._slot_of.clear()
         self._host.clear()
@@ -143,6 +166,8 @@ class TieredCoefficientStore:
             self._device_block = jnp.zeros((self.capacity, self.dim),
                                            jnp.float32)
             self.released = False
+            _account_device_bytes(self._registry, self.coordinate_id,
+                                  self.capacity * self.row_bytes)
         k = len(slots)
         bucket = bucket_rows(k, min_bucket=1)
         rows_np = np.asarray(rows, np.float32)
@@ -244,6 +269,7 @@ class TieredCoefficientStore:
             "device_capacity": self.capacity,
             "host_entities": len(self._host),
             "host_capacity": self.host_capacity,
-            "device_bytes": self.capacity * self.row_bytes,
+            "device_bytes": (0 if self.released
+                             else self.capacity * self.row_bytes),
             "released": self.released,
         }

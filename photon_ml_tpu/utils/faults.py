@@ -195,6 +195,19 @@ FAULT_POINTS: dict[str, FaultPointInfo] = {
         "consistent generation); corrupt flips candidate bytes on disk "
         "AFTER load — the flip is insensitive, it serves from memory",
         modes=("io_error", "corrupt", "slow", "kill"), has_path=True),
+    "serve.route": FaultPointInfo(
+        "in a scorer FLEET MEMBER's connection thread, per routed "
+        "sub-request arriving over a member-role connection from the "
+        "fleet router (serve/service.py; the router's dispatch path is "
+        "serve/fleet.py); tag = the member's fleet index. raise/"
+        "io_error/flaky fail THAT sub-request with an error response — "
+        "the router retries through utils/retry, fails over to the "
+        "shard's fallback member, or sheds typed; slow stalls the "
+        "sub-request inside the router's member timeout; kill dies the "
+        "member mid-request for the no-black-hole drill (every "
+        "in-flight request must still get a reply or a typed shed, and "
+        "the supervised relaunch re-admits only on the live generation)",
+        modes=("raise", "io_error", "delay", "slow", "flaky", "kill")),
 }
 
 

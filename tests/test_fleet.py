@@ -1,0 +1,433 @@
+"""Fleet routing tests: the entity-shard partition, the health state
+machine, degraded-mode shedding, generation-checked admission, and the
+no-black-hole e2e acceptance.
+
+Layers:
+- unit: ``entity_shard`` determinism + disjoint/exhaustive partition,
+  ``entity_of_row`` routing-entity precedence
+- unit: the healthy → suspect → dead machine on deterministic
+  consecutive-failure thresholds, dispatch-driven (no sockets)
+- unit: degraded mode — a dark shard sheds typed
+  (``ShardUnavailableError``), never hangs, and the
+  ``serve_route{outcome}`` ledger accounts for it
+- subprocess: generation-checked admission — a member serving a stale
+  ``model_id`` is refused re-admission (split-fleet guard)
+- e2e: 4 members + the router; SIGKILL of one member mid-concurrent
+  load with request-id accounting — every request answered (bit-exact
+  scores or a typed error, zero silent drops), surviving shard traffic
+  fails over, swap is refused typed, SIGTERM drains to rc 75
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.obs.metrics import MetricsRegistry
+from photon_ml_tpu.serve.fleet import (
+    Fleet,
+    FleetAdmissionError,
+    HealthPolicy,
+    entity_of_row,
+    entity_shard,
+)
+from photon_ml_tpu.serve.protocol import (
+    ModelSwapRefusedError,
+    ServeClient,
+    ShardUnavailableError,
+    typed_error,
+)
+from test_serve import (  # noqa: F401 — shared serving fixtures
+    SECTIONS,
+    _build_model_dir,
+    _make_records,
+    _serve_args,
+    _spawn_serve,
+    _subprocess_env,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PREEMPTED_EXIT = 75
+
+
+# ---------------------------------------------------------------------------
+# entity_shard / entity_of_row
+# ---------------------------------------------------------------------------
+
+
+class TestEntityShard:
+    def test_pinned_values_guard_hash_stability(self):
+        # the shard function is the cross-process routing contract —
+        # these pins fail loudly if anyone changes the hash
+        assert [entity_shard(f"user{u}", 2) for u in range(6)] \
+            == [0, 1, 0, 1, 1, 1]
+
+    def test_deterministic_across_calls(self):
+        for k in (1, 2, 5, 16):
+            ids = [f"e{i}" for i in range(200)]
+            assert [entity_shard(e, k) for e in ids] \
+                == [entity_shard(e, k) for e in ids]
+
+    def test_partition_is_disjoint_and_exhaustive(self):
+        # every entity owned by exactly one shard, all in range
+        for k in (1, 2, 3, 8):
+            owners = {e: entity_shard(e, k)
+                      for e in (f"id{i}" for i in range(500))}
+            assert all(0 <= s < k for s in owners.values())
+        assert all(entity_shard(f"id{i}", 1) == 0 for i in range(50))
+
+    def test_split_is_roughly_balanced(self):
+        from collections import Counter
+        counts = Counter(entity_shard(f"user{u}", 4)
+                         for u in range(512))
+        assert set(counts) == {0, 1, 2, 3}
+        assert min(counts.values()) > 512 // 4 // 2
+
+    def test_int_and_str_ids_agree(self):
+        assert entity_shard(123, 4) == entity_shard("123", 4)
+
+
+class TestEntityOfRow:
+    def test_route_key_reads_metadata_map_first(self):
+        row = {"uid": "u", "memberId": "top",
+               "metadataMap": {"memberId": "m7", "userId": "u3"}}
+        assert entity_of_row(row, "memberId") == "m7"
+
+    def test_route_key_falls_back_to_top_level(self):
+        assert entity_of_row({"memberId": "top"}, "memberId") == "top"
+
+    def test_missing_route_key_is_empty_not_uid(self):
+        # a configured key that the row lacks must NOT silently fall
+        # back to another id — that would split one entity's rows
+        assert entity_of_row({"uid": "x", "metadataMap": {}},
+                             "memberId") == ""
+
+    def test_default_is_first_metadata_key_sorted(self):
+        row = {"metadataMap": {"z": "last", "a": "first"}}
+        assert entity_of_row(row) == "first"
+
+    def test_uid_fallback_for_entityless_rows(self):
+        assert entity_of_row({"uid": "row9"}) == "row9"
+        assert entity_of_row({}) == ""
+
+
+# ---------------------------------------------------------------------------
+# health state machine (no sockets — thresholds are failure counts)
+# ---------------------------------------------------------------------------
+
+
+def _fleet(n=2, **kw) -> Fleet:
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("health", HealthPolicy(suspect_after=1, dead_after=3))
+    return Fleet([f"unix:/tmp/fleet-test-m{k}.sock" for k in range(n)],
+                 **kw)
+
+
+class TestHealthMachine:
+    def test_thresholds_healthy_suspect_dead(self):
+        f = _fleet()
+        m = f.members[0]
+        m.state, m.failures = "healthy", 0
+        f._record_failure(m)
+        assert m.state == "suspect"
+        f._record_failure(m)
+        assert m.state == "suspect"
+        f._record_failure(m)
+        assert m.state == "dead"
+        assert f._registry.counter("serve_fleet_events").value(
+            event="dead") == 1
+
+    def test_any_success_resets_suspect_to_healthy(self):
+        f = _fleet()
+        m = f.members[0]
+        m.state, m.failures = "suspect", 2
+        f._record_success(m)
+        assert m.state == "healthy" and m.failures == 0
+
+    def test_success_cannot_revive_a_dead_member(self):
+        # only a verified hello re-admits — a stray late reply must not
+        f = _fleet()
+        m = f.members[0]
+        m.state = "dead"
+        f._record_success(m)
+        assert m.state == "dead"
+
+    def test_member_state_gauge_tracks_transitions(self):
+        f = _fleet(n=3)
+        g = f._registry.gauge("serve_fleet_members")
+        assert g.value(state="dead") == 3  # boot: nothing admitted yet
+        for m in f.members:
+            m.state = "healthy"
+        f._record_failure(f.members[0])
+        assert g.value(state="suspect") == 1
+        assert g.value(state="healthy") == 2
+
+
+class TestDegradedMode:
+    def test_dark_shard_sheds_typed_not_hangs(self):
+        f = _fleet()  # both members boot dead: every shard is dark
+        t0 = time.monotonic()
+        with pytest.raises(ShardUnavailableError, match="no live"):
+            f.dispatch(0, {"kind": "score", "id": "r", "rows": []})
+        assert time.monotonic() - t0 < 1.0
+        assert f._registry.counter("serve_route").value(
+            outcome="shed") == 1
+
+    def test_unconnectable_members_fail_typed_and_feed_the_machine(self):
+        # healthy-but-unconnected members: retries exhaust, both hops
+        # fail, the dispatch raises OSError (→ typed error reply) and
+        # each hop's failure feeds the health machine
+        f = _fleet()
+        for m in f.members:
+            m.state = "healthy"
+        with pytest.raises(OSError, match="every route attempt"):
+            f.dispatch(0, {"kind": "score", "id": "r", "rows": []})
+        route = f._registry.counter("serve_route").by_label("outcome")
+        assert route.get("error") == 1
+        assert route.get("member_failed") == 2
+        assert route.get("failover") == 1
+        assert all(m.failures == 1 for m in f.members)
+        assert f.inflight_count() == 0  # nothing leaks on failure
+
+    def test_ledger_accounts_every_dispatch(self):
+        f = _fleet()
+        for _ in range(3):
+            with pytest.raises(ShardUnavailableError):
+                f.dispatch(1, {"kind": "score", "id": "r", "rows": []})
+        route = f._registry.counter("serve_route").by_label("outcome")
+        answered = (route.get("ok", 0) + route.get("error", 0)
+                    + route.get("shed", 0))
+        assert answered == 3  # ok + error + shed == every dispatch
+
+
+class TestRouteChain:
+    def test_owner_then_fallback_skipping_dead(self):
+        f = _fleet(n=3)
+        for m in f.members:
+            m.state = "healthy"
+        assert [m.index for m in f.route_chain(0)] == [0, 1]
+        f.members[0].state = "dead"
+        assert [m.index for m in f.route_chain(0)] == [1]
+        f.members[1].state = "dead"
+        assert f.route_chain(0) == []
+
+    def test_single_member_fleet_has_no_fallback_hop(self):
+        f = _fleet(n=1)
+        f.members[0].state = "healthy"
+        assert [m.index for m in f.route_chain(0)] == [0]
+
+
+# ---------------------------------------------------------------------------
+# subprocess: generation-checked admission
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_fixture(tmp_path_factory):
+    """Model dir + request rows + the single-process reference scores
+    the fleet must reproduce bit-exactly. The reference comes from a
+    real serve subprocess (production dtype config — conftest's
+    ``jax_enable_x64`` would skew an in-process reference)."""
+    root = str(tmp_path_factory.mktemp("fleet_e2e"))
+    model_dir = _build_model_dir(root)
+    records = _make_records()
+    proc, endpoint = _spawn_serve(_serve_args(
+        model_dir, f"unix:{root}/ref.sock", f"{root}/ref-trace"))
+    try:
+        with ServeClient(endpoint) as client:
+            resp = client.score(records)
+        assert resp["kind"] == "scores", resp
+        ref = np.asarray(resp["scores"], np.float64)
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    return {"root": root, "model_dir": model_dir, "records": records,
+            "ref": ref}
+
+
+class TestGenerationCheckedAdmission:
+    def test_stale_model_id_is_refused_until_it_catches_up(
+            self, fleet_fixture, tmp_path):
+        proc, endpoint = _spawn_serve(_serve_args(
+            fleet_fixture["model_dir"], "unix:" + str(tmp_path / "m.sock"),
+            str(tmp_path / "trace")))
+        try:
+            f = Fleet([endpoint], registry=MetricsRegistry(),
+                      member_timeout=10.0)
+            # the fleet is live on another model generation: the
+            # relaunched member's verified hello must be REFUSED, not
+            # admitted into a split fleet
+            f._live_model_id = "model-v2"
+            with pytest.raises(FleetAdmissionError,
+                               match="re-admission refused"):
+                f.admit(f.members[0])
+            assert f.members[0].state == "dead"
+            assert f._registry.counter("serve_fleet_events").value(
+                event="admitted") == 0
+            # once the fleet identity matches, the same member admits
+            f._live_model_id = None
+            f.admit(f.members[0])
+            assert f.members[0].state == "healthy"
+            assert f.members[0].model_id is not None
+            assert len(f.members[0].clients) == f._connections
+            f.close()
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# e2e: kill a member mid-load — no black holes
+# ---------------------------------------------------------------------------
+
+
+def _spawn_router(members, listen, trace):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serve.router",
+         "--listen", listen, "--members", ",".join(members),
+         "--route-id", "userId", "--heartbeat-seconds", "0.1",
+         "--suspect-after", "1", "--dead-after", "3",
+         "--member-timeout", "15",
+         "--trace-dir", trace, "--trace-heartbeat-seconds", "0.2"],
+        env=_subprocess_env(), cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("PHOTON_SERVE ready endpoint="):
+        proc.kill()
+        _, err = proc.communicate()
+        raise RuntimeError(f"router not ready: {line!r}\n{err[-2000:]}")
+    return proc, line.split("endpoint=", 1)[1]
+
+
+class TestFleetEndToEnd:
+    def test_no_black_hole_acceptance(self, fleet_fixture, tmp_path):
+        """Concurrent load over 4 members bit-identical to the shared
+        scoring core; SIGKILL of member 1 mid-load answers EVERY
+        request (request-id accounting, zero silent drops), shard-1
+        traffic fails over to its ring-successor fallback, swap is
+        refused typed, SIGTERM drains to rc 75."""
+        records = fleet_fixture["records"]
+        ref = fleet_fixture["ref"]
+        members, endpoints = [], []
+        router = None
+        try:
+            for k in range(4):
+                proc, ep = _spawn_serve(_serve_args(
+                    fleet_fixture["model_dir"],
+                    "unix:" + str(tmp_path / f"m{k}.sock"),
+                    str(tmp_path / f"m{k}")))
+                members.append(proc)
+                endpoints.append(ep)
+            router, endpoint = _spawn_router(
+                endpoints, "unix:" + str(tmp_path / "r.sock"),
+                str(tmp_path / "router"))
+
+            # 1. warm sanity: fleet scores ARE the single-process bits
+            with ServeClient(endpoint) as client:
+                resp = client.score(records)
+            assert resp["kind"] == "scores"
+            np.testing.assert_array_equal(
+                np.asarray(resp["scores"], np.float64), ref)
+
+            # 2. swap through the router is refused with a typed error
+            with ServeClient(endpoint) as client:
+                refusal = client.swap(fleet_fixture["model_dir"])
+            assert isinstance(typed_error(refusal),
+                              ModelSwapRefusedError)
+            with ServeClient(endpoint, raise_errors=True) as client:
+                with pytest.raises(ModelSwapRefusedError):
+                    client.swap(fleet_fixture["model_dir"])
+
+            # 3. SIGKILL member 1 mid-concurrent-load: request-id
+            # accounting proves zero black holes
+            ledger = {"submitted": 0, "scores": 0, "typed_errors": 0,
+                      "silent": 0, "not_bit_exact": 0}
+            llock = threading.Lock()
+            kill_at = threading.Barrier(4)
+
+            def load_loop(worker: int) -> None:
+                with ServeClient(endpoint, timeout=60) as client:
+                    kill_at.wait(timeout=30)
+                    for i in range(6):
+                        rid = f"w{worker}r{i}"
+                        with llock:
+                            ledger["submitted"] += 1
+                        try:
+                            resp = client.request(
+                                {"kind": "score", "id": rid,
+                                 "rows": records})
+                        except (ConnectionError, OSError):
+                            with llock:
+                                ledger["silent"] += 1
+                            return
+                        with llock:
+                            if resp.get("id") != rid:
+                                ledger["silent"] += 1
+                            elif resp.get("kind") == "scores":
+                                ledger["scores"] += 1
+                                if not np.array_equal(
+                                        np.asarray(resp["scores"],
+                                                   np.float64), ref):
+                                    ledger["not_bit_exact"] += 1
+                            elif resp.get("error"):
+                                ledger["typed_errors"] += 1
+                            else:
+                                ledger["silent"] += 1
+
+            workers = [threading.Thread(target=load_loop, args=(w,))
+                       for w in range(3)]
+            for t in workers:
+                t.start()
+            kill_at.wait(timeout=30)  # all loaders at the gate
+            members[1].kill()  # mid-load, no drain
+            for t in workers:
+                t.join(timeout=120)
+            assert ledger["silent"] == 0, ledger
+            assert ledger["scores"] + ledger["typed_errors"] \
+                == ledger["submitted"], ledger
+            assert ledger["not_bit_exact"] == 0, ledger
+            assert ledger["scores"] > 0, ledger
+
+            # 4. the dead member is marked, the survivors carry every
+            # shard — full-fixture requests still answer bit-exactly
+            deadline = time.monotonic() + 30
+            states = {}
+            while time.monotonic() < deadline:
+                with ServeClient(endpoint) as client:
+                    snap = client.stats()["fleet"]
+                states = {m["member"]: m["state"]
+                          for m in snap["members"]}
+                if states.get(1) == "dead":
+                    break
+                time.sleep(0.1)
+            assert states == {0: "healthy", 1: "dead",
+                              2: "healthy", 3: "healthy"}
+            with ServeClient(endpoint) as client:
+                resp = client.score(records)
+            assert resp["kind"] == "scores"
+            np.testing.assert_array_equal(
+                np.asarray(resp["scores"], np.float64), ref)
+
+            # 5. the route ledger balances: every routed sub-request
+            # resolved as ok, shed, or error — nothing vanished
+            with ServeClient(endpoint) as client:
+                route = client.stats()["route"]
+            assert route.get("ok", 0) > 0
+            assert not route.get("shed")
+
+            # 6. SIGTERM drains and exits with the preempted rc
+            router.send_signal(signal.SIGTERM)
+            assert router.wait(timeout=60) == PREEMPTED_EXIT
+            router = None
+        finally:
+            for proc in members + ([router] if router else []):
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=30)

@@ -328,6 +328,102 @@ class TestTieredCoefficientStore:
         assert reg.counter("serve_tier_evict").value(
             coordinate="c", tier="host") == 2
 
+    def test_device_bytes_gauge_survives_release_and_rewarm(self):
+        """The ``serve_tier_device_bytes`` gauge must round-trip
+        release() → re-warm without drifting — each cycle once added
+        the block twice (the hot-swap retire/rollback path)."""
+        m = _tier_model(n=12, d=3)  # row_bytes = 12
+        reg = MetricsRegistry()
+        cap_bytes = 4 * 12
+        g = reg.gauge("serve_tier_device_bytes")
+        store = TieredCoefficientStore("c", m,
+                                       hbm_budget_bytes=cap_bytes,
+                                       registry=reg)
+        assert g.value(coordinate="c") == cap_bytes
+        store.release()
+        assert g.value(coordinate="c") == 0
+        assert store.stats()["device_bytes"] == 0
+        store.lookup(_ids(0, 1))  # rollback re-warm on demand
+        assert g.value(coordinate="c") == cap_bytes
+        # a second full cycle lands on the same values, not 2×
+        store.release()
+        assert g.value(coordinate="c") == 0
+        store.lookup(_ids(2, 3))
+        assert g.value(coordinate="c") == cap_bytes
+        assert store.stats()["device_bytes"] == cap_bytes
+
+    def test_device_bytes_gauge_sums_overlapping_stores(self):
+        """Two generations' stores on one coordinate (swap probation)
+        both hold device rows; the gauge is the SUM, and releasing one
+        leaves the other's bytes standing."""
+        reg = MetricsRegistry()
+        a = TieredCoefficientStore("c", _tier_model(n=12, d=3),
+                                   hbm_budget_bytes=4 * 12,
+                                   registry=reg)
+        b = TieredCoefficientStore("c", _tier_model(n=12, d=3, seed=5),
+                                   hbm_budget_bytes=2 * 12,
+                                   registry=reg)
+        g = reg.gauge("serve_tier_device_bytes")
+        assert g.value(coordinate="c") == 4 * 12 + 2 * 12
+        a.release()
+        assert g.value(coordinate="c") == 2 * 12
+        b.release()
+        assert g.value(coordinate="c") == 0
+
+
+# ---------------------------------------------------------------------------
+# typed client-side errors (the wire grammar's exception view)
+# ---------------------------------------------------------------------------
+
+
+class TestTypedErrors:
+    def test_non_error_responses_parse_to_none(self):
+        from photon_ml_tpu.serve.protocol import typed_error
+        assert typed_error({"kind": "scores", "scores": []}) is None
+        assert typed_error({"kind": "pong"}) is None
+
+    def test_shed_grammar_parses_to_shed_error(self):
+        from photon_ml_tpu.serve.protocol import ShedError, typed_error
+        err = typed_error({"kind": "error", "error": "shed:queue_full"})
+        assert isinstance(err, ShedError)
+        assert err.reason == "queue_full"
+
+    def test_shard_unavailable_parses_typed(self):
+        from photon_ml_tpu.serve.protocol import (
+            ShardUnavailableError, typed_error)
+        err = typed_error(
+            {"kind": "error",
+             "error": "ShardUnavailableError: shard 2 has no live "
+                      "member (owner and fallback are dead)"})
+        assert isinstance(err, ShardUnavailableError)
+
+    def test_swap_refusal_parses_typed_from_swap_result(self):
+        from photon_ml_tpu.serve.protocol import (
+            ModelSwapRefusedError, typed_error)
+        err = typed_error(
+            {"kind": "swap_result", "outcome": "refused",
+             "error": "ModelSwapRefusedError: canary diverged"})
+        assert isinstance(err, ModelSwapRefusedError)
+        assert err.reason == "canary diverged"
+
+    def test_unknown_error_shapes_land_on_the_base(self):
+        from photon_ml_tpu.serve.protocol import (
+            ServeRequestError, ShedError, typed_error)
+        err = typed_error({"kind": "error",
+                           "error": "TypeError: row 3 is not an object"})
+        assert isinstance(err, ServeRequestError)
+        assert not isinstance(err, ShedError)
+        assert "row 3" in err.message
+
+    def test_every_typed_error_catches_as_the_base(self):
+        from photon_ml_tpu.serve.protocol import (
+            ModelSwapRefusedError, ServeRequestError,
+            ShardUnavailableError, ShedError)
+        for exc in (ShedError("queue_full"),
+                    ShardUnavailableError("dark"),
+                    ModelSwapRefusedError("refused")):
+            assert isinstance(exc, ServeRequestError)
+
 
 # ---------------------------------------------------------------------------
 # ServingScorer (in-process)

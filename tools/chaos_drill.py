@@ -366,6 +366,35 @@ def build_cells(smoke: bool) -> list[CellDef]:
                   "the relaunch serves exactly one consistent "
                   "generation (the boot model) bit-exact; stop-file "
                   "drains the supervisor to done"),
+        # --- scorer fleet: serve.route fires in the MEMBER process on
+        # --- routed sub-requests (tag = fleet index), so what's
+        # --- drilled is the ROUTER's machinery — bounded retry,
+        # --- failover to the shard's fallback member, typed shed —
+        # --- and its no-black-hole ledger --------------------------
+        cell("serve.route", "io_error", "serve.route@1=io_error:1",
+             "ok", serve=True, variant="fleet",
+             note="member 1's routed sub-request EIOs once: retried "
+                  "on the same member (budget spent), the request "
+                  "answers bit-exact, no failover needed"),
+        cell("serve.route", "flaky", "serve.route@1=flaky:6:0.5",
+             "ok", serve=True, variant="fleet",
+             note="seeded flaky member: flaky sub-requests retried "
+                  "(or failed over), every request answered "
+                  "bit-exact, zero typed errors"),
+        cell("serve.route", "slow", "serve.route@1=slow:2:0.05",
+             "ok", serve=True, variant="fleet",
+             note="a slow member stalls well inside the router's "
+                  "member timeout: requests complete bit-exact, "
+                  "nothing sheds"),
+        cell("serve.route", "kill",
+             f"serve.route@1=kill:1:{KILL_EXIT}", "killed",
+             serve=True, variant="fleet", smoke_cell=True,
+             note="the no-black-hole drill: member 1 dies mid-request "
+                  "under photon_supervise --fleet; every submitted "
+                  "request is answered (request-id accounting — "
+                  "scores or a typed error, zero silent drops), "
+                  "answered scores bit-exact, and the relaunched "
+                  "member re-admits onto the live generation"),
     ]
     if smoke:
         cells = [c for c in cells if c["smoke"]]
@@ -895,6 +924,11 @@ def _run_serve_cell(c: CellDef, workdir: str) -> dict:
     records = fix["records"]
     expected = c["expected"]
 
+    if c["point"] == "serve.route":
+        if expected == "killed":
+            return _run_fleet_kill_cell(c, name, fix, cell_dir,
+                                        failures, t0)
+        return _run_fleet_cell(c, name, fix, cell_dir, failures, t0)
     if c["point"] in ("serve.model_load", "serve.swap"):
         if expected == "killed":
             return _run_serve_swap_kill_cell(c, name, fix, cell_dir,
@@ -1036,6 +1070,271 @@ def _run_serve_kill_cell(c: CellDef, name: str, fix: dict, cell_dir: str,
     if "Traceback (most recent call last)" in err:
         failures.append("stack-trace crash:\n" + err[-2000:])
     _check_trace_survives(trace, failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def _spawn_fleet_router(members: list[str], listen: str, trace: str,
+                        extra_env: dict | None = None):
+    """Start the fleet router subprocess, wait for its ready line
+    (printed only after every reachable member admitted)."""
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serve.router",
+         "--listen", listen, "--members", ",".join(members),
+         "--route-id", "userId", "--heartbeat-seconds", "0.1",
+         "--trace-dir", trace, "--trace-heartbeat-seconds", "0.2"],
+        env=env, cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("PHOTON_SERVE ready endpoint="):
+        proc.kill()
+        _, err = proc.communicate()
+        raise RuntimeError(
+            f"fleet router never became ready: {line!r}\n{err[-2000:]}")
+    return proc, line.split("endpoint=", 1)[1]
+
+
+def _run_fleet_cell(c: CellDef, name: str, fix: dict, cell_dir: str,
+                    failures: list[str], t0: float) -> dict:
+    """serve.route ok-mode cells: a 2-member fleet behind the router;
+    the fault fires in member 1 on routed sub-requests. The invariant
+    is the no-black-hole ledger — every request answered with real
+    scores (retry/failover absorb the fault), zero typed errors, zero
+    sheds, bit-exact against the shared batch scoring core."""
+    import numpy as np
+
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    env = {"PHOTON_FAULTS": c["spec"],
+           "PHOTON_FAULTS_STATE_DIR": os.path.join(cell_dir,
+                                                   "fault_state"),
+           "PHOTON_FAULTS_SEED": "42"}
+    members, endpoints = [], []
+    router = None
+    rc = None
+    outcome = "?"
+    try:
+        for k in range(2):
+            proc, ep = _spawn_serve(serve_args(
+                fix["model_dir"],
+                "unix:" + os.path.join(cell_dir, f"m{k}.sock"),
+                os.path.join(cell_dir, f"member{k}")), extra_env=env)
+            members.append(proc)
+            endpoints.append(ep)
+        router, endpoint = _spawn_fleet_router(
+            endpoints, "unix:" + os.path.join(cell_dir, "router.sock"),
+            os.path.join(cell_dir, "router"), extra_env=env)
+        answered = 0
+        with ServeClient(endpoint) as client:
+            for i in range(6):
+                resp = client.score(fix["records"])
+                if resp.get("kind") != "scores":
+                    failures.append(f"request {i} not answered with "
+                                    f"scores: {str(resp)[:200]}")
+                    continue
+                answered += 1
+                if not np.array_equal(
+                        np.asarray(resp["scores"], np.float64),
+                        fix["ref"]):
+                    failures.append(f"request {i} NOT bit-exact vs "
+                                    f"the shared batch scoring core")
+            route = client.stats().get("route") or {}
+        for bad in ("error", "shed"):
+            if route.get(bad):
+                failures.append(f"route ledger shows {bad}="
+                                f"{route[bad]} — the fault must be "
+                                f"absorbed by retry/failover")
+        router.terminate()
+        rc = router.wait(timeout=90)
+        if rc != PREEMPTED_EXIT:
+            failures.append(f"router SIGTERM drain must exit "
+                            f"rc={PREEMPTED_EXIT}, got rc={rc}")
+        outcome = f"absorbed(answered={answered}, route={route})"
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"fleet cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        err = ""
+        if router is not None:
+            if router.poll() is None:
+                router.kill()
+            _, err = router.communicate()
+        for proc in members:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+    if "Traceback (most recent call last)" in err:
+        failures.append("router stack-trace crash:\n" + err[-2000:])
+    _check_trace_survives(os.path.join(cell_dir, "router"), failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def _run_fleet_kill_cell(c: CellDef, name: str, fix: dict,
+                         cell_dir: str, failures: list[str],
+                         t0: float) -> dict:
+    """The fleet no-black-hole drill: photon_supervise --fleet runs 4
+    members + the router; the injected kill (budget claimed once via
+    PHOTON_FAULTS_STATE_DIR) drops member 1 mid-request under
+    concurrent load. Request-id accounting proves zero silent drops:
+    every submitted request gets a reply carrying its own id — real
+    scores (bit-exact) or a typed error. The relaunched member must
+    re-admit onto the live generation, and a stop-file drains the
+    supervisor to PHOTON_SUPERVISE_OK."""
+    import threading
+
+    import numpy as np
+
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    stop_file = os.path.join(cell_dir, "stop")
+    fleet_dir = os.path.join(cell_dir, "fleet")
+    rsock = os.path.join(cell_dir, "router.sock")
+    env = dict(os.environ)
+    env.pop("PHOTON_FAULTS", None)
+    env.pop("PHOTON_FAULTS_STATE_DIR", None)
+    env.update({
+        "PHOTON_FAULTS": c["spec"],
+        "PHOTON_FAULTS_STATE_DIR": os.path.join(cell_dir,
+                                                "fault_state"),
+        "PHOTON_FAULTS_SEED": "42",
+    })
+    sup = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "photon_supervise.py"),
+         "--fleet", "4", "--fleet-dir", fleet_dir,
+         "--router-listen", "unix:" + rsock,
+         "--stop-file", stop_file,
+         "--backoff-base", "0.2", "--poll-seconds", "0.1", "--",
+         "--game-model-input-dir", fix["model_dir"],
+         "--feature-shard-id-to-feature-section-keys-map",
+         "global:globalFeatures|user:userFeatures",
+         "--random-effect-id-set", "userId",
+         "--max-batch-rows", "64",
+         "--trace-heartbeat-seconds", "0.2"],
+        env=env, cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    rc = None
+    outcome = "?"
+    ledger = {"submitted": 0, "scores": 0, "typed_errors": 0,
+              "silent": 0, "not_bit_exact": 0}
+    llock = threading.Lock()
+    try:
+        # wait for the router with rows member 1 does NOT own, so the
+        # warm-up cannot consume the kill budget — member 1 dies later,
+        # mid-request, under the concurrent load below
+        from photon_ml_tpu.serve.fleet import entity_shard
+        warm = [r for r in fix["records"]
+                if entity_shard(r["metadataMap"]["userId"], 4) != 1]
+        _serve_score_retry("unix:" + rsock, warm[:2],
+                           deadline_secs=150)
+
+        def load_loop(worker: int) -> None:
+            with ServeClient("unix:" + rsock, timeout=60) as client:
+                for i in range(8):
+                    rid = f"w{worker}r{i}"
+                    with llock:
+                        ledger["submitted"] += 1
+                    try:
+                        resp = client.request(
+                            {"kind": "score", "id": rid,
+                             "rows": fix["records"]})
+                    except (ConnectionError, OSError):
+                        with llock:
+                            ledger["silent"] += 1
+                        return
+                    with llock:
+                        if resp.get("id") != rid:
+                            ledger["silent"] += 1
+                        elif resp.get("kind") == "scores":
+                            ledger["scores"] += 1
+                            if not np.array_equal(
+                                    np.asarray(resp["scores"],
+                                               np.float64),
+                                    fix["ref"]):
+                                ledger["not_bit_exact"] += 1
+                        elif resp.get("error"):
+                            ledger["typed_errors"] += 1
+                        else:
+                            ledger["silent"] += 1
+
+        workers = [threading.Thread(target=load_loop, args=(w,))
+                   for w in range(3)]
+        for th in workers:
+            th.start()
+        for th in workers:
+            th.join(timeout=120)
+        if ledger["silent"]:
+            failures.append(f"{ledger['silent']} request(s) "
+                            f"black-holed: {ledger}")
+        if ledger["scores"] + ledger["typed_errors"] \
+                != ledger["submitted"]:
+            failures.append(f"request-id accounting does not balance: "
+                            f"{ledger}")
+        if ledger["not_bit_exact"]:
+            failures.append(f"{ledger['not_bit_exact']} answered "
+                            f"request(s) NOT bit-exact vs the shared "
+                            f"batch scoring core")
+
+        # the relaunched member must RE-ADMIT onto the live generation
+        deadline = time.monotonic() + 90
+        states: dict = {}
+        model_ids: set = set()
+        while time.monotonic() < deadline:
+            try:
+                with ServeClient("unix:" + rsock, timeout=30) as cl:
+                    fleet_stats = cl.stats().get("fleet") or {}
+                ms = fleet_stats.get("members") or []
+                states = {m["member"]: m["state"] for m in ms}
+                model_ids = {m["model_id"] for m in ms
+                             if m["model_id"] is not None}
+                if ms and all(m["state"] == "healthy" for m in ms):
+                    break
+            except (ConnectionError, OSError):
+                pass
+            time.sleep(0.3)
+        if not states or any(s != "healthy" for s in states.values()):
+            failures.append(f"killed member never re-admitted: "
+                            f"states={states}")
+        if len(model_ids) > 1:
+            failures.append(f"SPLIT FLEET: members serve "
+                            f"{sorted(model_ids)}")
+        with open(stop_file, "w") as fh:
+            fh.write("chaos cell done\n")
+        rc = sup.wait(timeout=120)
+        outcome = (f"killed+relaunched(answered="
+                   f"{ledger['scores']}+{ledger['typed_errors']}e"
+                   f"/{ledger['submitted']})")
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"fleet kill cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+        out, err = sup.communicate()
+    if rc != 0:
+        failures.append(f"fleet supervisor must finish rc=0 after the "
+                        f"stop-file drain, got rc={rc}:\n{err[-1500:]}")
+    elif "PHOTON_SUPERVISE_OK" not in out:
+        failures.append(f"no PHOTON_SUPERVISE_OK line: {out[-400:]!r}")
+    elif "relaunch_member" not in out:
+        failures.append("supervisor log shows no member relaunch — "
+                        "the injected kill never cost a member")
+    if "Traceback (most recent call last)" in err:
+        failures.append("stack-trace crash:\n" + err[-2000:])
+    _check_trace_survives(os.path.join(fleet_dir, "router"), failures)
     return {"cell": name, "spec": c["spec"], "expected": c["expected"],
             "rc": rc, "outcome": outcome, "note": c["note"],
             "seconds": round(time.monotonic() - t0, 1),

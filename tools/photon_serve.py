@@ -15,7 +15,7 @@ section for the operational recipe). Equivalent module form — the one
         --trace-dir out/serve-trace \
         --telemetry-endpoint 127.0.0.1:9090
 
-One control verb rides the same script — ``swap`` asks a RUNNING
+Two control verbs ride the same script. ``swap`` asks a RUNNING
 service to hot-swap to a retrained model (load + shadow-scoring
 canary + atomic generation flip; see the README)::
 
@@ -24,6 +24,14 @@ canary + atomic generation flip; see the README)::
 
 It blocks until the swap resolves, prints the ``swap_result`` JSON,
 and exits 0 on ``ok`` / 1 on ``refused``.
+
+``fleet`` runs the entity-sharded front-end router over N already
+running members (``photon_ml_tpu.serve.router`` — see the README
+"Serving" fleet section for health thresholds and failover
+semantics)::
+
+    tools/photon_serve.py fleet --listen 127.0.0.1:7440 \
+        --members unix:/run/m0.sock,unix:/run/m1.sock
 """
 
 from __future__ import annotations
@@ -69,4 +77,7 @@ def swap_main(argv) -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "swap":
         sys.exit(swap_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        from photon_ml_tpu.serve.router import main as fleet_main
+        sys.exit(fleet_main(sys.argv[2:]))
     main()

@@ -542,6 +542,138 @@ def format_status(status: dict, source: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Fleet aggregation
+# ---------------------------------------------------------------------------
+
+_MEMBER_DIR_RE = re.compile(r"^member(\d+)$")
+
+
+def compute_fleet(fleet_dir: str) -> dict:
+    """Fold a ``photon_supervise --fleet`` directory (``member<k>/``
+    telemetry dirs plus an optional ``router/``) into one fleet-status
+    document: per-member serving rows, the aggregate line, and the
+    scripting verdict — a single stalled member makes the whole fleet
+    exit :data:`EXIT_STALLED`, because a stalled member is exactly the
+    black-hole risk the router's health machine exists to contain."""
+    members = []
+    router = None
+    try:
+        names = sorted(os.listdir(fleet_dir))
+    except OSError:
+        names = []
+    for name in names:
+        path = os.path.join(fleet_dir, name)
+        if not os.path.isdir(path):
+            continue
+        m = _MEMBER_DIR_RE.match(name)
+        if m:
+            members.append((int(m.group(1)), path))
+        elif name == "router":
+            router = path
+
+    def summarize(role, path) -> dict:
+        status = compute_status(read_run_dir(path))
+        # a member/router is one process; fold the (rare) multi-proc
+        # case by taking the worst state and summing the serving rows
+        states = [p["state"] for p in status["processes"].values()] \
+            or ["no_data"]
+        rank = {"no_data": 0, "finished": 1, "running": 2,
+                "preempted": 3, "stalled": 4, "aborted": 5}
+        serving = next(
+            (p["serving"] for _, p in sorted(status["processes"].items())
+             if p.get("serving")), None) or {}
+        return {
+            "member": role,
+            "state": max(states, key=lambda s: rank[s]),
+            "stalled": any(p["stalled"]
+                           for p in status["processes"].values()),
+            "qps": serving.get("qps"),
+            "p99_ms": serving.get("p99_ms"),
+            "rows_scored": serving.get("rows_scored"),
+            "tier_hits": serving.get("tier_hits"),
+            "shed": serving.get("shed"),
+            "generation": serving.get("generation"),
+            "model_id": serving.get("model_id"),
+        }
+
+    fleet = [summarize(k, path) for k, path in sorted(members)]
+    router_row = summarize("router", router) if router else None
+    rows = fleet + ([router_row] if router_row else [])
+    generations = sorted({r["generation"] for r in fleet
+                          if r["generation"] is not None})
+    agg = {
+        "members": len(fleet),
+        "live": sum(1 for r in fleet
+                    if r["state"] in ("running", "finished")),
+        "qps": sum(r["qps"] or 0.0 for r in fleet),
+        "rows_scored": sum(r["rows_scored"] or 0 for r in fleet),
+        "tier_hits": sum(r["tier_hits"] or 0 for r in fleet),
+        "shed": sum(r["shed"] or 0 for r in fleet),
+        "p99_ms": max((r["p99_ms"] for r in fleet
+                       if r["p99_ms"] is not None), default=None),
+        # >1 live generation = a split fleet — exactly what the
+        # router's generation-checked re-admission prevents
+        "generations": generations,
+    }
+    if not rows:
+        status, exit_code = "no_data", EXIT_NO_DATA
+    elif any(r["stalled"] for r in rows):
+        status, exit_code = "stalled", EXIT_STALLED
+    elif any(r["state"] == "aborted" for r in rows):
+        status, exit_code = "aborted", EXIT_ABORTED
+    elif all(r["state"] == "no_data" for r in rows):
+        status, exit_code = "no_data", EXIT_NO_DATA
+    else:
+        status, exit_code = "running", EXIT_HEALTHY
+        if all(r["state"] in ("finished", "no_data") for r in rows):
+            status = "finished"
+    return {
+        "kind": "fleet_status",
+        "status": status,
+        "exit_code": exit_code,
+        "aggregate": agg,
+        "router": router_row,
+        "fleet": fleet,
+    }
+
+
+def format_fleet(status: dict, source: str) -> str:
+    agg = status["aggregate"]
+    lines = [f"photon-top --fleet — {source}: "
+             f"{status['status'].upper()} "
+             f"({agg['live']}/{agg['members']} member(s) live)"]
+    header = (f"{'member':>7} {'state':<9} {'gen':>4} "
+              f"{'model':<12} {'qps':>8} {'p99_ms':>7} "
+              f"{'rows':>9} {'tier_hits':>9} {'shed':>5} "
+              f"{'stalled':>7}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    rows = list(status["fleet"])
+    if status.get("router"):
+        rows.append(status["router"])
+    for r in rows:
+        lines.append(
+            f"{str(r['member']):>7} {r['state']:<9} "
+            f"{r['generation'] if r['generation'] is not None else '—':>4} "
+            f"{str(r['model_id'] or '—')[:12]:<12} "
+            f"{r['qps'] if r['qps'] is not None else 0:>8.1f} "
+            f"{r['p99_ms'] if r['p99_ms'] is not None else 0:>7.1f} "
+            f"{r['rows_scored'] or 0:>9.0f} "
+            f"{r['tier_hits'] or 0:>9.0f} "
+            f"{r['shed'] or 0:>5.0f} "
+            f"{'YES' if r['stalled'] else 'no':>7}")
+    gens = agg["generations"]
+    lines.append(
+        f"  aggregate: qps={agg['qps']:.1f} "
+        f"p99={agg['p99_ms'] if agg['p99_ms'] is not None else 0:.1f}ms "
+        f"rows={agg['rows_scored']:.0f} "
+        f"tier_hits={agg['tier_hits']:.0f} shed={agg['shed']:.0f} "
+        f"generations={','.join(str(g) for g in gens) or '—'}"
+        f"{' SPLIT-FLEET' if len(gens) > 1 else ''}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -570,7 +702,28 @@ def main(argv=None) -> int:
                    help="gang-level aggregate view: min/max per-process "
                         "sweep and sweep_skew over a merged multi-host "
                         "run dir")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet aggregate view over a photon_supervise "
+                        "--fleet directory (--run-dir points at the "
+                        "--fleet-dir): per-member qps/p99/generation/"
+                        "tier-hit rows + the aggregate line; exit 2 if "
+                        "ANY member is stalled")
     ns = p.parse_args(argv)
+
+    if ns.fleet:
+        if not ns.run_dir:
+            p.error("--fleet requires --run-dir (the --fleet-dir)")
+        source = f"fleet-dir {ns.run_dir}"
+        while True:
+            status = compute_fleet(ns.run_dir)
+            if ns.watch and not ns.json:
+                print("\x1b[2J\x1b[H", end="")  # clear, home
+            print(json.dumps(status, indent=1) if ns.json
+                  else format_fleet(status, source))
+            if not ns.watch or status["status"] in ("finished",
+                                                    "aborted"):
+                return status["exit_code"]
+            time.sleep(2.0)
 
     if ns.run_dir:
         source = f"run-dir {ns.run_dir}"

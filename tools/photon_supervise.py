@@ -76,6 +76,9 @@ PREEMPTED_EXIT = 75
 #: scoring service (``photon_ml_tpu.serve.service``) is the other
 #: in-tree citizen.
 TRAIN_MODULE = "photon_ml_tpu.cli.game_training_driver"
+#: Fleet mode's members and front end (``--fleet N``).
+SERVE_MODULE = "photon_ml_tpu.serve.service"
+ROUTER_MODULE = "photon_ml_tpu.serve.router"
 # the ladder: level 0 runs the operator's args untouched; each level
 # appends flags (argparse last-occurrence-wins, so appending overrides).
 # The flags are training-driver CD semantics — the ladder only engages
@@ -308,6 +311,149 @@ def supervise(driver_args: list[str], *, max_restarts: int = 5,
             collector.close()
 
 
+def supervise_fleet(member_args: list[str], *, fleet: int,
+                    fleet_dir: str, router_listen: str | None = None,
+                    max_restarts: int = 5, backoff_base: float = 0.5,
+                    backoff_max: float = 15.0,
+                    poll_seconds: float = 0.2,
+                    grace_seconds: float = 10.0,
+                    stop_file: str | None = None,
+                    python: str | None = None,
+                    module: str = SERVE_MODULE) -> int:
+    """Fleet mode: keep N scorer members (and optionally the fleet
+    router in front of them) alive. Member ``k`` listens on
+    ``unix:<fleet-dir>/member<k>.sock`` with its telemetry under
+    ``<fleet-dir>/member<k>/`` — the layout ``photon_status --fleet``
+    aggregates. A dead member is relaunched with per-member bounded
+    backoff; the router re-admits it only after a verified,
+    generation-checked hello (``serve/fleet.py``) — the supervisor
+    only supplies the process, never the trust. A ``--stop-file``
+    reaches every child, so one touch drains the whole fleet to exit
+    0. Exit codes match :func:`supervise`."""
+    from photon_ml_tpu.parallel.multihost import WorkerSupervisor
+
+    os.makedirs(fleet_dir, exist_ok=True)
+    record = Recorder(os.path.join(fleet_dir, "supervisor.jsonl"))
+    policy = WorkerSupervisor(
+        spawn=lambda attempt: None, max_restarts=max_restarts,
+        backoff_base_seconds=backoff_base,
+        backoff_max_seconds=backoff_max, name="photon-supervise-fleet")
+    env = dict(os.environ)
+    env["PHOTON_GAME_SUPERVISED"] = "1"
+    sockets = [os.path.join(fleet_dir, f"member{k}.sock")
+               for k in range(fleet)]
+    endpoints = [f"unix:{s}" for s in sockets]
+
+    def spawn_member(k: int) -> subprocess.Popen:
+        args = (list(member_args)
+                + ["--listen", endpoints[k],
+                   "--trace-dir", os.path.join(fleet_dir, f"member{k}")]
+                + (["--stop-file", stop_file] if stop_file else []))
+        record("launch_member", member=k, endpoint=endpoints[k])
+        return subprocess.Popen(
+            [python or sys.executable, "-m", module, *args], env=env)
+
+    def spawn_router() -> subprocess.Popen:
+        args = (["--listen", router_listen,
+                 "--members", ",".join(endpoints),
+                 "--trace-dir", os.path.join(fleet_dir, "router")]
+                + (["--stop-file", stop_file] if stop_file else []))
+        record("launch_router", endpoint=router_listen)
+        return subprocess.Popen(
+            [python or sys.executable, "-m", ROUTER_MODULE, *args],
+            env=env)
+
+    members: list[subprocess.Popen | None] = [spawn_member(k)
+                                              for k in range(fleet)]
+    router = spawn_router() if router_listen else None
+    restarts = [0] * fleet
+    router_restarts = 0
+    relaunch_at: dict[int, float] = {}  # member → earliest relaunch
+
+    def shutdown_all(procs) -> None:
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                _terminate_gracefully(proc, grace_seconds, record)
+
+    try:
+        while True:
+            time.sleep(poll_seconds)
+            now = time.monotonic()
+            for k in range(fleet):
+                proc = members[k]
+                if proc is not None and proc.poll() is not None:
+                    rc = proc.returncode
+                    record("member_exit", member=k, rc=rc,
+                           preempted=(rc == PREEMPTED_EXIT))
+                    members[k] = None
+                    if rc == 0:
+                        continue  # scheduled stop: done, not dead
+                    restarts[k] += 1
+                    if restarts[k] > max_restarts:
+                        record("abort", member=k,
+                               reason="member restart budget exhausted",
+                               restarts=restarts[k] - 1, last_rc=rc)
+                        print(f"PHOTON_SUPERVISE_EXHAUSTED member={k} "
+                              f"restarts={restarts[k] - 1} last_rc={rc}",
+                              file=sys.stderr, flush=True)
+                        shutdown_all(members + [router])
+                        return 1
+                    delay = policy.backoff_seconds(restarts[k])
+                    record("backoff", member=k, seconds=round(delay, 2),
+                           restart=restarts[k])
+                    relaunch_at[k] = now + delay
+                elif (proc is None and k in relaunch_at
+                        and now >= relaunch_at[k]):
+                    del relaunch_at[k]
+                    record("relaunch_member", member=k,
+                           restart=restarts[k])
+                    members[k] = spawn_member(k)
+            if router is not None and router.poll() is not None:
+                rc = router.returncode
+                record("router_exit", rc=rc,
+                       preempted=(rc == PREEMPTED_EXIT))
+                if rc == 0:
+                    shutdown_all(members)
+                    total = sum(restarts) + router_restarts
+                    record("done", restarts=total)
+                    print(f"PHOTON_SUPERVISE_OK restarts={total}",
+                          flush=True)
+                    return 0
+                if rc == CLEAN_ABORT_EXIT:
+                    record("abort", reason="router clean abort", rc=rc)
+                    shutdown_all(members)
+                    return CLEAN_ABORT_EXIT
+                router_restarts += 1
+                if router_restarts > max_restarts:
+                    record("abort",
+                           reason="router restart budget exhausted",
+                           restarts=router_restarts - 1, last_rc=rc)
+                    shutdown_all(members)
+                    return 1
+                delay = policy.backoff_seconds(router_restarts)
+                record("backoff", seconds=round(delay, 2),
+                       restart=router_restarts, member="router")
+                time.sleep(delay)
+                record("relaunch_router", restart=router_restarts)
+                router = spawn_router()
+            if (router is None and not relaunch_at
+                    and all(m is None for m in members)):
+                record("done", restarts=sum(restarts))
+                print(f"PHOTON_SUPERVISE_OK restarts={sum(restarts)}",
+                      flush=True)
+                return 0
+    except BaseException:
+        # an interrupted supervisor must not orphan the fleet
+        for proc in members + [router]:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                proc.wait()
+        raise
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="self-healing supervisor for a game_training_driver "
@@ -345,9 +491,41 @@ def main(argv=None) -> int:
                         "(default: the GAME training driver; "
                         "photon_ml_tpu.serve.service keeps the scoring "
                         "service alive through the same contract)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="fleet mode: supervise N scorer members (the "
+                        "driver args after `--` become EVERY member's "
+                        "args — model flags, queue depths); implies "
+                        "--module photon_ml_tpu.serve.service unless "
+                        "overridden")
+    p.add_argument("--fleet-dir", default=None,
+                   help="fleet mode: directory for member sockets "
+                        "(member<k>.sock), per-member telemetry dirs "
+                        "(member<k>/), the router dir, and "
+                        "supervisor.jsonl")
+    p.add_argument("--router-listen", default=None,
+                   help="fleet mode: also run the fleet router in "
+                        "front of the members at this endpoint "
+                        "(HOST:PORT or unix:/path.sock); its exit 0 "
+                        "drains the whole fleet")
+    p.add_argument("--stop-file", default=None,
+                   help="fleet mode: forwarded to every member and the "
+                        "router — touching it drains the fleet to "
+                        "exit 0")
     ns, driver_args = p.parse_known_args(argv)
     if driver_args and driver_args[0] == "--":
         driver_args = driver_args[1:]
+    if ns.fleet:
+        if not ns.fleet_dir:
+            p.error("--fleet requires --fleet-dir")
+        module = (ns.module if ns.module != TRAIN_MODULE
+                  else SERVE_MODULE)
+        return supervise_fleet(
+            driver_args, fleet=ns.fleet, fleet_dir=ns.fleet_dir,
+            router_listen=ns.router_listen,
+            max_restarts=ns.max_restarts, backoff_base=ns.backoff_base,
+            backoff_max=ns.backoff_max, poll_seconds=ns.poll_seconds,
+            grace_seconds=ns.grace_seconds, stop_file=ns.stop_file,
+            module=module)
     if not driver_args:
         p.error("no driver arguments given (pass them after `--`)")
     return supervise(
