@@ -16,8 +16,8 @@ ownership is a pure function of (entity, K)), which is what keeps
 per-member device-tier budgets from overlapping and makes aggregate
 hot-tier capacity scale linearly with fleet size.
 
-Membership is a health-state machine per member, driven by the
-router's hello/ping/stats traffic plus a heartbeat ping each tick::
+Membership is a health-state machine per member, driven by dispatch
+outcomes plus a heartbeat ``stats`` probe each tick::
 
     (boot) --verified hello--> healthy
     healthy  --suspect_after consecutive failures--> suspect
@@ -26,13 +26,26 @@ router's hello/ping/stats traffic plus a heartbeat ping each tick::
     dead     --verified hello (generation check)---> healthy
 
 Thresholds are FAILURE COUNTS, not wall-clock, so the machine is
-deterministic under test. A dead member's socket is kicked closed so
-every dispatch blocked on it fails immediately (and is then retried,
-failed over to the shard's fallback member, or shed with a typed
-error — never black-holed). Re-admission requires a fresh verified
-hello whose ``model_id`` matches the fleet's live identity: a member
-relaunched mid-hot-swap with yesterday's model is refused until it
-catches up, so one fleet never serves two model generations.
+deterministic under test — and only TRANSPORT failures count: a member
+that ANSWERS a sub-request with an application error (a typed
+``shed:*`` under overload, a deterministic bad-row error) is alive and
+takes no health penalty; its typed reply goes straight back to the
+client with no retry and no failover (:func:`reply_exception`), so a
+poison request stream or an overload shed can never darken a healthy
+fleet. A dead member's socket is kicked closed so every dispatch
+blocked on it fails immediately (and is then retried, failed over to
+the shard's fallback member, or shed with a typed error — never
+black-holed); a single connection closed by a mid-wire failure is
+re-dialed at its next checkout while the member stays in rotation.
+Re-admission requires a fresh verified hello whose ``model_id``
+matches the fleet's live identity: a member relaunched mid-hot-swap
+with yesterday's model is refused until it catches up, so one fleet
+never serves two model generations. The live identity itself follows
+the fleet through a member-by-member hot-swap: the heartbeat's
+``stats`` replies carry each member's current model, and once every
+live member unanimously reports a new one the fleet identity advances
+(``_note_member_identity``) — so post-swap relaunches re-admit onto
+the NEW generation instead of being refused forever.
 
 Lock discipline (photonlint W901/W904): ``Fleet._lock`` guards every
 piece of member health/identity/in-flight metadata; each member's
@@ -54,7 +67,9 @@ from typing import Optional, Sequence
 from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from photon_ml_tpu.serve.protocol import (
     ServeClient,
+    ServeRequestError,
     ShardUnavailableError,
+    ShedError,
     typed_error,
 )
 from photon_ml_tpu.utils.retry import (
@@ -122,10 +137,48 @@ class FleetAdmissionError(RuntimeError):
 
 
 class MemberReplyError(OSError):
-    """A member answered a routed sub-request with an error response.
-    An OSError so ``ROUTE_RETRY_POLICY`` retries it like a transport
-    failure — a member that consumed an injected fault budget answers
-    clean on the retry."""
+    """A member answered a routed sub-request with a TRANSPORT-grade
+    error response (``serve.route`` fault points catch ``(InjectedFault,
+    OSError)`` and answer with the exception's type name). An OSError so
+    ``ROUTE_RETRY_POLICY`` retries it like a dead wire — a member that
+    consumed an injected fault budget answers clean on the retry.
+    Application answers (typed sheds, deterministic bad-row errors) are
+    NOT this: see :func:`reply_exception`."""
+
+
+#: Error-reply type names that stand in for WIRE-level failures inside
+#: the member: its routed-plane fault point catches ``(InjectedFault,
+#: OSError)`` and answers with the exception's type name, so these
+#: replies mean "this sub-request hit transport-grade trouble" and take
+#: the same bounded-retry / failover / health path a socket error takes.
+_TRANSPORT_REPLY_ERRORS = frozenset({
+    "InjectedFault", "OSError", "IOError", "ConnectionError",
+    "ConnectionResetError", "ConnectionAbortedError",
+    "ConnectionRefusedError", "BrokenPipeError", "TimeoutError",
+    "InterruptedError",
+})
+
+
+def reply_exception(resp: dict, member_index: int
+                    ) -> Optional[Exception]:
+    """The exception a member's reply warrants, or None for clean
+    replies. Transport-grade error replies
+    (:data:`_TRANSPORT_REPLY_ERRORS`) become :class:`MemberReplyError`
+    — retried, failed over, and fed to the health machine like a dead
+    wire. Every OTHER error reply is an application ANSWER: the member
+    is alive and already did the work of refusing, so its typed
+    exception goes straight back to the client — retrying a
+    ``shed:queue_full`` amplifies the very overload that caused it,
+    and a poison request retried across members would darken a healthy
+    fleet (three malformed requests must never mark a member dead)."""
+    err = typed_error(resp)
+    if err is None:
+        return None
+    name = str(resp.get("error", "")).partition(":")[0].strip()
+    if not isinstance(err, ShedError) and name in _TRANSPORT_REPLY_ERRORS:
+        return MemberReplyError(
+            f"member {member_index} replied: {resp.get('error')}")
+    return err
 
 
 class HealthPolicy:
@@ -266,6 +319,21 @@ class Fleet:
                 self._count("member_failed")
                 last = e.__cause__ or e
                 continue
+            except ShedError:
+                # the member ANSWERED: alive but over budget. The typed
+                # shed goes to the client untouched — retrying it on
+                # the same member, or hedging it onto the fallback,
+                # would amplify the very overload that caused it — and
+                # an answering member takes no health penalty.
+                self._count("shed")
+                raise
+            except ServeRequestError:
+                # deterministic application error (malformed rows, a
+                # refused kind): the error IS the reply, so no retry,
+                # no failover, no health penalty — a poison request
+                # stream must not darken a healthy fleet.
+                self._count("error")
+                raise
             self._record_success(member)
             self._count("ok")
             return resp
@@ -292,14 +360,15 @@ class Fleet:
                 raise OSError(
                     f"member {member.index}: every pooled connection "
                     f"busy for {self._member_timeout:.0f}s") from None
+            client = self._repair(member, pool, client)
             try:
                 resp = client.request(msg)
             except BaseException:
                 # a request that died mid-wire leaves the framing
                 # desynced — close before returning so the slot still
-                # exists (pool size is invariant) but the next draw of
-                # THIS connection fails fast instead of mis-pairing
-                # replies; re-admission swaps in a fresh pool
+                # exists (pool size is invariant) and the next checkout
+                # of THIS slot re-dials it (``_repair``) instead of
+                # mis-pairing replies
                 try:
                     client.close()
                 except OSError:
@@ -311,15 +380,73 @@ class Fleet:
         finally:
             with self._lock:
                 self._inflight.pop(token, None)
-        err = typed_error(resp)
+        err = reply_exception(resp, member.index)
         if err is not None:
-            raise MemberReplyError(
-                f"member {member.index} replied: {resp.get('error')}")
+            raise err
         return resp
 
     def inflight_count(self) -> int:
         with self._lock:
             return len(self._inflight)
+
+    def _repair(self, member: FleetMember, pool, client: ServeClient
+                ) -> ServeClient:
+        """Checkout-time pool repair: a slot whose client was closed
+        after a mid-wire failure is re-dialed while the member stays
+        healthy, instead of burning a retry attempt (plus backoff) on
+        every future draw until a full dead→re-admission cycle rebuilds
+        the pool. On failure the dead slot goes back (pool size is
+        invariant) and the OSError feeds the normal retry/health
+        path."""
+        if not client.closed:
+            return client
+        try:
+            return self._revive(member, client)
+        except (OSError, FleetAdmissionError) as e:
+            pool.put(client)
+            raise OSError(
+                f"member {member.index}: reconnect of a closed pool "
+                f"slot failed: {type(e).__name__}: {e}") from e
+
+    def _revive(self, member: FleetMember, dead: ServeClient
+                ) -> ServeClient:
+        """One reconnect attempt for one closed pool slot (the member
+        is in rotation, so its listener should answer NOW): fresh
+        connection, verified hello, member-role handshake, generation
+        check — the admission gauntlet, scoped to a single slot."""
+        client = ServeClient(member.endpoint,
+                             timeout=self._member_timeout,
+                             connect_policy=READMIT_CONNECT_POLICY)
+        try:
+            if (client.hello or {}).get("kind") != "serve_hello":
+                raise FleetAdmissionError(
+                    f"member {member.index}: bad hello on reconnect: "
+                    f"{client.hello!r}")
+            ack = client.request({"kind": "member",
+                                  "member": member.index,
+                                  "fleet": len(self.members)})
+            if ack.get("kind") != "member_ack":
+                raise FleetAdmissionError(
+                    f"member {member.index}: member-role handshake "
+                    f"refused on reconnect: {ack!r}")
+            with self._lock:
+                live = self._live_model_id
+            if live is not None and ack.get("model_id") != live:
+                raise FleetAdmissionError(
+                    f"member {member.index} reconnected serving "
+                    f"{ack.get('model_id')!r} but the fleet is live "
+                    f"on {live!r}")
+        except BaseException:
+            client.close()
+            raise
+        with member.wire:
+            try:
+                member.clients.remove(dead)
+            except ValueError:
+                pass  # pool already rebuilt by a re-admission
+            member.clients.append(client)
+        self._count_member("reconnected")
+        return client
 
     # -- health state machine -------------------------------------------
 
@@ -473,10 +600,12 @@ class Fleet:
         self._count_member("readmitted" if readmission else "admitted")
 
     def heartbeat_tick(self) -> None:
-        """One health round (router main thread): ping live members,
-        probe dead ones for re-admission. A member whose every pooled
-        connection is busy with a dispatch is skipped this tick — the
-        dispatch results themselves feed the state machine."""
+        """One health round (router main thread): probe live members
+        with a ``stats`` request (liveness AND the member's current
+        model identity in one round trip), re-dial closed pool slots,
+        probe dead members for re-admission. A member whose every
+        pooled connection is busy with a dispatch is skipped this tick
+        — the dispatch results themselves feed the state machine."""
         for member in self.members:
             with self._lock:
                 state = member.state
@@ -496,15 +625,54 @@ class Fleet:
             except queue.Empty:
                 continue  # all connections mid-dispatch — busy ≠ sick
             try:
-                pong = client.ping()
-                if pong.get("kind") != "pong":
-                    raise OSError(f"bad pong: {pong!r}")
+                client = self._repair(member, pool, client)
+            except OSError:
+                self._record_failure(member)
+                continue
+            try:
+                reply = client.stats()
+                if reply.get("kind") != "stats":
+                    raise OSError(f"bad stats reply: {reply!r}")
             except (OSError, ConnectionError):
                 pool.put(client)
                 self._record_failure(member)
             else:
                 pool.put(client)
                 self._record_success(member)
+                self._note_member_identity(member,
+                                           reply.get("model_id"),
+                                           reply.get("generation"))
+
+    def _note_member_identity(self, member: FleetMember,
+                              model_id, generation) -> None:
+        """Heartbeat-fed identity tracking: record what the member says
+        it serves NOW, and advance the fleet's live identity once every
+        live member unanimously reports a new ``model_id`` — the
+        documented fleet-wide hot-swap is member-by-member (the router
+        refuses to proxy swaps), so without this the identity would
+        stay frozen at the boot model and a member relaunched on the
+        NEW generation would be refused re-admission forever. Until
+        unanimity the old identity stands, so a straggler relaunched on
+        the previous model is still admitted mid-swap."""
+        advanced = None
+        with self._lock:
+            if generation is not None:
+                member.generation = int(generation)
+            if model_id is None:
+                return
+            member.model_id = model_id
+            live = self._live_model_id
+            if model_id != live:
+                ids = {m.model_id for m in self.members
+                       if m.state != "dead"}
+                if ids == {model_id}:
+                    self._live_model_id = model_id
+                    advanced = live
+        if advanced is not None:
+            self._count_member("identity_advanced")
+            self._warn(f"fleet live model identity advanced "
+                       f"{advanced!r} → {model_id!r} (every live "
+                       f"member reports the new generation)")
 
     # -- introspection / shutdown ---------------------------------------
 

@@ -152,6 +152,19 @@ def typed_error(resp: dict) -> Optional[ServeRequestError]:
     return ServeRequestError(message)
 
 
+def wire_error(exc: BaseException) -> str:
+    """Render an exception into the wire ``error`` grammar so
+    :func:`typed_error` round-trips it on the far side: a
+    :class:`ShedError` keeps its ``shed:<reason>`` form (its message
+    already carries the prefix), everything else is rendered
+    ``TypeName: message``. The fleet router uses this to forward a
+    member's typed refusal to the client without demoting it to a
+    generic error."""
+    if isinstance(exc, ShedError):
+        return exc.message
+    return f"{type(exc).__name__}: {exc}"
+
+
 def parse_serve_endpoint(endpoint: str) -> tuple[str, object]:
     """``("tcp", (host, port))`` or ``("unix", path)``."""
     scheme, addr = parse_endpoint(endpoint)
@@ -263,6 +276,13 @@ class ServeClient:
         self._file = self._sock.makefile("rb")
         self.hello = self._read()
         self.generation = self.hello.get("generation")
+
+    @property
+    def closed(self) -> bool:
+        """True once :meth:`close` ran (a kicked-but-unclosed client is
+        NOT closed — its owner replaces it wholesale). The fleet's pool
+        repair re-dials closed slots at checkout."""
+        return self._sock is None
 
     def reconnect(self) -> dict:
         """Drop the connection and re-dial (same bounded backoff).

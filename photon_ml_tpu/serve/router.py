@@ -20,12 +20,17 @@ The robustness contract is the point:
   dispatching thread (the health machine kicks the dead member's
   socket), so in-flight work re-routes or sheds — it never hangs.
 - **Health-checked routing.** The main thread runs the heartbeat loop
-  (``Fleet.heartbeat_tick``): ping live members, mark
-  healthy → suspect → dead on deterministic consecutive-failure
-  thresholds, and probe dead members for re-admission — which
-  requires a fresh verified hello whose model identity matches the
-  fleet's live one (the generation check), so a member relaunched by
-  ``photon_supervise --fleet`` mid-hot-swap cannot split the fleet.
+  (``Fleet.heartbeat_tick``): stats-probe live members (liveness plus
+  their current model identity — a member-by-member hot-swap advances
+  the fleet's live identity once every live member reports the new
+  model), mark healthy → suspect → dead on deterministic
+  consecutive-failure thresholds, and probe dead members for
+  re-admission — which requires a fresh verified hello whose model
+  identity matches the fleet's live one (the generation check), so a
+  member relaunched by ``photon_supervise --fleet`` mid-hot-swap
+  cannot split the fleet. Only transport failures feed the health
+  machine: an application error reply (typed shed, bad-row error) is
+  forwarded to the client typed, with no retry and no health penalty.
 
 Thread layout mirrors ``serve/service.py``: an accept thread, one
 reader thread per client connection (each scatters its own requests
@@ -62,6 +67,7 @@ from photon_ml_tpu.serve.protocol import (
     hello,
     parse_serve_endpoint,
     scores_response,
+    wire_error,
 )
 
 #: Same SLO windows as the single-process service.
@@ -81,7 +87,6 @@ class FleetRouter:
         self._drain_grace = float(drain_grace_seconds)
         self._lock = threading.Lock()
         self._conns: set[socket.socket] = set()
-        self._threads: list[threading.Thread] = []
         self._closed = False
         self._started_at = time.monotonic()
         self._latencies_ms: list[float] = []
@@ -115,10 +120,10 @@ class FleetRouter:
     # -- socket front (accept + reader threads) -------------------------
 
     def start(self) -> None:
-        t = threading.Thread(target=self._accept_loop,
-                             name="route-accept", daemon=True)
-        t.start()
-        self._threads.append(t)
+        # daemonic and never joined — no reference kept (an always-on
+        # router must not grow a Thread object per accepted connection)
+        threading.Thread(target=self._accept_loop,
+                         name="route-accept", daemon=True).start()
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -133,10 +138,8 @@ class FleetRouter:
                     conn.close()
                     return
                 self._conns.add(conn)
-            t = threading.Thread(target=self._conn_loop, args=(conn,),
-                                 name="route-conn", daemon=True)
-            t.start()
-            self._threads.append(t)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name="route-conn", daemon=True).start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
@@ -254,8 +257,10 @@ class FleetRouter:
             if isinstance(resp, Exception):
                 self._registry.counter("serve_errors").inc(
                     kind=type(resp).__name__)
-                send(error_response(
-                    rid, f"{type(resp).__name__}: {resp}"))
+                # wire_error keeps the typed grammar intact — a
+                # member's shed:queue_full reaches the client as a
+                # ShedError, not a generic string
+                send(error_response(rid, wire_error(resp)))
                 return
             sub_scores = resp.get("scores") or []
             sub_uids = resp.get("uids")

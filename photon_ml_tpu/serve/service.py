@@ -180,7 +180,6 @@ class ServeService:
         self._warn = warn or (lambda msg: None)
         self._lock = threading.Lock()
         self._conns: set[socket.socket] = set()
-        self._threads: list[threading.Thread] = []
         self._closed = False
         self._started_at = time.monotonic()
         self._latencies_ms: list[float] = []
@@ -229,10 +228,10 @@ class ServeService:
     # -- socket front (accept + reader threads) -------------------------
 
     def start(self) -> None:
-        t = threading.Thread(target=self._accept_loop,
-                             name="serve-accept", daemon=True)
-        t.start()
-        self._threads.append(t)
+        # daemonic and never joined — no reference kept (an always-on
+        # service must not grow a Thread object per accepted connection)
+        threading.Thread(target=self._accept_loop,
+                         name="serve-accept", daemon=True).start()
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -247,10 +246,8 @@ class ServeService:
                     conn.close()
                     return
                 self._conns.add(conn)
-            t = threading.Thread(target=self._conn_loop, args=(conn,),
-                                 name="serve-conn", daemon=True)
-            t.start()
-            self._threads.append(t)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name="serve-conn", daemon=True).start()
 
     def _conn_loop(self, conn: socket.socket) -> None:
         wlock = threading.Lock()
@@ -480,10 +477,8 @@ class ServeService:
                 refuse("a swap is already in progress")
                 return
             self._swap = task
-        t = threading.Thread(target=self._swap_load, args=(task,),
-                             name="serve-swap-load", daemon=True)
-        t.start()
-        self._threads.append(t)
+        threading.Thread(target=self._swap_load, args=(task,),
+                         name="serve-swap-load", daemon=True).start()
 
     def _swap_load(self, task: _SwapTask) -> None:
         """Loader thread: disk I/O + validation only — no device work.

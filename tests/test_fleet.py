@@ -20,8 +20,10 @@ Layers:
 
 from __future__ import annotations
 
+import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -35,14 +37,21 @@ from photon_ml_tpu.serve.fleet import (
     Fleet,
     FleetAdmissionError,
     HealthPolicy,
+    MemberReplyError,
     entity_of_row,
     entity_shard,
+    reply_exception,
 )
 from photon_ml_tpu.serve.protocol import (
     ModelSwapRefusedError,
     ServeClient,
+    ServeRequestError,
     ShardUnavailableError,
+    ShedError,
+    encode,
+    hello,
     typed_error,
+    wire_error,
 )
 from test_serve import (  # noqa: F401 — shared serving fixtures
     SECTIONS,
@@ -222,6 +231,286 @@ class TestRouteChain:
         f = _fleet(n=1)
         f.members[0].state = "healthy"
         assert [m.index for m in f.route_chain(0)] == [0]
+
+
+# ---------------------------------------------------------------------------
+# fake member: just enough proto-1 wire to drive the dispatch machinery
+# ---------------------------------------------------------------------------
+
+
+class _FakeMember:
+    """An in-process proto-1 member: verified hello, member-role ack,
+    ``stats`` carrying its (mutable) model identity, and scripted
+    replies per ``score`` request — drives the router-side dispatch,
+    health, and identity machinery without a jax subprocess."""
+
+    def __init__(self, sock_path: str, model_id: str = "fake-model",
+                 generation: int = 1):
+        self.model_id = model_id
+        self.generation = generation
+        self.score_replies: list[dict] = []  # scripted, FIFO
+        self.requests: list[dict] = []       # every score msg seen
+        self.lock = threading.Lock()
+        self.endpoint = f"unix:{sock_path}"
+        self._closed = False
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(sock_path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.1)
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with self.lock:
+                conn.sendall(encode(hello(
+                    self.model_id, ["game"],
+                    generation=self.generation)))
+            for line in conn.makefile("rb"):
+                msg = json.loads(line)
+                kind = msg.get("kind")
+                if kind == "member":
+                    with self.lock:
+                        reply = {"kind": "member_ack", "proto": 1,
+                                 "member": msg.get("member"),
+                                 "generation": self.generation,
+                                 "model_id": self.model_id}
+                elif kind == "ping":
+                    reply = {"kind": "pong", "proto": 1}
+                elif kind == "stats":
+                    with self.lock:
+                        reply = {"kind": "stats", "proto": 1,
+                                 "generation": self.generation,
+                                 "model_id": self.model_id}
+                elif kind == "score":
+                    with self.lock:
+                        self.requests.append(msg)
+                        scripted = (self.score_replies.pop(0)
+                                    if self.score_replies else None)
+                    if scripted is None:
+                        reply = {"kind": "scores", "proto": 1,
+                                 "id": msg.get("id"),
+                                 "scores": [1.0] * len(
+                                     msg.get("rows") or [])}
+                    else:
+                        reply = dict(scripted)
+                        reply.setdefault("id", msg.get("id"))
+                else:
+                    reply = {"kind": "error", "proto": 1,
+                             "error": f"RuntimeError: unknown {kind!r}"}
+                conn.sendall(encode(reply))
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def fake_fleet(tmp_path):
+    """Two fake members admitted into a real Fleet (1 pooled
+    connection each keeps checkout order deterministic)."""
+    fakes = [_FakeMember(str(tmp_path / f"fm{k}.sock"))
+             for k in range(2)]
+    f = Fleet([fk.endpoint for fk in fakes],
+              registry=MetricsRegistry(), member_timeout=5.0,
+              connections_per_member=1)
+    f.admit_all()
+    yield f, fakes
+    f.close()
+    for fk in fakes:
+        fk.close()
+
+
+# ---------------------------------------------------------------------------
+# member error replies: application answers vs transport failures
+# ---------------------------------------------------------------------------
+
+
+class TestReplyException:
+    def test_clean_reply_is_none(self):
+        assert reply_exception({"kind": "scores", "scores": []}, 0) \
+            is None
+
+    def test_transport_grade_names_are_retryable(self):
+        # the member's serve.route fault point catches (InjectedFault,
+        # OSError) and answers with the type name — those take the
+        # retry/failover/health path like a dead wire
+        for msg in ("OSError: [Errno 5] injected I/O error",
+                    "InjectedFault: serve.route",
+                    "ConnectionResetError: peer reset",
+                    "TimeoutError: member stalled"):
+            exc = reply_exception({"error": msg}, 3)
+            assert isinstance(exc, MemberReplyError), msg
+            assert isinstance(exc, OSError)
+
+    def test_shed_and_app_errors_are_answers_not_failures(self):
+        exc = reply_exception({"error": "shed:queue_full"}, 0)
+        assert isinstance(exc, ShedError)
+        assert exc.reason == "queue_full"
+        exc = reply_exception({"error": "TypeError: row 0 is not an "
+                                        "object"}, 0)
+        assert type(exc) is ServeRequestError
+        exc = reply_exception(
+            {"error": "ModelSwapRefusedError: canary"}, 0)
+        assert isinstance(exc, ModelSwapRefusedError)
+
+
+class TestDispatchReplyHandling:
+    def test_shed_reply_goes_straight_to_client(self, fake_fleet):
+        # REVIEW high: an overload shed must reach the client typed —
+        # not be retried (load amplification), not fail over to the
+        # fallback (darkening two members), not feed the health machine
+        f, fakes = fake_fleet
+        fakes[0].score_replies.append(
+            {"kind": "error", "proto": 1, "error": "shed:queue_full"})
+        with pytest.raises(ShedError) as ei:
+            f.dispatch(0, {"kind": "score", "id": "r", "rows": []})
+        assert ei.value.reason == "queue_full"
+        assert len(fakes[0].requests) == 1  # no retry
+        assert len(fakes[1].requests) == 0  # no failover
+        assert f.members[0].state == "healthy"
+        assert f.members[0].failures == 0
+        assert f._registry.counter("serve_route").value(
+            outcome="shed") == 1
+
+    def test_poison_request_does_not_darken_the_fleet(self, fake_fleet):
+        # deterministic bad-row errors answered three times in a row
+        # must leave both members healthy (defaults: dead_after=3)
+        f, fakes = fake_fleet
+        for _ in range(3):
+            fakes[0].score_replies.append(
+                {"kind": "error", "proto": 1,
+                 "error": "TypeError: row 0 is not an object"})
+            with pytest.raises(ServeRequestError):
+                f.dispatch(0, {"kind": "score", "id": "r", "rows": []})
+        assert len(fakes[0].requests) == 3   # one wire visit each
+        assert len(fakes[1].requests) == 0   # fallback untouched
+        assert all(m.state == "healthy" and m.failures == 0
+                   for m in f.members)
+        assert f._registry.counter("serve_route").value(
+            outcome="error") == 3
+
+    def test_transport_reply_is_retried_then_answers_clean(
+            self, fake_fleet):
+        # an injected-fault reply (OSError name) burns a retry on the
+        # SAME member and the re-dispatch answers clean — the chaos
+        # io_error cell's contract
+        f, fakes = fake_fleet
+        fakes[0].score_replies.append(
+            {"kind": "error", "proto": 1,
+             "error": "OSError: [Errno 5] injected I/O error"})
+        resp = f.dispatch(0, {"kind": "score", "id": "r",
+                              "rows": [{"uid": "u"}]})
+        assert resp["kind"] == "scores"
+        assert len(fakes[0].requests) == 2  # retried, same member
+        assert f.members[0].failures == 0   # success reset
+        assert f._registry.counter("serve_route").value(
+            outcome="ok") == 1
+
+
+# ---------------------------------------------------------------------------
+# pool repair: a closed slot is re-dialed at checkout
+# ---------------------------------------------------------------------------
+
+
+class TestPoolRepair:
+    def test_closed_slot_is_redialed_on_dispatch_checkout(
+            self, fake_fleet):
+        # REVIEW low: a client closed after a mid-wire failure must be
+        # re-dialed at its next checkout — not burn a retry + backoff
+        # on every future draw until a dead→re-admission cycle
+        f, fakes = fake_fleet
+        m = f.members[0]
+        m.clients[0].close()  # the mid-wire-failure aftermath
+        resp = f.dispatch(0, {"kind": "score", "id": "r",
+                              "rows": [{"uid": "u"}]})
+        assert resp["kind"] == "scores"
+        assert len(fakes[0].requests) == 1  # no retry burned
+        assert m.failures == 0
+        assert len(m.clients) == 1 and not m.clients[0].closed
+        assert f._registry.counter("serve_fleet_events").value(
+            event="reconnected") == 1
+
+    def test_heartbeat_repairs_closed_slots(self, fake_fleet):
+        f, fakes = fake_fleet
+        m = f.members[1]
+        m.clients[0].close()
+        f.heartbeat_tick()
+        assert m.state == "healthy" and m.failures == 0
+        assert not m.clients[0].closed
+
+
+# ---------------------------------------------------------------------------
+# live identity follows a member-by-member hot-swap
+# ---------------------------------------------------------------------------
+
+
+class TestLiveIdentityAdvance:
+    def test_unanimous_new_model_advances_the_fleet_identity(
+            self, fake_fleet):
+        # REVIEW medium: after the documented member-by-member swap the
+        # fleet identity must advance, or relaunches on the NEW model
+        # are refused forever (permanent capacity loss)
+        f, fakes = fake_fleet
+        assert f.live_model_id() == "fake-model"
+        for fk in fakes:
+            with fk.lock:
+                fk.model_id = "fake-model-v2"
+                fk.generation = 2
+        f.heartbeat_tick()
+        assert f.live_model_id() == "fake-model-v2"
+        assert f.live_generation() == 2
+        assert f._registry.counter("serve_fleet_events").value(
+            event="identity_advanced") == 1
+
+    def test_partial_swap_keeps_the_old_identity(self, fake_fleet):
+        # mid-swap (one member flipped, one not) the old identity
+        # stands — a straggler relaunched on the previous model is
+        # still admissible, and the fleet never splits
+        f, fakes = fake_fleet
+        with fakes[0].lock:
+            fakes[0].model_id = "fake-model-v2"
+        f.heartbeat_tick()
+        assert f.live_model_id() == "fake-model"
+        assert f.members[0].model_id == "fake-model-v2"
+
+
+# ---------------------------------------------------------------------------
+# wire grammar round-trip for forwarded typed errors
+# ---------------------------------------------------------------------------
+
+
+class TestWireErrorRoundTrip:
+    def test_typed_exceptions_survive_the_router_hop(self):
+        # the router forwards a member's typed refusal with wire_error;
+        # the client's typed_error must reconstruct the same type
+        for exc in (ShardUnavailableError("shard 3 has no live member"),
+                    ModelSwapRefusedError("canary: drift")):
+            back = typed_error({"error": wire_error(exc)})
+            assert type(back) is type(exc)
+        back = typed_error({"error": wire_error(ShedError("queue_full"))})
+        assert isinstance(back, ShedError)
+        assert back.reason == "queue_full"
 
 
 # ---------------------------------------------------------------------------
