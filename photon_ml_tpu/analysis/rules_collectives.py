@@ -8,8 +8,9 @@ silently mis-reduces) on a real mesh. These rules make the axis/spec
 discipline mechanical:
 
 - **W601** a collective (``lax.psum``/``pmean``/``all_gather``/
-  ``psum_scatter``/``axis_index``/...) whose *literal* axis name matches
-  no axis the program ever defines. The axis universe is built from
+  ``psum_scatter``/``axis_index``/... — plus the package's quantized
+  wrappers ``qpsum``/``qall_gather``, which forward the axis verbatim)
+  whose *literal* axis name matches no axis the program ever defines. The axis universe is built from
   defining sites only — ``Mesh(..., axis_names)`` constructions,
   ``jax.pmap(axis_name=...)``, and the package's ``*_AXIS`` string
   constants — never from collectives themselves (a typo must not define
@@ -48,6 +49,11 @@ _COLLECTIVES = {
     "jax.lax.psum_scatter": 1, "jax.lax.all_to_all": 1,
     "jax.lax.ppermute": 1, "jax.lax.axis_index": 0,
     "jax.lax.axis_size": 0, "jax.lax.pshuffle": 1,
+    # the package's quantized wrappers forward their axis name to the
+    # lax collectives verbatim — same axis discipline, same findings
+    # (call sites replacing lax.psum with qpsum must not lose W601/W602)
+    "photon_ml_tpu.parallel.quantized_collectives.qpsum": 1,
+    "photon_ml_tpu.parallel.quantized_collectives.qall_gather": 1,
 }
 _AXIS_KWARGS = ("axis_name", "axis_index_groups_axis")
 
@@ -224,6 +230,8 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
             d = mod.resolve(node.func)
             if d in _COLLECTIVES:
                 short = _short(d)
+                if d.startswith("jax.lax."):
+                    short = f"lax.{short}"
                 axis_node = _axis_node(node, _COLLECTIVES[d])
                 value = literal_in(mod, index, axis_node) \
                     if axis_node is not None else None
@@ -234,7 +242,7 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
                         findings.append(Finding(
                             "W601", mod.relpath, node.lineno,
                             node.col_offset,
-                            f"lax.{short}() over unknown axis "
+                            f"{short}() over unknown axis "
                             f"{axis!r} — no Mesh/pmap defines it; "
                             f"known axes: {_axes_label(axes)}"))
                 # W602: collective under replica-divergent control flow
@@ -246,7 +254,7 @@ def check(modules: list[ModuleInfo], index: PackageIndex,
                         findings.append(Finding(
                             "W602", mod.relpath, node.lineno,
                             node.col_offset,
-                            f"lax.{short}() under a Python `{kind}` "
+                            f"{short}() under a Python `{kind}` "
                             f"(line {branch.lineno}) branching on "
                             f"{why} — replicas that disagree about "
                             f"entering the branch deadlock the "

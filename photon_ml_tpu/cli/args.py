@@ -48,6 +48,39 @@ def check_telemetry_flags(p: argparse.ArgumentParser,
         p.error(str(e))
 
 
+PRECISION_CHOICES = ("f32", "bf16")
+
+
+def add_precision_flags(p: argparse.ArgumentParser) -> None:
+    """The mixed-precision / quantized-collectives flag pair shared by
+    the training driver and the multihost worker entrypoint."""
+    p.add_argument(
+        "--precision", choices=PRECISION_CHOICES, default="f32",
+        help="storage/compute dtype for design-matrix tiles and "
+             "per-entity RE blocks; every reduction still accumulates "
+             "in f32 (bf16 halves HBM traffic on the bandwidth-bound "
+             "value+gradient pass)")
+    p.add_argument(
+        "--collective-quant", choices=("none", "int8"), default="none",
+        help="wire format for the mesh collective sites (RE score psum, "
+             "sharded-update iterate all-gather): int8 ships "
+             "blockwise-quantized payloads and accumulates in f32 "
+             "(parallel/quantized_collectives.py); only engages on "
+             ">1-shard meshes")
+
+
+def precision_dtype(precision: str):
+    """``--precision`` value → jnp dtype for dataset storage."""
+    import jax.numpy as jnp
+
+    try:
+        return {"f32": jnp.float32, "bf16": jnp.bfloat16}[precision]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision {precision!r}; "
+            f"expected one of {PRECISION_CHOICES}") from None
+
+
 def add_observability_flags(p: argparse.ArgumentParser,
                             heartbeat_default: float = 10.0,
                             stall_default: float = 120.0) -> None:

@@ -87,9 +87,11 @@ from photon_ml_tpu.utils.compile_cache import (
 )
 
 from photon_ml_tpu.cli.args import (
+    add_precision_flags,
     check_telemetry_flags,
     parse_key_value_map,
     parse_section_keys_map,
+    precision_dtype,
 )
 
 
@@ -216,6 +218,7 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "that do not divide the device count fall back to "
                         "the largest divisor (logged); 1 (default) is the "
                         "unsharded path, bit-identical to before")
+    add_precision_flags(p)
     p.add_argument("--cd-block-size", type=int, default=1,
                    help="solve this many coordinates per sweep "
                         "CONCURRENTLY against a stale device-resident "
@@ -556,13 +559,16 @@ class GameTrainingDriver:
         coords = {}
         compute_variance = (
             parse_flag(self.ns.compute_variance))
+        dtype = precision_dtype(getattr(self.ns, "precision", "f32"))
+        quant = getattr(self.ns, "collective_quant", "none")
         for cid in self.updating_sequence:
             if cid in self.fixed_data_configs:
                 data_cfg = self.fixed_data_configs[cid]
                 opt_cfg = fixed_cfgs.get(
                     cid, GLMOptimizationConfiguration())
                 ds = build_fixed_effect_dataset(
-                    self.train_data, data_cfg.feature_shard_id)
+                    self.train_data, data_cfg.feature_shard_id,
+                    dtype=dtype)
                 coords[cid] = FixedEffectCoordinate(
                     dataset=ds,
                     problem=GLMOptimizationProblem(
@@ -571,18 +577,22 @@ class GameTrainingDriver:
                         # with entity sharding on, the data-axis replicas
                         # also split the optimizer state / weight update
                         # (engages only when the data axis is > 1)
-                        shard_weight_update=self._entity_shards > 1))
+                        shard_weight_update=self._entity_shards > 1,
+                        collective_quant=quant))
             elif cid in self.random_data_configs and cid in factored_cfgs:
                 data_cfg = self.random_data_configs[cid]
                 re_cfg, latent_cfg, mf_cfg = factored_cfgs[cid]
-                ds = build_random_effect_dataset(self.train_data, data_cfg)
+                ds = build_random_effect_dataset(self.train_data, data_cfg,
+                                                 dtype=dtype)
                 coords[cid] = FactoredRandomEffectCoordinate(
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
                         config=re_cfg, task=self.task,
-                        lane_compaction_chunk=self._lane_chunk()),
+                        lane_compaction_chunk=self._lane_chunk(),
+                        collective_quant=quant),
                     latent_problem=GLMOptimizationProblem(
-                        config=latent_cfg, task=self.task),
+                        config=latent_cfg, task=self.task,
+                        collective_quant=quant),
                     latent_dim=mf_cfg.num_factors,
                     num_inner_iterations=mf_cfg.max_number_iterations)
             elif cid in self.random_data_configs:
@@ -605,18 +615,21 @@ class GameTrainingDriver:
                         num_buckets=num_buckets,
                         entity_axis_size=self._entity_shards,
                         blocks_dir=os.path.join(
-                            self.ns.random_effect_blocks_dir, cid))
+                            self.ns.random_effect_blocks_dir, cid),
+                        dtype=dtype)
                 else:
                     ds = build_random_effect_dataset(
                         self.train_data, data_cfg,
                         num_buckets=num_buckets,
-                        entity_axis_size=self._entity_shards)
+                        entity_axis_size=self._entity_shards,
+                        dtype=dtype)
                 coords[cid] = RandomEffectCoordinate(
                     dataset=ds,
                     problem=RandomEffectOptimizationProblem(
                         config=opt_cfg, task=self.task,
                         lane_compaction_chunk=self._lane_chunk(),
-                        entity_shards=self._entity_shards))
+                        entity_shards=self._entity_shards,
+                        collective_quant=quant))
             else:
                 raise ValueError(
                     f"coordinate {cid!r} in updating sequence has no data "
@@ -1055,6 +1068,8 @@ def _run_multihost(ns: argparse.Namespace) -> None:
             blocks_dir=(os.path.join(ns.random_effect_blocks_dir,
                                      f"p{ns.process_id}")
                         if ns.random_effect_blocks_dir else None),
+            precision=getattr(ns, "precision", "f32"),
+            collective_quant=getattr(ns, "collective_quant", "none"),
             stop=stop)
 
         # one npz per process: fixed coefficients + per-coordinate tables

@@ -266,8 +266,10 @@ class RandomEffectCoordinate:
         return new_coefs, tracker
 
     def score(self, coefs: Array) -> Array:
-        return score_random_effect(self.dataset, coefs,
-                                   entity_shards=self.problem.entity_shards)
+        return score_random_effect(
+            self.dataset, coefs,
+            entity_shards=self.problem.entity_shards,
+            collective_quant=self.problem.collective_quant)
 
     def regularization_value(self, coefs: Array) -> float:
         return self.problem.regularization_value(coefs)
@@ -393,8 +395,10 @@ class FactoredRandomEffectCoordinate:
         lat_ds = dataclasses.replace(self.dataset, X=X_lat,
                                      passive_X=lat_passive,
                                      projectors=None, random_projector=None)
-        return score_random_effect(lat_ds, coefs,
-                                   entity_shards=self.problem.entity_shards)
+        return score_random_effect(
+            lat_ds, coefs,
+            entity_shards=self.problem.entity_shards,
+            collective_quant=self.problem.collective_quant)
 
     def regularization_value(self, state: tuple[Array, Array]) -> float:
         coefs, B = state

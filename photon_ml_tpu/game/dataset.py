@@ -1002,6 +1002,7 @@ def build_random_effect_dataset_streamed(
     pad_dim_multiple: int = 8,
     keep_host_blocks: bool = False,
     entity_shard: Optional[tuple[int, int]] = None,
+    dtype=jnp.float32,
 ) -> RandomEffectDataset:
     """Random-effect blocks from STREAMED parts, optionally memmap-backed.
 
@@ -1028,9 +1029,13 @@ def build_random_effect_dataset_streamed(
       columns, not CSR + all blocks.
 
     Always returns the bucketed representation (``num_buckets=1`` → one
-    bucket). Blocks stay float32; with ``blocks_dir`` they are numpy
-    memmaps that JAX copies to device per-bucket at solve time — the
-    caller owns the directory's lifetime. ``keep_host_blocks=True`` keeps
+    bucket). Host-side staging is always float32; ``dtype`` applies at
+    the device commit (the --precision bf16 storage mode), matching the
+    in-RAM builder. With ``blocks_dir`` the blocks are f32 numpy memmaps
+    that JAX copies to device per-bucket at solve time — the memmap
+    files themselves stay f32 regardless of ``dtype`` (the on-disk
+    format is the spill contract, and the paging path converts on
+    device commit) — and the caller owns the directory's lifetime. ``keep_host_blocks=True`` keeps
     RAM-built blocks as plain numpy too (no device commit) — for callers
     that re-shard them onto a global mesh themselves (the multi-host
     worker must not materialize the full block set on one device first).
@@ -1260,7 +1265,7 @@ def build_random_effect_dataset_streamed(
             Xs[b].flush()
         buckets.append(EntityBucket(
             entity_start=int(b_starts[b]), num_real=int(bucket_sizes[b]),
-            X=Xs[b] if host_blocks else jnp.asarray(Xs[b]),
+            X=Xs[b] if host_blocks else jnp.asarray(Xs[b], dtype),
             labels=labs[b] if host_blocks else jnp.asarray(labs[b]),
             base_offsets=offsb[b] if host_blocks else jnp.asarray(offsb[b]),
             weights=wtsb[b] if host_blocks else jnp.asarray(wtsb[b]),
@@ -1277,7 +1282,7 @@ def build_random_effect_dataset_streamed(
         projectors=projectors,
         random_projector=random_projector,
         passive_X=(None if p_X is None
-                   else (p_X if host_blocks else jnp.asarray(p_X))),
+                   else (p_X if host_blocks else jnp.asarray(p_X, dtype))),
         passive_entity=(None if p_X is None
                         else (p_ent if host_blocks else jnp.asarray(p_ent))),
         passive_row_ids=(None if p_X is None

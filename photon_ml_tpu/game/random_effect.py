@@ -707,13 +707,17 @@ def _resolve_entity_shards(entity_shards: int, num_lanes: int):
 
 
 @lru_cache(maxsize=64)
-def _sharded_score_fn(mesh, num_samples):
+def _sharded_score_fn(mesh, num_samples, collective_quant="none"):
     """shard_map + jit of the active-score exchange: each shard scores its
     resident entity lanes and scatters into a full-length sample-axis
     partial, reduced ON DEVICE with a psum over the entity axis — the
     replicated result feeds the CD fused epilogue directly, no host-side
-    assemble and no new device→host syncs."""
+    assemble and no new device→host syncs. ``collective_quant`` selects
+    the psum wire format (int8 ships blockwise-quantized partials and
+    dequant-accumulates in f32); it is part of the cache key, so the two
+    wire modes compile as distinct programs and never cross-hit."""
     from photon_ml_tpu.parallel.distributed import _shard_map
+    from photon_ml_tpu.parallel.quantized_collectives import qpsum
 
     lane = P(ENTITY_AXIS)
 
@@ -724,7 +728,8 @@ def _sharded_score_fn(mesh, num_samples):
         flat = jax.ops.segment_sum(
             margins.reshape(-1), row_ids.reshape(-1).astype(jnp.int32),
             num_segments=num_samples + 1)
-        return lax.psum(flat[:num_samples], ENTITY_AXIS)
+        return qpsum(flat[:num_samples], ENTITY_AXIS,
+                     mode=collective_quant)
 
     fit = _shard_map(impl, mesh, in_specs=(lane, lane, lane, lane),
                      out_specs=P())
@@ -765,6 +770,11 @@ class RandomEffectOptimizationProblem:
     # on different coordinates still tune independently)
     chunk_tuner: ChunkAutoTuner = dataclasses.field(
         default_factory=ChunkAutoTuner, compare=False, repr=False)
+    # Wire format of the sharded score exchange's entity-axis psum
+    # ("none" | "int8", driver --collective-quant). The per-entity
+    # solves themselves have no collectives — entities are independent —
+    # so this only affects the score path.
+    collective_quant: str = "none"
 
     def objective(self) -> GLMObjective:
         cfg = self.config
@@ -999,7 +1009,8 @@ def score_passive(passive_X: Array, passive_entity: Array, coefs: Array,
 
 
 def score_random_effect(dataset: RandomEffectDataset, coefs: Array,
-                        entity_shards: int = 1) -> Array:
+                        entity_shards: int = 1,
+                        collective_quant: str = "none") -> Array:
     """Full sample-axis score vector (active + passive) for this coordinate.
 
     ``coefs`` is the compact global block ``[num_entities, reduced_dim]``;
@@ -1009,15 +1020,24 @@ def score_random_effect(dataset: RandomEffectDataset, coefs: Array,
     block's scoring runs shard-local and the per-shard partial score
     vectors reduce with an on-device psum over the entity axis — the
     replicated result feeds the CD fused epilogue with zero added host
-    syncs. Shard-count 1 is the unchanged single-program path."""
+    syncs; ``collective_quant="int8"`` ships that psum's partials
+    blockwise-quantized (parallel/quantized_collectives.py) and counts
+    the wire bytes on ``collective_bytes{site="re.score_psum"}``.
+    Shard-count 1 is the unchanged single-program path."""
+    from photon_ml_tpu.parallel.quantized_collectives import \
+        record_collective_bytes
 
     def _score_block(X, c_b, row_ids, weights):
         mesh, K = _resolve_entity_shards(entity_shards, int(X.shape[0]))
         if K > 1:
             with trace.span("re.shard_score", shards=K,
                             lanes=int(X.shape[0])):
-                return _sharded_score_fn(mesh, int(dataset.num_samples))(
+                out = _sharded_score_fn(mesh, int(dataset.num_samples),
+                                        collective_quant)(
                     X, c_b, row_ids, weights)
+                record_collective_bytes("re.score_psum", collective_quant,
+                                        int(dataset.num_samples))
+                return out
         return score_active(X, c_b, row_ids, weights, dataset.num_samples)
 
     if dataset.buckets is not None:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.data.batch import Batch, DenseBatch
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.parallel.quantized_collectives import qpsum
 
 Array = jnp.ndarray
 
@@ -54,10 +55,11 @@ def _pallas_sums(loss, w_eff, margin_shift, batch,
         w_eff, margin_shift)
 
 
-def _maybe_psum(x, axis_name: Optional[str]):
-    if axis_name is None:
-        return x
-    return jax.lax.psum(x, axis_name)
+def _maybe_psum(x, axis_name: Optional[str], quant: str = "none"):
+    # qpsum is the identity on axis_name=None and a plain lax.psum for
+    # mode "none" and sub-block payloads (every scalar here); int8 mode
+    # compresses only the d-vector sums, which dominate the traffic.
+    return qpsum(x, axis_name, mode=quant)
 
 
 def value_and_gradient(
@@ -66,6 +68,7 @@ def value_and_gradient(
     coef: Array,
     batch: Batch,
     axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ) -> tuple[Array, Array]:
     """Weighted loss value and gradient in normalized coefficient space.
 
@@ -86,9 +89,9 @@ def value_and_gradient(
         r = batch.weights * d1
         vector_sum = batch.weighted_feature_sum(r)
         prefactor_sum = jnp.sum(r)
-    value = _maybe_psum(value, axis_name)
-    vector_sum = _maybe_psum(vector_sum, axis_name)
-    prefactor_sum = _maybe_psum(prefactor_sum, axis_name)
+    value = _maybe_psum(value, axis_name, collective_quant)
+    vector_sum = _maybe_psum(vector_sum, axis_name, collective_quant)
+    prefactor_sum = _maybe_psum(prefactor_sum, axis_name, collective_quant)
     return value, norm.reconstruct_gradient(vector_sum, prefactor_sum)
 
 
@@ -99,6 +102,7 @@ def hessian_vector(
     vector: Array,
     batch: Batch,
     axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ) -> Array:
     """Gauss-Newton Hessian-vector product H v.
 
@@ -113,8 +117,9 @@ def hessian_vector(
     # zv: margin of v without data offsets (offsets are constant in w).
     zv = batch.margins(v_eff, v_shift) - batch.offsets
     r = batch.weights * loss.d2(z, batch.labels) * zv
-    vector_sum = _maybe_psum(batch.weighted_feature_sum(r), axis_name)
-    prefactor_sum = _maybe_psum(jnp.sum(r), axis_name)
+    vector_sum = _maybe_psum(batch.weighted_feature_sum(r), axis_name,
+                             collective_quant)
+    prefactor_sum = _maybe_psum(jnp.sum(r), axis_name, collective_quant)
     return norm.reconstruct_gradient(vector_sum, prefactor_sum)
 
 
@@ -124,6 +129,7 @@ def hessian_diagonal(
     coef: Array,
     batch: Batch,
     axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ) -> Array:
     """Diagonal of the Gauss-Newton Hessian (for variance approximation).
 
@@ -134,12 +140,14 @@ def hessian_diagonal(
     w_eff, margin_shift = norm.effective_coefficients(coef)
     z = batch.margins(w_eff, margin_shift)
     r = batch.weights * loss.d2(z, batch.labels)
-    sq_sum = _maybe_psum(batch.hadamard_square_sum(r), axis_name)
+    sq_sum = _maybe_psum(batch.hadamard_square_sum(r), axis_name,
+                         collective_quant)
     if norm.shifts is None:
         diag = sq_sum
     else:
-        lin_sum = _maybe_psum(batch.weighted_feature_sum(r), axis_name)
-        scalar_sum = _maybe_psum(jnp.sum(r), axis_name)
+        lin_sum = _maybe_psum(batch.weighted_feature_sum(r), axis_name,
+                              collective_quant)
+        scalar_sum = _maybe_psum(jnp.sum(r), axis_name, collective_quant)
         diag = sq_sum - 2.0 * norm.shifts * lin_sum + norm.shifts**2 * scalar_sum
     if norm.factors is not None:
         diag = diag * norm.factors**2
@@ -176,6 +184,11 @@ class GLMObjective:
                                                  metadata=dict(static=True))
     has_hessian: bool = dataclasses.field(default=True,
                                           metadata=dict(static=True))
+    # Wire format of the axis_name collectives ("none" | "int8",
+    # parallel/quantized_collectives.py). Static: it selects which
+    # collective ops get traced, exactly like axis_name itself.
+    collective_quant: str = dataclasses.field(default="none",
+                                              metadata=dict(static=True))
 
     def value(self, coef: Array, batch: Batch) -> Array:
         return self.calculate(coef, batch)[0]
@@ -185,7 +198,8 @@ class GLMObjective:
 
     def calculate(self, coef: Array, batch: Batch) -> tuple[Array, Array]:
         value, grad = value_and_gradient(
-            self.loss, self.norm, coef, batch, self.axis_name
+            self.loss, self.norm, coef, batch, self.axis_name,
+            self.collective_quant,
         )
         # Unconditional arithmetic: l2_lambda may be a tracer inside jit.
         value = value + 0.5 * self.l2_lambda * jnp.dot(coef, coef)
@@ -193,11 +207,13 @@ class GLMObjective:
         return value, grad
 
     def hessian_vector(self, coef: Array, vector: Array, batch: Batch) -> Array:
-        hv = hessian_vector(self.loss, self.norm, coef, vector, batch, self.axis_name)
+        hv = hessian_vector(self.loss, self.norm, coef, vector, batch,
+                            self.axis_name, self.collective_quant)
         return hv + self.l2_lambda * vector
 
     def hessian_diagonal(self, coef: Array, batch: Batch) -> Array:
-        d = hessian_diagonal(self.loss, self.norm, coef, batch, self.axis_name)
+        d = hessian_diagonal(self.loss, self.norm, coef, batch,
+                             self.axis_name, self.collective_quant)
         return d + self.l2_lambda
 
     def with_l2(self, l2_lambda: float) -> "GLMObjective":

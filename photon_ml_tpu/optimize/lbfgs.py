@@ -31,6 +31,7 @@ from photon_ml_tpu.optimize.common import (
     should_continue,
 )
 from photon_ml_tpu.optimize.linesearch import strong_wolfe
+from photon_ml_tpu.parallel.quantized_collectives import qpsum
 
 Array = jnp.ndarray
 
@@ -81,33 +82,40 @@ class LBFGSResume(NamedTuple):
     g0n: Array  # original-dispatch anchor ‖g₀‖
 
 
-def axis_dot(axis_name: Optional[str]):
+def axis_dot(axis_name: Optional[str], collective_quant: str = "none"):
     """d-vector dot product, all-reduced over ``axis_name`` when the
     vectors are shards of a mesh-partitioned weight update (arXiv
     2004.13336): each replica holds a slice of x/g/S/Y, so every inner
-    product in the solver must psum its local partial."""
+    product in the solver must psum its local partial. Routed through
+    ``qpsum`` so the solver's collective sites share the
+    ``--collective-quant`` wire format — the payload here is a scalar,
+    which qpsum always ships uncompressed (a 4-byte partial cannot
+    compress; quantizing it would only add error)."""
     if axis_name is None:
         return jnp.dot
-    return lambda a, b: lax.psum(jnp.dot(a, b), axis_name)
+    return lambda a, b: qpsum(jnp.dot(a, b), axis_name,
+                              mode=collective_quant)
 
 
-def axis_norm(axis_name: Optional[str]):
+def axis_norm(axis_name: Optional[str], collective_quant: str = "none"):
     """d-vector 2-norm, all-reduced over ``axis_name`` (see axis_dot)."""
     if axis_name is None:
         return jnp.linalg.norm
-    return lambda a: jnp.sqrt(lax.psum(jnp.sum(a * a), axis_name))
+    return lambda a: jnp.sqrt(qpsum(jnp.sum(a * a), axis_name,
+                                    mode=collective_quant))
 
 
 def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
                        head: Array,
-                       axis_name: Optional[str] = None) -> Array:
+                       axis_name: Optional[str] = None,
+                       collective_quant: str = "none") -> Array:
     """Two-loop recursion over a masked circular history buffer.
 
     With ``axis_name`` set, g/S/Y are per-replica shards and every inner
     product is psum'd — the recursion then produces this replica's shard
     of the exact full-dimension direction."""
     m = S.shape[0]
-    vdot = axis_dot(axis_name)
+    vdot = axis_dot(axis_name, collective_quant)
 
     # Order slots newest -> oldest: head-1, head-2, ...
     idx = (head - 1 - jnp.arange(m)) % m
@@ -139,7 +147,7 @@ def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, valid: Array,
     return -r
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 9, 10))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 9, 10, 11))
 def _minimize_lbfgs_impl(
     value_and_grad_fn,
     x0: Array,
@@ -152,6 +160,7 @@ def _minimize_lbfgs_impl(
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
     update_axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ):
     # ``data`` is a traced pytree (the batch): one compiled kernel per
     # function object serves every batch of the same shape — critical for the
@@ -173,8 +182,8 @@ def _minimize_lbfgs_impl(
         raise ValueError(
             "sharded weight update supports neither box constraints nor "
             "track_iterates")
-    vdot = axis_dot(update_axis_name)
-    vnorm = axis_norm(update_axis_name)
+    vdot = axis_dot(update_axis_name, collective_quant)
+    vnorm = axis_norm(update_axis_name, collective_quant)
     d = x0.shape[0]
     dtype = x0.dtype
     if resume is None:
@@ -220,7 +229,7 @@ def _minimize_lbfgs_impl(
 
     def body(c: _LBFGSCarry) -> _LBFGSCarry:
         direction = two_loop_direction(c.g, c.S, c.Y, c.rho, c.valid, c.head,
-                                       update_axis_name)
+                                       update_axis_name, collective_quant)
         dphi0 = vdot(c.g, direction)
         # Safeguard: fall back to steepest descent if not a descent direction.
         bad = dphi0 >= 0.0
@@ -315,6 +324,7 @@ def minimize_lbfgs(
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
     update_axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ):
     """Minimize ``f(x, data)`` from ``x0``; returns (x, RunHistory, made_progress).
 
@@ -336,8 +346,9 @@ def minimize_lbfgs(
     return obs_compile.call(
         "optimizer.lbfgs", _minimize_lbfgs_impl,
         (value_and_grad_fn, x0, data, max_iter, m, tolerance, box,
-         track_iterates, resume, return_carry, update_axis_name),
-        static_argnums=(0, 3, 4, 5, 7, 9, 10),
+         track_iterates, resume, return_carry, update_axis_name,
+         collective_quant),
+        static_argnums=(0, 3, 4, 5, 7, 9, 10, 11),
         arg_names=("value_and_grad_fn", "x0", "data", "max_iter", "m",
                    "tolerance", "box", "track_iterates", "resume",
-                   "return_carry", "update_axis_name"))
+                   "return_carry", "update_axis_name", "collective_quant"))

@@ -40,6 +40,7 @@ from photon_ml_tpu.optimize.lbfgs import (
     axis_norm,
     two_loop_direction,
 )
+from photon_ml_tpu.parallel.quantized_collectives import qpsum
 
 Array = jnp.ndarray
 
@@ -75,7 +76,7 @@ class _OWLQNCarry(NamedTuple):
     iterates: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
-@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8, 10, 11))
+@partial(jax.jit, static_argnums=(0, 3, 4, 5, 8, 10, 11, 12))
 def _minimize_owlqn_impl(
     value_and_grad_fn,
     x0: Array,
@@ -89,6 +90,7 @@ def _minimize_owlqn_impl(
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
     update_axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ):
     # Sharded weight update (see lbfgs): x0/g/l1 are per-replica shards,
     # every d-vector reduction (including the L1 penalty sum) is psum'd.
@@ -97,8 +99,8 @@ def _minimize_owlqn_impl(
         raise ValueError(
             "sharded weight update supports neither box constraints nor "
             "track_iterates")
-    vdot = axis_dot(update_axis_name)
-    vnorm = axis_norm(update_axis_name)
+    vdot = axis_dot(update_axis_name, collective_quant)
+    vnorm = axis_norm(update_axis_name, collective_quant)
     d = x0.shape[0]
     dtype = x0.dtype
     l1 = jnp.broadcast_to(jnp.asarray(l1, dtype), (d,))
@@ -110,7 +112,8 @@ def _minimize_owlqn_impl(
         penalty = jnp.sum(l1 * jnp.abs(x),
                           dtype=jnp.promote_types(dtype, jnp.float32))
         if update_axis_name is not None:
-            penalty = lax.psum(penalty, update_axis_name)
+            penalty = qpsum(penalty, update_axis_name,
+                            mode=collective_quant)
         return f + penalty, g
 
     # ``resume`` continues a previous chunk's solve verbatim: carry
@@ -162,7 +165,7 @@ def _minimize_owlqn_impl(
     def body(c: _OWLQNCarry) -> _OWLQNCarry:
         pg = pseudo_gradient(c.x, c.g, l1)
         direction = two_loop_direction(pg, c.S, c.Y, c.rho, c.valid, c.head,
-                                       update_axis_name)
+                                       update_axis_name, collective_quant)
         # Project direction onto the orthant of -pg (keep only components
         # that actually descend along the pseudo-gradient).
         direction = jnp.where(direction * pg < 0.0, direction, 0.0)
@@ -267,6 +270,7 @@ def minimize_owlqn(
     resume: Optional[LBFGSResume] = None,
     return_carry: bool = False,
     update_axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ):
     """Minimize f(x, data) + l1 ||x||_1; returns (x, RunHistory, made_progress).
 
@@ -280,8 +284,9 @@ def minimize_owlqn(
     return obs_compile.call(
         "optimizer.owlqn", _minimize_owlqn_impl,
         (value_and_grad_fn, x0, data, max_iter, m, tolerance, l1, box,
-         track_iterates, resume, return_carry, update_axis_name),
-        static_argnums=(0, 3, 4, 5, 8, 10, 11),
+         track_iterates, resume, return_carry, update_axis_name,
+         collective_quant),
+        static_argnums=(0, 3, 4, 5, 8, 10, 11, 12),
         arg_names=("value_and_grad_fn", "x0", "data", "max_iter", "m",
                    "tolerance", "l1", "box", "track_iterates", "resume",
-                   "return_carry", "update_axis_name"))
+                   "return_carry", "update_axis_name", "collective_quant"))

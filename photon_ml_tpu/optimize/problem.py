@@ -89,8 +89,15 @@ class GLMOptimizationProblem:
     # constraints and track_iterates (falls back to the replicated
     # update there).
     shard_weight_update: bool = False
+    # Wire format of the mesh collectives this problem's sharded solve
+    # emits ("none" | "int8", parallel/quantized_collectives.py —
+    # driver --collective-quant). Irrelevant on the local backend.
+    collective_quant: str = "none"
 
     def __post_init__(self):
+        from photon_ml_tpu.parallel.quantized_collectives import \
+            check_quant_mode
+        check_quant_mode(self.collective_quant)
         if (self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
                 and self.config.optimizer_type == OptimizerType.TRON):
             # function/svm has no Hessian: DiffFunction only
@@ -108,6 +115,7 @@ class GLMOptimizationProblem:
             norm=self.normalization,
             l2_lambda=l2,
             has_hessian=self.task != TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+            collective_quant=self.collective_quant,
         )
 
     # -- solve ---------------------------------------------------------------
@@ -141,19 +149,22 @@ class GLMOptimizationProblem:
                 vg, x0, payload, l1=l1_arr,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
                 box=self.box, track_iterates=self.track_iterates,
-                update_axis_name=update_axis_name)
+                update_axis_name=update_axis_name,
+                collective_quant=self.collective_quant)
         if cfg.optimizer_type == OptimizerType.LBFGS:
             return minimize_lbfgs(
                 vg, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
                 box=self.box, track_iterates=self.track_iterates,
-                update_axis_name=update_axis_name)
+                update_axis_name=update_axis_name,
+                collective_quant=self.collective_quant)
         if cfg.optimizer_type == OptimizerType.TRON:
             return minimize_tron(
                 vg, hvp, x0, payload,
                 max_iter=cfg.max_iterations, tolerance=cfg.tolerance,
                 box=self.box, track_iterates=self.track_iterates,
-                update_axis_name=update_axis_name)
+                update_axis_name=update_axis_name,
+                collective_quant=self.collective_quant)
         raise ValueError(f"unknown optimizer {cfg.optimizer_type}")
 
     def publish(self, x: Array, history, progressed,

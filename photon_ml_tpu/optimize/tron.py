@@ -59,15 +59,17 @@ class _CGState(NamedTuple):
 
 
 def _truncated_cg(hvp, gradient: Array, delta: Array,
-                  axis_name: Optional[str] = None) -> tuple[Array, Array, Array]:
+                  axis_name: Optional[str] = None,
+                  collective_quant: str = "none"
+                  ) -> tuple[Array, Array, Array]:
     """Approximately solve H s = -g within ||s|| <= delta.
 
     Returns (cg_iterations, step, residual). ``hvp(v)`` computes H v.
     With ``axis_name`` set, gradient/step are per-replica shards and every
     inner product is psum'd (see lbfgs.axis_dot).
     """
-    vdot = axis_dot(axis_name)
-    vnorm = axis_norm(axis_name)
+    vdot = axis_dot(axis_name, collective_quant)
+    vnorm = axis_norm(axis_name, collective_quant)
     tol = 0.1 * vnorm(gradient)
     r0 = -gradient
 
@@ -152,7 +154,7 @@ class TRONResume(NamedTuple):
     g0n: Array
 
 
-@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8, 10, 11))
+@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 8, 10, 11, 12))
 def _minimize_tron_impl(
     value_and_grad_fn,
     hvp_fn,
@@ -166,6 +168,7 @@ def _minimize_tron_impl(
     resume: Optional[TRONResume] = None,
     return_carry: bool = False,
     update_axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ):
     # Sharded weight update (see lbfgs): x0/g are per-replica shards, CG
     # and region arithmetic psum every d-vector reduction. hvp_fn must
@@ -174,8 +177,8 @@ def _minimize_tron_impl(
         raise ValueError(
             "sharded weight update supports neither box constraints nor "
             "track_iterates")
-    vdot = axis_dot(update_axis_name)
-    vnorm = axis_norm(update_axis_name)
+    vdot = axis_dot(update_axis_name, collective_quant)
+    vnorm = axis_norm(update_axis_name, collective_quant)
     dtype = x0.dtype
     if resume is None:
         f_start, g_start = value_and_grad_fn(x0, data)
@@ -214,7 +217,8 @@ def _minimize_tron_impl(
 
     def body(c: _TRONCarry) -> _TRONCarry:
         _, step, residual = _truncated_cg(
-            lambda v: hvp_fn(c.x, v, data), c.g, c.delta, update_axis_name)
+            lambda v: hvp_fn(c.x, v, data), c.g, c.delta, update_axis_name,
+            collective_quant)
 
         x_try = c.x + step
         gs = vdot(c.g, step)
@@ -325,6 +329,7 @@ def minimize_tron(
     resume: Optional[TRONResume] = None,
     return_carry: bool = False,
     update_axis_name: Optional[str] = None,
+    collective_quant: str = "none",
 ):
     """Trust-region Newton; returns (x, RunHistory, made_progress).
 
@@ -341,8 +346,9 @@ def minimize_tron(
         "optimizer.tron", _minimize_tron_impl,
         (value_and_grad_fn, hvp_fn, x0, data, max_iter, tolerance,
          max_failures, box, track_iterates, resume, return_carry,
-         update_axis_name),
-        static_argnums=(0, 1, 4, 5, 6, 8, 10, 11),
+         update_axis_name, collective_quant),
+        static_argnums=(0, 1, 4, 5, 6, 8, 10, 11, 12),
         arg_names=("value_and_grad_fn", "hvp_fn", "x0", "data", "max_iter",
                    "tolerance", "max_failures", "box", "track_iterates",
-                   "resume", "return_carry", "update_axis_name"))
+                   "resume", "return_carry", "update_axis_name",
+                   "collective_quant"))

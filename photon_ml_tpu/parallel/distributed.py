@@ -62,6 +62,10 @@ from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.optimize.common import OptimizationResult, solver_x0
 from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, pad_rows_to_multiple
+from photon_ml_tpu.parallel.quantized_collectives import (
+    qall_gather,
+    record_collective_bytes,
+)
 
 Array = jnp.ndarray
 
@@ -120,6 +124,23 @@ def run_glm_shard_map(
     )
     x, history, progressed = jax.jit(fit)(batch, x0)
 
+    # Host-side collective-traffic ledger (collectives run inside the
+    # jitted loop where counting is impossible): one d-vector gradient
+    # psum per iteration on every backend, plus the sharded update's
+    # per-evaluation iterate all-gather of one shard. Line-search extra
+    # evaluations are invisible here — a documented lower bound, applied
+    # identically for both wire modes so the ratio is exact.
+    iters = int(history.num_iterations)
+    itemsize = jnp.dtype(batch.acc_dtype).itemsize
+    record_collective_bytes("fe.grad_psum", problem.collective_quant,
+                            dim, itemsize=itemsize, rounds=iters)
+    if shard_update:
+        d_pad = pad_rows_to_multiple(dim, n_shards)
+        record_collective_bytes("fe.iterate_gather",
+                                problem.collective_quant,
+                                d_pad // n_shards, itemsize=itemsize,
+                                rounds=iters)
+
     # Variances/publication run on the full (GSPMD-sharded) batch.
     return problem.publish(x, history, progressed, problem.objective(),
                            batch)
@@ -139,9 +160,13 @@ def _sharded_update_local_fit(problem: GLMOptimizationProblem, obj,
     """
     d_pad = pad_rows_to_multiple(dim, n_shards)
     shard_d = d_pad // n_shards
+    quant = problem.collective_quant
 
     def gather_full(x_shard):
-        return lax.all_gather(x_shard, DATA_AXIS, tiled=True)[:dim]
+        # the per-evaluation iterate/gradient gather — the compressible
+        # wire traffic of the sharded update (every replica dequantizes
+        # the same bytes, so iterates stay replica-identical)
+        return qall_gather(x_shard, DATA_AXIS, mode=quant)[:dim]
 
     def slice_own(full_vec):
         start = lax.axis_index(DATA_AXIS) * shard_d

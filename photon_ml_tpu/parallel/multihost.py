@@ -280,6 +280,8 @@ def run_game_worker(
     blocks_dir=None,
     checkpoint_dir=None,
     checkpoint_every_coordinates: int = 0,
+    precision: str = "f32",
+    collective_quant: str = "none",
     stop=None,
 ) -> dict:
     """One multi-host GAME training process: fixed + random effects CD.
@@ -330,6 +332,14 @@ def run_game_worker(
     stops EVERY member at the same coordinate — the collective snapshot
     fires once, then all members raise
     :class:`~photon_ml_tpu.utils.preempt.PreemptionRequested`.
+
+    ``precision`` / ``collective_quant`` are the mixed-precision flag
+    pair (cli/args.py): storage dtype for the design-matrix tiles and
+    RE blocks, and the wire format of the mesh collectives. Both shape
+    every member's traced collective programs (payload dtypes and
+    shapes), so a mismatch would wedge the gang mid-collective — they
+    ride the same formation-time signature check as the checkpoint
+    cadence and fail fast with the per-process values.
     """
     import os
 
@@ -362,6 +372,7 @@ def run_game_worker(
             feature_shard_sections, index_maps, fixed_coordinate,
             random_coordinates, task, num_iterations, num_buckets,
             blocks_dir, checkpoint_dir, checkpoint_every_coordinates,
+            precision=precision, collective_quant=collective_quant,
             stop=stop)
     finally:
         jax.distributed.shutdown()
@@ -371,7 +382,8 @@ def _game_worker_body(
         process_id, num_processes, train_paths, feature_shard_sections,
         index_maps, fixed_coordinate, random_coordinates, task,
         num_iterations, num_buckets, blocks_dir=None, checkpoint_dir=None,
-        checkpoint_every_coordinates=0, stop=None):
+        checkpoint_every_coordinates=0, precision="f32",
+        collective_quant="none", stop=None):
     """Post-initialize body of :func:`run_game_worker` (imports deferred
     until the distributed backend is live)."""
     import os
@@ -396,6 +408,26 @@ def _game_worker_body(
     from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
     from photon_ml_tpu.parallel.distributed import run_glm_shard_map
     from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    # Precision / collective-quant shape the TRACED collective programs
+    # (payload dtypes, int8 q+scale shapes), so a per-host mismatch would
+    # wedge the gang mid-collective with an opaque shape error — validate
+    # locally BEFORE any ingestion or collective work, then gang-check
+    # the codes alongside the checkpoint cadence below.
+    from photon_ml_tpu.cli.args import PRECISION_CHOICES, precision_dtype
+    from photon_ml_tpu.parallel.quantized_collectives import (
+        QUANT_MODES,
+        check_quant_mode,
+    )
+
+    if precision not in PRECISION_CHOICES:
+        raise ValueError(f"unknown precision {precision!r}; "
+                         f"expected one of {PRECISION_CHOICES}")
+    check_quant_mode(collective_quant)
+    # Host-side staging stays f32 everywhere; the storage dtype applies
+    # at the device commit (to_global/to_global_ent), mirroring the
+    # single-host builders' device-commit cast.
+    store_dtype = np.dtype(precision_dtype(precision))
 
     devs = jax.devices()
     n_local = len(jax.local_devices())
@@ -426,8 +458,10 @@ def _game_worker_body(
     # bound — fail fast with the real reason instead.
     ckpt_sig = (-1 if checkpoint_dir is None
                 else int(checkpoint_every_coordinates))
-    n_all = allgather_ragged(np.asarray([n_loc, n_local, ckpt_sig],
-                                        np.int64))
+    prec_sig = PRECISION_CHOICES.index(precision)
+    quant_sig = QUANT_MODES.index(collective_quant)
+    n_all = allgather_ragged(np.asarray(
+        [n_loc, n_local, ckpt_sig, prec_sig, quant_sig], np.int64))
     n_per = np.asarray([int(x[0]) for x in n_all])
     dev_per = np.asarray([int(x[1]) for x in n_all])
     if not (dev_per == n_local).all():
@@ -442,6 +476,16 @@ def _game_worker_body(
             f"all members issue the snapshot collectives at the same "
             f"--checkpoint-every-coordinates cadence); got per-process "
             f"values {ckpt_per.tolist()} (-1 = checkpointing off)")
+    for sig_col, flag, choices in ((3, "--precision", PRECISION_CHOICES),
+                                   (4, "--collective-quant", QUANT_MODES)):
+        per = np.asarray([int(x[sig_col]) for x in n_all])
+        if per.min() != per.max():
+            raise RuntimeError(
+                f"{flag} must be identical on EVERY process of the gang "
+                f"(it shapes the traced collective programs — payload "
+                f"dtypes and quantized wire shapes — so a mismatch "
+                f"deadlocks the mesh collectives); got per-process "
+                f"values {[choices[v] for v in per.tolist()]}")
     L = int(-(-int(n_per.max()) // n_local) * n_local)
     n_pad_total = L * num_processes
 
@@ -555,7 +599,10 @@ def _game_worker_body(
                     == process_id * block.X.shape[0])
             for field in ("X", "labels", "base_offsets", "weights",
                           "row_ids"):
-                setattr(block, field, to_global_ent(getattr(block, field)))
+                val = getattr(block, field)
+                if field == "X":  # design tiles only; scalars stay f32
+                    val = np.asarray(val, store_dtype)
+                setattr(block, field, to_global_ent(val))
         if re_ds.passive_X is not None:
             # passive rows stay host-side numpy: they enter jitted
             # scoring as replicated constants next to the entity-sharded
@@ -575,9 +622,11 @@ def _game_worker_body(
             fac_coord = FactoredRandomEffectCoordinate(
                 dataset=re_ds,
                 problem=RandomEffectOptimizationProblem(
-                    config=fac_re_cfg, task=task),
+                    config=fac_re_cfg, task=task,
+                    collective_quant=collective_quant),
                 latent_problem=GLMOptimizationProblem(
-                    config=fac_latent_cfg, task=task),
+                    config=fac_latent_cfg, task=task,
+                    collective_quant=collective_quant),
                 latent_dim=fac_mf_cfg.num_factors,
                 num_inner_iterations=fac_mf_cfg.max_number_iterations)
         coords.append({
@@ -585,7 +634,8 @@ def _game_worker_body(
             "id_type": r_data_cfg.random_effect_type,
             "ds": re_ds,
             "prob": RandomEffectOptimizationProblem(
-                config=r_opt_cfg, task=task),
+                config=r_opt_cfg, task=task,
+                collective_quant=collective_quant),
             "fac": fac_coord,
         })
 
@@ -593,6 +643,7 @@ def _game_worker_body(
     f_mat = local.feature_shards[f_data_cfg.feature_shard_id].tocsr()
     X_loc = np.zeros((L, f_mat.shape[1]), np.float32)
     X_loc[:n_loc] = f_mat.toarray()
+    X_loc = np.asarray(X_loc, store_dtype)
     sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     def to_global(loc, extra_dims=()):
@@ -608,7 +659,8 @@ def _game_worker_body(
     X_g = to_global(X_loc, (X_loc.shape[1],))
     y_g = to_global(resp_loc)
     w_g = to_global(wt_loc)
-    f_problem = GLMOptimizationProblem(config=f_opt_cfg, task=task)
+    f_problem = GLMOptimizationProblem(config=f_opt_cfg, task=task,
+                                       collective_quant=collective_quant)
 
     def gather_global(x_global):
         """Sharded global [N_pad] vector → replicated numpy on every host."""

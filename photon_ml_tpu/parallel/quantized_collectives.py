@@ -1,0 +1,187 @@
+"""EQuARX-style quantized collectives: int8 wire traffic, f32 math.
+
+PR 12 made the GAME collective traffic real — mesh-sharded random-effect
+scoring psums a full sample-axis partial every chunk, and the sharded
+fixed-effect update all-gathers coefficient/gradient shards every
+objective evaluation. EQuARX (PAPERS.md, arXiv 2306.08585) shows that
+quantizing exactly this traffic — blockwise-scaled int8 with full-
+precision accumulation — costs negligible model quality at 2-4x less
+bytes moved. This module is that trade as two drop-in wrappers:
+
+- :func:`qpsum` — ``lax.psum`` with optional int8 payload compression:
+  quantize the local partial blockwise (per-block absmax scale),
+  all-gather the int8 payload + f32 scales (the compressed wire
+  traffic), then dequantize and SUM IN F32 on every device. The
+  accumulator is always f32 (photonlint W801-clean by construction);
+  only the wire representation is low-precision.
+- :func:`qall_gather` — tiled ``lax.all_gather`` of a 1-D shard with
+  the same blockwise-int8 wire format, dequantized to the caller's
+  dtype on arrival.
+
+Mode ``"none"`` (the default everywhere) is byte-for-byte the plain
+collective — callers thread a ``--collective-quant`` flag and pay
+nothing until they opt in. Payloads smaller than one quantization block
+(scalars: every solver inner product) also fall back to the plain
+collective: a 4-byte scalar cannot compress, and quantizing it would
+only add error.
+
+Error model: per-block absmax scaling bounds the per-element
+quantization error by ``absmax(block) / 127 / 2`` — relative error
+~0.4% of the block's largest magnitude. Summing K dequantized shards
+in f32 grows the absolute error at most linearly in K. Outlier-heavy
+blocks (one huge element) degrade the rest of their block; the block
+size trades scale overhead (4 bytes per ``block`` elements) against
+outlier blast radius.
+
+Accounting: collectives run inside jit, so byte counting is host-side
+at the dispatch sites (:func:`record_collective_bytes`), feeding the
+``collective_bytes{site,mode}`` counter. Counts are per-device payload
+bytes per collective round — a deliberate lower bound (line-search
+extra evaluations inside a fused solver loop are invisible to the
+host), consistent across modes so the compression ratio is exact.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+Array = jnp.ndarray
+
+#: Wire-format modes for the quantized collective wrappers.
+QUANT_MODES = ("none", "int8")
+
+#: Elements per quantization block: per-block f32 absmax scale amortized
+#: over this many int8 payload elements (1.6% byte overhead), small
+#: enough that one outlier only degrades its own 256-element block.
+QUANT_BLOCK = 256
+
+
+def check_quant_mode(mode: str) -> str:
+    """Validate a ``--collective-quant`` value; returns it for chaining."""
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"unknown collective-quant mode {mode!r}; "
+            f"expected one of {QUANT_MODES}")
+    return mode
+
+
+def quantize_blockwise(x: Array, block: int = QUANT_BLOCK
+                       ) -> tuple[Array, Array]:
+    """Flatten + pad ``x`` to blocks of ``block`` and quantize each to
+    int8 with a per-block absmax scale. Returns ``(q [nb, block] int8,
+    scale [nb] f32)``; ``dequantize_blockwise`` inverts it up to the
+    documented per-block error bound. Zero blocks quantize to zeros
+    with scale 0 (the round trip is exact there)."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = absmax * jnp.float32(1.0 / 127.0)
+    # scale == 0 => the whole block is 0 => 0 / tiny == 0: no where needed
+    safe = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(jnp.round(blocks / safe[:, None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_blockwise(q: Array, scale: Array) -> Array:
+    """int8 blocks + per-block scales back to f32 (``[..., nb, block]``).
+    The multiply runs in f32: the f32 accumulator every downstream
+    reduction relies on starts here, NOT at the reduction."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def _engages(x: Array, mode: str, block: int) -> bool:
+    # static decision (shapes are concrete under trace): sub-block
+    # payloads — every scalar psum in the solvers — can't compress
+    return mode == "int8" and x.size >= block
+
+
+def qpsum(x: Array, axis_name, mode: str = "none",
+          block: int = QUANT_BLOCK) -> Array:
+    """``lax.psum(x, axis_name)`` with optional int8 wire compression.
+
+    ``axis_name=None`` is the identity (the un-sharded caller
+    convention shared with ``aggregators._maybe_psum``). Mode
+    ``"none"``, scalars, and sub-block payloads take the plain psum.
+    int8 mode ships ``ceil(n/block)`` int8 blocks + f32 scales instead
+    of ``n`` f32 elements, then dequantizes and sums the K shard
+    partials in f32 on every device — same replicated result contract
+    as psum, reassociated like any tree reduction."""
+    if axis_name is None:
+        return x
+    x = jnp.asarray(x)
+    if not _engages(x, check_quant_mode(mode), block):
+        return lax.psum(x, axis_name)
+    q, scale = quantize_blockwise(x, block)
+    q_all = lax.all_gather(q, axis_name)        # [K, nb, block] int8 wire
+    scale_all = lax.all_gather(scale, axis_name)  # [K, nb] f32 wire
+    total = jnp.sum(dequantize_blockwise(q_all, scale_all), axis=0,
+                    dtype=jnp.float32)
+    return total.reshape(-1)[: x.size].reshape(x.shape).astype(x.dtype)
+
+
+def qall_gather(x: Array, axis_name, mode: str = "none",
+                block: int = QUANT_BLOCK) -> Array:
+    """Tiled ``lax.all_gather`` of a 1-D shard with optional int8 wire
+    compression (the sharded-update iterate/gradient gather of
+    arXiv 2004.13336). Every device receives each shard's int8 blocks +
+    scales and dequantizes locally, so the full vector is f32-identical
+    on all replicas (the bit-identical-iterates invariant survives —
+    everyone dequantizes the same bytes)."""
+    if axis_name is None:
+        return x
+    x = jnp.asarray(x)
+    if x.ndim != 1 or not _engages(x, check_quant_mode(mode), block):
+        return lax.all_gather(x, axis_name, tiled=True)
+    n = x.shape[0]
+    q, scale = quantize_blockwise(x, block)
+    q_all = lax.all_gather(q, axis_name)          # [K, nb, block]
+    scale_all = lax.all_gather(scale, axis_name)  # [K, nb]
+    deq = dequantize_blockwise(q_all, scale_all)
+    k = deq.shape[0]
+    # trim each shard's block padding before tiling the shards together
+    return deq.reshape(k, -1)[:, :n].reshape(-1).astype(x.dtype)
+
+
+# -- host-side byte accounting ---------------------------------------------
+
+
+def collective_payload_bytes(num_elements: int, itemsize: int = 4,
+                             mode: str = "none",
+                             block: int = QUANT_BLOCK) -> int:
+    """Per-device wire payload of one collective round: what one shard
+    contributes to the gather/reduce. int8 mode counts the quantized
+    blocks plus their f32 scales; sub-block payloads fall back exactly
+    like the wrappers do, so the ratio reported by the counters matches
+    the bytes the compiled program actually moves."""
+    n = int(num_elements)
+    if check_quant_mode(mode) == "int8" and n >= block:
+        nblocks = -(-n // block)
+        return nblocks * block + nblocks * 4
+    return n * int(itemsize)
+
+
+def record_collective_bytes(site: str, mode: str, num_elements: int,
+                            itemsize: int = 4, rounds: int = 1,
+                            block: int = QUANT_BLOCK,
+                            registry: MetricsRegistry = REGISTRY) -> int:
+    """Count ``rounds`` collective rounds of the given payload on the
+    ``collective_bytes{site,mode}`` counter (host-side: collectives run
+    inside jit where counting is impossible — dispatch sites call this
+    with their known round count, documented as a lower bound). The
+    ``mode`` label records the EFFECTIVE wire format: an int8 request
+    whose payload is sub-block ships plain f32, and is labeled so."""
+    effective = ("int8" if check_quant_mode(mode) == "int8"
+                 and int(num_elements) >= block else "none")
+    nbytes = collective_payload_bytes(num_elements, itemsize, mode,
+                                      block) * max(0, int(rounds))
+    if nbytes:
+        registry.counter("collective_bytes").inc(nbytes, site=site,
+                                                 mode=effective)
+    return nbytes

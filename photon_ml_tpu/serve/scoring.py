@@ -151,6 +151,7 @@ class ServingScorer:
                  id_types: Sequence[str] = (),
                  hbm_budget_bytes: int = 64 << 20,
                  host_tier_entities: int = 65536,
+                 tier_dtype: str = "f32",
                  min_bucket: int = MIN_BUCKET,
                  max_batch_rows: int = 4096,
                  registry: MetricsRegistry = REGISTRY):
@@ -171,10 +172,12 @@ class ServingScorer:
                   and m.entity_ids is not None
                   and m.coefficients.shape[0] > 0]
         per_store = hbm_budget_bytes // max(len(tiered), 1)
+        self.tier_dtype = tier_dtype
         self.stores = {
             cid: TieredCoefficientStore(
                 cid, model.models[cid], per_store,
-                host_capacity=host_tier_entities, registry=registry)
+                host_capacity=host_tier_entities,
+                device_dtype=tier_dtype, registry=registry)
             for cid in tiered}
         self._fold_fn = _make_fold(len(model.models))
         #: Generation tag, assigned by :class:`GenerationStore` when the

@@ -756,6 +756,12 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    help="device-tier coefficient budget, split across "
                         "the random-effect coordinates")
     p.add_argument("--host-tier-entities", type=int, default=65536)
+    p.add_argument("--serve-tier-dtype", choices=("f32", "bf16"),
+                   default="f32",
+                   help="device-tier storage dtype: bf16 halves row "
+                        "bytes (~2x hot-tier capacity under the same "
+                        "budget) at the cost of bf16-rounded "
+                        "device-tier hits; host/model tiers stay f32")
     p.add_argument("--min-bucket", type=int, default=8,
                    help="smallest power-of-two pad bucket (batches of "
                         "1..min-bucket rows share one compiled shape)")
@@ -866,6 +872,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                 hbm_budget_bytes=int(
                     ns.serve_hbm_budget_mb * (1 << 20)),
                 host_tier_entities=ns.host_tier_entities,
+                tier_dtype=ns.serve_tier_dtype,
                 min_bucket=ns.min_bucket,
                 max_batch_rows=ns.max_batch_rows)
             scorer.generation = generation

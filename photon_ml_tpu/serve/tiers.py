@@ -7,12 +7,15 @@ skewed — so only the hot head earns device residency.
 
 Three tiers, checked in order per request row:
 
-- **device** — a fixed ``[H, D]`` f32 block in device memory. ``H``
-  comes from the HBM budget (``budget // row_bytes``, the same
-  accounting the PR 11 ``hbm_bytes`` gauges report), so eviction
-  pressure IS the budget. Hits are gathered with a jitted bucketed
+- **device** — a fixed ``[H, D]`` block in device memory, f32 by
+  default or bf16 with ``device_dtype="bf16"``. ``H`` comes from the
+  HBM budget (``budget // row_bytes``, the same accounting the PR 11
+  ``hbm_bytes`` gauges report), so eviction pressure IS the budget —
+  and the bf16 tier's halved ``row_bytes`` buys ~2x hot-tier capacity
+  under the same budget. Hits are gathered with a jitted bucketed
   gather routed through ``obs/compile`` — one compile per pad bucket,
-  zero retraces warm.
+  zero retraces warm; the bf16 gather dequantizes to f32 on-device
+  inside the same jitted call.
 - **host** — an LRU of entities recently evicted from the device block
   (indices into the model block, so the tier costs O(1) per entry).
 - **model** — the full coefficient block loaded from the on-disk model;
@@ -23,10 +26,14 @@ Promotion and eviction are counted per tier
 (``serve_tier_hits{coordinate,tier}``, ``serve_tier_promote``,
 ``serve_tier_evict``) so the hit rate is a first-class serving metric.
 
-Bit-parity invariant: every tier stores the SAME f32 rows the model
-block holds (device transfer of f32 is bit-exact both ways), so the
-host-side rowwise dot downstream sees identical inputs no matter which
-tier served a row.
+Bit-parity invariant: with the default f32 device tier every tier
+stores the SAME f32 rows the model block holds (device transfer of f32
+is bit-exact both ways), so the host-side rowwise dot downstream sees
+identical inputs no matter which tier served a row. ``device_dtype=
+"bf16"`` deliberately trades that invariant for capacity: device-tier
+hits return bf16-rounded rows (max relative rounding error 2^-8 per
+element) while host/model-tier hits stay exact — enable it only when
+the scoring tolerance absorbs bf16 rounding.
 """
 
 from __future__ import annotations
@@ -52,6 +59,17 @@ from photon_ml_tpu.serve.batcher import bucket_rows
 #: keeps a warmed bucket warm across a generation flip.
 _GATHER_FN = jax.jit(lambda block, slots: block[slots])
 _PROMOTE_FN = jax.jit(lambda block, rows, slots: block.at[slots].set(rows))
+#: bf16 device tier: dequantize to f32 INSIDE the jitted gather so the
+#: host only ever sees f32 rows (one fused gather+upcast, no second
+#: device round-trip). Distinct function identity → distinct obs sites
+#: (the ``.bf16`` site tag below), so a mixed f32/bf16 fleet never
+#: reads as cross-dtype retraces at a shared site.
+_GATHER_DEQUANT_FN = jax.jit(
+    lambda block, slots: block[slots].astype(jnp.float32))
+
+#: Device-tier storage dtypes: row_bytes drives both the capacity
+#: calculation and the ``serve_tier_device_bytes`` accounting.
+TIER_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
 #: ``serve_tier_device_bytes`` is the SUM of live device blocks per
 #: (registry, coordinate) — during a hot-swap two generations' stores
@@ -83,17 +101,26 @@ class TieredCoefficientStore:
 
     def __init__(self, coordinate_id: str, model: RandomEffectModel,
                  hbm_budget_bytes: int, host_capacity: int = 65536,
+                 device_dtype: str = "f32",
                  registry: MetricsRegistry = REGISTRY):
         if model.entity_ids is None:
             raise ValueError(
                 f"coordinate {coordinate_id!r}: tiered store needs raw "
                 f"entity_ids (models loaded from disk carry them)")
+        if device_dtype not in TIER_DTYPES:
+            raise ValueError(
+                f"coordinate {coordinate_id!r}: unknown device_dtype "
+                f"{device_dtype!r}; expected one of "
+                f"{tuple(TIER_DTYPES)}")
         self.coordinate_id = coordinate_id
         self._registry = registry
         self._block_np = np.asarray(model.coefficients, np.float32)
         e, d = self._block_np.shape
         self.dim = d
-        self.row_bytes = d * 4
+        self.device_dtype = device_dtype
+        self._dev_dtype = TIER_DTYPES[device_dtype]
+        self._site_tag = "" if device_dtype == "f32" else f".{device_dtype}"
+        self.row_bytes = d * jnp.dtype(self._dev_dtype).itemsize
         # sorted-comparable raw ids (python-string compare — the same
         # convention as models._codes_via_ids, so tier lookups and
         # untiered scoring resolve entities identically)
@@ -103,11 +130,12 @@ class TieredCoefficientStore:
         self.capacity = int(max(1, min(
             max(e, 1), hbm_budget_bytes // max(self.row_bytes, 1))))
         self.host_capacity = int(max(0, host_capacity))
-        self._device_block = jnp.zeros((self.capacity, d), jnp.float32)
+        self._device_block = jnp.zeros((self.capacity, d), self._dev_dtype)
         self._slot_of: "OrderedDict[str, int]" = OrderedDict()  # LRU
         self._free = list(range(self.capacity))
         self._host: "OrderedDict[str, int]" = OrderedDict()  # id → row
-        self._gather_fn = _GATHER_FN
+        self._gather_fn = (_GATHER_FN if device_dtype == "f32"
+                           else _GATHER_DEQUANT_FN)
         self._promote_fn = _PROMOTE_FN
         self.released = False
         _account_device_bytes(registry, coordinate_id,
@@ -164,7 +192,7 @@ class TieredCoefficientStore:
         """Bucketed jitted scatter of promoted rows into the block."""
         if self._device_block is None:  # re-warm after release()
             self._device_block = jnp.zeros((self.capacity, self.dim),
-                                           jnp.float32)
+                                           self._dev_dtype)
             self.released = False
             _account_device_bytes(self._registry, self.coordinate_id,
                                   self.capacity * self.row_bytes)
@@ -180,9 +208,10 @@ class TieredCoefficientStore:
             slots_np = np.concatenate(
                 [slots_np, np.repeat(slots_np[:1], bucket - k)])
         self._device_block = obs_compile.call(
-            f"serve.tier_promote[{self.coordinate_id}.b{bucket}]",
+            f"serve.tier_promote[{self.coordinate_id}"
+            f"{self._site_tag}.b{bucket}]",
             self._promote_fn,
-            (self._device_block, jnp.asarray(rows_np),
+            (self._device_block, jnp.asarray(rows_np, self._dev_dtype),
              jnp.asarray(slots_np)),
             arg_names=("block", "rows", "slots"))
 
@@ -243,7 +272,8 @@ class TieredCoefficientStore:
                 slots = np.concatenate(
                     [slots, np.repeat(slots[:1], bucket - u)])
             rows_dev = obs_compile.call(
-                f"serve.tier_gather[{self.coordinate_id}.b{bucket}]",
+                f"serve.tier_gather[{self.coordinate_id}"
+                f"{self._site_tag}.b{bucket}]",
                 self._gather_fn,
                 (self._device_block, jnp.asarray(slots)),
                 arg_names=("block", "slots"))
@@ -267,6 +297,7 @@ class TieredCoefficientStore:
             "coordinate": self.coordinate_id,
             "device_entities": len(self._slot_of),
             "device_capacity": self.capacity,
+            "device_dtype": self.device_dtype,
             "host_entities": len(self._host),
             "host_capacity": self.host_capacity,
             "device_bytes": (0 if self.released

@@ -935,10 +935,13 @@ def test_w601_seeded_axis_typo_in_random_effect(tmp_path_factory):
         ignore=shutil.ignore_patterns("__pycache__"))
     target = root / "photon_ml_tpu" / "game" / "random_effect.py"
     src = target.read_text()
-    needle = "lax.psum(flat[:num_samples], ENTITY_AXIS)"
+    # PR 18 routed the score exchange through the quantized qpsum
+    # wrapper; W601 treats it as a collective, so the typo protection
+    # must survive the wrapper swap.
+    needle = "qpsum(flat[:num_samples], ENTITY_AXIS,"
     assert needle in src, "score-exchange psum moved; update this test"
     target.write_text(src.replace(
-        needle, 'lax.psum(flat[:num_samples], "entty")'))
+        needle, 'qpsum(flat[:num_samples], "entty",'))
     report = runner.lint(root, paths=["photon_ml_tpu"],
                          families={"W6"})
     w601 = [f for f in report.new if f.rule == "W601"]
@@ -2086,3 +2089,45 @@ def test_changed_files_filter_keeps_whole_program_resolution(tmp_path):
     report = runner.lint(tmp_path, paths=["pkg"], families={"W8"},
                          changed_paths={"pkg/cold.py"})
     assert report.new == []
+
+
+def test_w801_seeded_qpsum_dequant_downgrade(tmp_path_factory):
+    """Downcasting the qpsum dequant buffer to bf16 while dropping the
+    sum's ``dtype=jnp.float32`` accumulator must fire W801 on a scratch
+    copy — the f32-accumulate contract of the quantized collectives is
+    enforced, not just promised in the module docstring."""
+    root = tmp_path_factory.mktemp("qpsum_acc")
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    target = (root / "photon_ml_tpu" / "parallel"
+              / "quantized_collectives.py")
+    src = target.read_text()
+    needle = (
+        "    total = jnp.sum(dequantize_blockwise(q_all, scale_all), "
+        "axis=0,\n"
+        "                    dtype=jnp.float32)\n")
+    assert needle in src, "qpsum dequant-sum moved; update this test"
+    target.write_text(src.replace(needle, (
+        "    deq = dequantize_blockwise(q_all, scale_all)"
+        ".astype(jnp.bfloat16)\n"
+        "    total = jnp.sum(deq, axis=0)\n")))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         families={"W8"})
+    w801 = [f for f in report.new if f.rule == "W801"
+            and f.path == ("photon_ml_tpu/parallel/"
+                           "quantized_collectives.py")]
+    assert w801, [f.format() for f in report.new]
+
+
+def test_quantized_collectives_clean_without_suppressions():
+    """The quantized collective wrappers must pass the collective-axis
+    (W6xx) and precision (W8xx) families clean BY CONSTRUCTION — zero
+    findings AND zero suppression directives in the source."""
+    rel = "photon_ml_tpu/parallel/quantized_collectives.py"
+    assert "photonlint:" not in (REPO_ROOT / rel).read_text(), \
+        f"{rel} must not need suppressions"
+    report = runner.lint(REPO_ROOT, paths=["photon_ml_tpu"],
+                         families={"W6", "W8"}, baseline=None)
+    hits = [f for f in report.new if f.path == rel]
+    assert hits == [], [f.format() for f in hits]

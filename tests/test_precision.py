@@ -1,0 +1,324 @@
+"""Mixed precision + quantized collectives (PR 18).
+
+Three contracts:
+
+1. **bf16 storage, f32 math**: a bf16 design matrix must reproduce the
+   f32 objective trajectory within bf16 input-rounding tolerance on all
+   three solvers, judged against an f64 oracle on a NON-separable
+   problem (label noise keeps f* well away from 0, so relative gaps
+   mean something).
+2. **int8 wire, f32 accumulate**: qpsum/qall_gather round-trip within
+   the documented per-block absmax error bound, fall back bitwise to
+   the plain collective for scalars/mode="none", and stay
+   replica-identical.
+3. **Flag surface**: drivers and the serving entrypoint accept/reject
+   the precision flags consistently (multihost gang checks and the
+   serve tier store share the same vocabularies).
+"""
+
+import argparse
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from photon_ml_tpu.data.batch import DenseBatch
+from photon_ml_tpu.optimize.config import (
+    GLMOptimizationConfiguration,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    TaskType,
+)
+from photon_ml_tpu.optimize.problem import GLMOptimizationProblem
+from photon_ml_tpu.parallel.distributed import _shard_map
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from photon_ml_tpu.parallel.quantized_collectives import (
+    QUANT_BLOCK,
+    check_quant_mode,
+    collective_payload_bytes,
+    dequantize_blockwise,
+    qall_gather,
+    qpsum,
+    quantize_blockwise,
+    record_collective_bytes,
+)
+
+
+def _noisy_logistic_data(rng, n=2048, d=64):
+    """Non-separable logistic data: labels drawn FROM the sigmoid, so a
+    fraction land on the wrong side and f* stays O(0.1)·n — near-zero
+    losses would make relative trajectory comparison meaningless."""
+    X = (rng.normal(size=(n, d)) / np.sqrt(d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.random(n) < p).astype(np.float32)
+    return X, y
+
+
+def _batch(X, y, dtype):
+    n = X.shape[0]
+    return DenseBatch(
+        X=jnp.asarray(X, dtype),
+        labels=jnp.asarray(y, jnp.float32),
+        offsets=jnp.zeros(n, jnp.float32),
+        weights=jnp.ones(n, jnp.float32),
+    )
+
+
+def _config(optimizer, l1=False):
+    reg = (RegularizationContext(RegularizationType.ELASTIC_NET, alpha=0.5)
+           if l1 else RegularizationContext(RegularizationType.L2))
+    return GLMOptimizationConfiguration(
+        max_iterations=40, tolerance=1e-8, regularization_weight=1.0,
+        optimizer_type=optimizer, regularization_context=reg)
+
+
+@pytest.mark.parametrize("optimizer,l1", [
+    (OptimizerType.LBFGS, False),
+    (OptimizerType.LBFGS, True),  # OWL-QN path
+    (OptimizerType.TRON, False),
+])
+def test_bf16_objective_parity_vs_f64_oracle(rng, optimizer, l1):
+    X, y = _noisy_logistic_data(rng)
+    problem = GLMOptimizationProblem(
+        config=_config(optimizer, l1), task=TaskType.LOGISTIC_REGRESSION)
+    finals = {}
+    for name, dtype in (("f64", jnp.float64), ("f32", jnp.float32),
+                        ("bf16", jnp.bfloat16)):
+        _, result = problem.run(_batch(X, y, dtype))
+        finals[name] = float(result.value)
+        assert np.isfinite(result.value)
+    oracle = finals["f64"]
+    assert abs(oracle) > 1e-2  # non-separable: f* well away from 0
+    # f32 reproduces the oracle tightly; bf16 within input-rounding slack
+    assert abs(finals["f32"] - oracle) / abs(oracle) < 1e-4
+    assert abs(finals["bf16"] - oracle) / abs(oracle) < 2e-2
+
+
+def test_bf16_batch_accumulates_f32():
+    b = _batch(np.ones((4, 4), np.float32), np.ones(4, np.float32),
+               jnp.bfloat16)
+    assert b.X.dtype == jnp.bfloat16
+    assert b.acc_dtype == jnp.float32
+    # the bandwidth win the mode exists for: half the X bytes
+    assert b.X.dtype.itemsize * 2 == jnp.dtype(jnp.float32).itemsize
+
+
+# -- int8 wire format -------------------------------------------------------
+
+
+def test_quantize_roundtrip_error_bound(rng):
+    x = jnp.asarray(rng.normal(size=3 * QUANT_BLOCK + 17).astype(
+        np.float32) * 5.0)
+    q, scale = quantize_blockwise(x)
+    deq = np.asarray(dequantize_blockwise(q, scale)).reshape(-1)[: x.size]
+    # per-element bound: half an int8 step of the block's absmax scale
+    bound = np.repeat(np.asarray(scale), QUANT_BLOCK)[: x.size] / 2.0
+    assert (np.abs(deq - np.asarray(x)) <= bound + 1e-7).all()
+
+
+def test_quantize_zero_block_exact():
+    q, scale = quantize_blockwise(jnp.zeros(QUANT_BLOCK))
+    assert float(np.abs(np.asarray(q)).max()) == 0.0
+    assert float(np.asarray(scale).max()) == 0.0
+    assert float(np.abs(np.asarray(
+        dequantize_blockwise(q, scale))).max()) == 0.0
+
+
+def test_qpsum_int8_multidevice_error_bound(rng, devices):
+    k, n = 4, 4 * QUANT_BLOCK
+    mesh = make_mesh(num_data=k, num_entity=1, devices=devices[:k])
+    shards = rng.normal(size=(k, n)).astype(np.float32) * 3.0
+    flat = jnp.asarray(shards.reshape(-1))
+
+    def local(x):
+        return qpsum(x, DATA_AXIS, mode="int8")
+
+    out = jax.jit(_shard_map(local, mesh=mesh, in_specs=P(DATA_AXIS),
+                             out_specs=P(DATA_AXIS)))(flat)
+    tiles = np.asarray(out).reshape(k, n)
+    want = shards.sum(axis=0)
+    # every replica dequantizes the same bytes → identical tiles
+    for t in tiles[1:]:
+        np.testing.assert_array_equal(tiles[0], t)
+    # error ≤ sum over shards of each shard's per-block half-step
+    bound = np.zeros(n)
+    for s in shards:
+        _, scale = quantize_blockwise(jnp.asarray(s))
+        bound += np.repeat(np.asarray(scale), QUANT_BLOCK)[:n] / 2.0
+    assert (np.abs(tiles[0] - want) <= bound + 1e-6).all()
+
+
+def test_qpsum_scalar_falls_back_bitwise(rng, devices):
+    k = 4
+    mesh = make_mesh(num_data=k, num_entity=1, devices=devices[:k])
+    vals = rng.normal(size=k).astype(np.float32)
+
+    def local(x):
+        # scalar payload: int8 mode must take the EXACT plain-psum path
+        return (qpsum(jnp.sum(x), DATA_AXIS, mode="int8")
+                - qpsum(jnp.sum(x), DATA_AXIS, mode="none"))
+
+    out = jax.jit(_shard_map(local, mesh=mesh, in_specs=P(DATA_AXIS),
+                             out_specs=P()))(jnp.asarray(vals))
+    assert float(np.abs(np.asarray(out)).max()) == 0.0
+
+
+def test_qall_gather_int8_tiled_with_padding(rng, devices):
+    # shard length deliberately NOT a block multiple: the per-shard pad
+    # must be trimmed before tiling, or shards bleed into each other
+    k, n = 4, QUANT_BLOCK + 37
+    mesh = make_mesh(num_data=k, num_entity=1, devices=devices[:k])
+    shards = rng.normal(size=(k, n)).astype(np.float32)
+
+    def local(x):
+        return qall_gather(x, DATA_AXIS, mode="int8")
+
+    out = jax.jit(_shard_map(
+        local, mesh=mesh, in_specs=P(DATA_AXIS),
+        out_specs=P(DATA_AXIS)))(jnp.asarray(shards.reshape(-1)))
+    got = np.asarray(out).reshape(k, k * n)[0].reshape(k, n)
+    for i in range(k):
+        _, scale = quantize_blockwise(jnp.asarray(shards[i]))
+        bound = np.repeat(np.asarray(scale), QUANT_BLOCK)[:n] / 2.0
+        assert (np.abs(got[i] - shards[i]) <= bound + 1e-7).all()
+
+
+def test_qpsum_no_axis_is_identity_bitwise(rng):
+    x = jnp.asarray(rng.normal(size=QUANT_BLOCK * 2).astype(np.float32))
+    assert qpsum(x, None, mode="int8") is x
+
+
+def test_qpsum_single_shard_int8_matches_roundtrip(rng, devices):
+    """1-shard sanity: the int8 bit path with K=1 is exactly one
+    quantize→dequantize round trip of the local shard."""
+    mesh = make_mesh(num_data=1, num_entity=1, devices=devices[:1])
+    x = rng.normal(size=2 * QUANT_BLOCK).astype(np.float32)
+
+    def local(v):
+        return qpsum(v, DATA_AXIS, mode="int8")
+
+    out = jax.jit(_shard_map(local, mesh=mesh, in_specs=P(DATA_AXIS),
+                             out_specs=P(DATA_AXIS)))(jnp.asarray(x))
+    q, scale = quantize_blockwise(jnp.asarray(x))
+    want = np.asarray(dequantize_blockwise(q, scale)).reshape(-1)[: x.size]
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_sharded_glm_fit_int8_converges_close_to_f32(rng, devices):
+    from photon_ml_tpu.parallel.distributed import run_glm_shard_map
+
+    X, y = _noisy_logistic_data(rng, n=1024, d=2 * QUANT_BLOCK)
+    batch = _batch(X, y, jnp.float32)
+    mesh = make_mesh(num_data=4, num_entity=1, devices=devices[:4])
+    finals = {}
+    for mode in ("none", "int8"):
+        problem = GLMOptimizationProblem(
+            config=_config(OptimizerType.LBFGS),
+            task=TaskType.LOGISTIC_REGRESSION,
+            shard_weight_update=True, collective_quant=mode)
+        _, result = run_glm_shard_map(problem, batch, mesh)
+        finals[mode] = float(result.value)
+    assert abs(finals["int8"] - finals["none"]) / abs(
+        finals["none"]) < 1e-3
+
+
+# -- byte accounting --------------------------------------------------------
+
+
+def test_payload_bytes_compression_ratio():
+    n = 4 * QUANT_BLOCK
+    f32 = collective_payload_bytes(n, mode="none")
+    i8 = collective_payload_bytes(n, mode="int8")
+    assert f32 == 4 * n
+    assert i8 == n + 4 * (n // QUANT_BLOCK)  # int8 payload + f32 scales
+    assert 3.5 < f32 / i8 < 4.0
+    # sub-block payloads ship (and are counted as) plain f32
+    assert collective_payload_bytes(3, mode="int8") == 12
+
+
+def test_record_collective_bytes_effective_mode_label():
+    from photon_ml_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    record_collective_bytes("site.a", "int8", 4 * QUANT_BLOCK,
+                            registry=reg)
+    record_collective_bytes("site.a", "int8", 3, registry=reg)  # scalar
+    c = reg.counter("collective_bytes")
+    assert c.value(site="site.a", mode="int8") == \
+        collective_payload_bytes(4 * QUANT_BLOCK, mode="int8")
+    # the sub-block request shipped f32 and must be LABELED f32
+    assert c.value(site="site.a", mode="none") == 12
+
+
+# -- flag surface -----------------------------------------------------------
+
+
+def test_check_quant_mode_rejects_unknown():
+    assert check_quant_mode("int8") == "int8"
+    with pytest.raises(ValueError, match="collective-quant"):
+        check_quant_mode("int4")
+
+
+def test_precision_dtype_mapping():
+    from photon_ml_tpu.cli.args import precision_dtype
+
+    assert precision_dtype("f32") == jnp.float32
+    assert precision_dtype("bf16") == jnp.bfloat16
+    with pytest.raises(ValueError, match="precision"):
+        precision_dtype("f16")
+
+
+def test_precision_flags_parse_and_reject():
+    from photon_ml_tpu.cli.args import add_precision_flags
+
+    p = argparse.ArgumentParser()
+    add_precision_flags(p)
+    ns = p.parse_args([])
+    assert (ns.precision, ns.collective_quant) == ("f32", "none")
+    ns = p.parse_args(["--precision", "bf16", "--collective-quant",
+                       "int8"])
+    assert (ns.precision, ns.collective_quant) == ("bf16", "int8")
+    for bad in (["--precision", "f16"], ["--collective-quant", "int4"]):
+        with pytest.raises(SystemExit):
+            p.parse_args(bad)
+
+
+def test_problem_rejects_unknown_collective_quant():
+    with pytest.raises(ValueError, match="collective-quant"):
+        GLMOptimizationProblem(
+            config=_config(OptimizerType.LBFGS),
+            task=TaskType.LOGISTIC_REGRESSION, collective_quant="int4")
+
+
+def test_multihost_worker_rejects_bad_precision_flags():
+    """The gang worker validates BEFORE any collective: a bad value must
+    be a loud local ValueError, not a wedged mesh."""
+    from photon_ml_tpu.parallel.multihost import _game_worker_body
+
+    for kwargs in ({"precision": "f16"}, {"collective_quant": "int4"}):
+        with pytest.raises(ValueError):
+            _game_worker_body(
+                0, 1, [], {}, {}, ("f", None, None), [], None, 1, 1,
+                **kwargs)
+
+
+def test_serve_tier_dtype_flag_consistency():
+    """--serve-tier-dtype vocabulary == the tier store's; both reject
+    the same unknowns the training flags do."""
+    from photon_ml_tpu.serve.service import parse_args as serve_parse
+    from photon_ml_tpu.serve.tiers import TIER_DTYPES
+
+    base = ["--game-model-input-dir", "/tmp/m",
+            "--feature-shard-id-to-feature-section-keys-map", "global:f"]
+    ns = serve_parse(base)
+    assert ns.serve_tier_dtype == "f32"
+    ns = serve_parse(base + ["--serve-tier-dtype", "bf16"])
+    assert ns.serve_tier_dtype == "bf16"
+    with pytest.raises(SystemExit):
+        serve_parse(base + ["--serve-tier-dtype", "f16"])
+    assert set(TIER_DTYPES) == {"f32", "bf16"}
