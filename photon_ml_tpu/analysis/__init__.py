@@ -40,11 +40,28 @@ collectives checked everywhere.
   flowing into jitted calls, and — given ``--trace-evidence`` —
   ``xla.retrace`` span records from a real run mapped back to the
   dispatch sites that caused them.
+- **W8xx precision discipline** — low-precision reductions without an
+  f32 accumulator, unguarded float64, dtype-erasing host round-trips,
+  implicit mixed-dtype promotion in loss/grad paths.
+- **W9xx thread safety** — inconsistently guarded shared state,
+  non-async-signal-safe handlers, unjoined threads, lock-order
+  inversion.
+- **WAxx wire-protocol drift** — serve-plane string contracts: NDJSON
+  ``kind``s sent vs dispatched, typed-error names raised/rendered vs
+  the ``typed_error`` parse table and the transport-classification
+  set, writer field sets vs kind-pinned reader accesses.
+- **WBxx telemetry-taxonomy drift** — metric/span names emitted vs the
+  README taxonomy tables vs every consumer (``photon_status``,
+  ``bench.py``, trace tools, chaos assertions — loaded as auxiliary
+  modules), plus label-key drift between emit sites sharing a name.
 
 Entry points: :func:`photon_ml_tpu.analysis.runner.lint` (library) and
 ``tools/photonlint.py`` (CLI). Per-line suppressions use
 ``# photonlint: allow-<rule>(reason)`` and a committed baseline file
-grandfathers known findings (see README "Static analysis").
+grandfathers known findings (see README "Static analysis"). Runs can
+be incremental: ``cache_dir=`` / ``--cache-dir`` keys per-file
+artifacts and a whole-program findings replay on content hashes (see
+:mod:`photon_ml_tpu.analysis.cache`).
 """
 
 from photon_ml_tpu.analysis.core import Finding, LintReport  # noqa: F401

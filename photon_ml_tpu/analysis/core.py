@@ -98,9 +98,35 @@ RULES: dict[str, str] = {
             "its lifetime is unbounded at exit",
     "W904": "inconsistent nested lock acquisition order across the "
             "package — deadlock shape",
+    "WA00": "wire-protocol string (message kind / error name) built "
+            "from a fully dynamic expression — statically unauditable",
+    "WA01": "protocol kind sent by a client but handled by no server "
+            "dispatch — the request can only come back as an "
+            "unknown-kind error",
+    "WA02": "server dispatch handles a protocol kind that no client "
+            "ever sends (dead handler / renamed request)",
+    "WA03": "typed serve error that can reach the wire but parses back "
+            "as a generic error — name missing from typed_error()'s "
+            "table",
+    "WA04": "transport-classification set names an error that no code "
+            "path can put on the wire (stale or aliased exception "
+            "name)",
+    "WA05": "reader accesses a wire-message field that no writer of "
+            "that kind ever sets",
+    "WB00": "telemetry name (counter/gauge/histogram/span) built from "
+            "a fully dynamic expression — statically unauditable",
+    "WB01": "emitted telemetry name missing from the README taxonomy "
+            "tables",
+    "WB02": "README taxonomy table row names a metric/span that "
+            "nothing emits",
+    "WB03": "consumer reads a metric/span name that nothing emits — "
+            "phantom consumer / silent dashboard",
+    "WB04": "label-key drift between emit sites sharing one metric "
+            "name (per-label breakdowns silently fragment)",
 }
 
-FAMILIES = ("W0", "W1", "W2", "W3", "W4", "W5", "W6", "W7", "W8", "W9")
+FAMILIES = ("W0", "W1", "W2", "W3", "W4", "W5", "W6", "W7", "W8", "W9",
+            "WA", "WB")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +160,9 @@ class LintReport:
     suppressed: list[Finding]
     stale_baseline: list[dict]  # entries whose findings no longer exist
     files_checked: int = 0
+    # populated when an incremental cache is in play / --stats is asked
+    cache_stats: dict | None = None
+    timings: dict[str, float] | None = None
 
     @property
     def ok(self) -> bool:
@@ -170,7 +199,7 @@ class LintReport:
 # Valid:   photonlint: allow-W104(reason text)
 # Family:  photonlint: allow-W1xx(reason text)
 _ALLOW_RE = re.compile(
-    r"photonlint:\s*allow-(W\d(?:\d\d|xx))\(([^)]*)\)")
+    r"photonlint:\s*allow-(W[0-9A-Z](?:\d\d|xx))\(([^)]*)\)")
 # A comment is a directive only when it STARTS with the marker — prose
 # that merely mentions the word is ignored.
 _DIRECTIVE_RE = re.compile(r"^#\s*photonlint:")

@@ -353,6 +353,29 @@ def literal_in(mod: ModuleInfo, index: "PackageIndex", node: ast.AST):
         return None
 
 
+def name_value(mod: ModuleInfo, index: "PackageIndex",
+               node: ast.AST) -> tuple[str, Optional[str]]:
+    """Bounded string abstraction for protocol/telemetry names.
+
+    Returns ``("literal", s)`` for a statically known string (direct
+    literal or constant resolved through the package),
+    ``("prefix", head)`` for an f-string with a non-empty literal head
+    (``f"serve.combine[b{n}]"`` → prefix ``serve.combine[b``), and
+    ``("dynamic", None)`` for everything else — the WA00/WB00
+    "unauditable name" bucket.
+    """
+    if isinstance(node, ast.JoinedStr):
+        head = node.values[0] if node.values else None
+        if isinstance(head, ast.Constant) and isinstance(head.value, str) \
+                and head.value:
+            return ("prefix", head.value)
+        return ("dynamic", None)
+    value = literal_in(mod, index, node)
+    if isinstance(value, str):
+        return ("literal", value)
+    return ("dynamic", None)
+
+
 def collect_mesh_axes(index: "PackageIndex") -> set[str]:
     """Every axis name the program can legitimately collective over:
     Mesh(..., axis_names) construction sites, ``jax.pmap(axis_name=...)``

@@ -151,8 +151,10 @@ class MemberReplyError(OSError):
 #: OSError)`` and answers with the exception's type name, so these
 #: replies mean "this sub-request hit transport-grade trouble" and take
 #: the same bounded-retry / failover / health path a socket error takes.
+#: (No ``IOError`` entry: it aliases ``OSError`` in Python 3, so
+#: ``type(e).__name__`` can never render it on the wire.)
 _TRANSPORT_REPLY_ERRORS = frozenset({
-    "InjectedFault", "OSError", "IOError", "ConnectionError",
+    "InjectedFault", "OSError", "ConnectionError",
     "ConnectionResetError", "ConnectionAbortedError",
     "ConnectionRefusedError", "BrokenPipeError", "TimeoutError",
     "InterruptedError",

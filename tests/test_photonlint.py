@@ -1315,10 +1315,39 @@ def _format_failure(report):
     return "\n".join(lines)
 
 
-def test_package_has_no_new_findings():
+def test_package_has_no_new_findings(tmp_path):
+    """The tier-1 gate — run THROUGH the incremental cache: cold run
+    populates, the replay must be at least 2x faster with identical
+    findings, and a changed-input rerun (different family subset →
+    different program key) must reuse >=90% of the per-file artifacts."""
+    import time as time_mod
+
+    cache_dir = tmp_path / "photonlint_cache"
+    t0 = time_mod.perf_counter()
     report = runner.lint(REPO_ROOT, paths=["photon_ml_tpu"],
-                         readme=README, baseline=BASELINE)
+                         readme=README, baseline=BASELINE,
+                         cache_dir=cache_dir)
+    cold_secs = time_mod.perf_counter() - t0
     assert report.ok, _format_failure(report)
+    assert report.cache_stats["file_misses"] > 0
+
+    t0 = time_mod.perf_counter()
+    again = runner.lint(REPO_ROOT, paths=["photon_ml_tpu"],
+                        readme=README, baseline=BASELINE,
+                        cache_dir=cache_dir)
+    warm_secs = time_mod.perf_counter() - t0
+    assert again.cache_stats["program_hit"]
+    assert again.format_json() == report.format_json(), \
+        "cached replay must be byte-identical to the cold run"
+    assert warm_secs < cold_secs / 2, \
+        f"cached rerun not faster: {warm_secs:.2f}s vs {cold_secs:.2f}s"
+
+    subset = runner.lint(REPO_ROOT, paths=["photon_ml_tpu"],
+                         readme=README, baseline=BASELINE,
+                         families={"WA", "WB"}, cache_dir=cache_dir)
+    cs = subset.cache_stats
+    hit_rate = cs["file_hits"] / (cs["file_hits"] + cs["file_misses"])
+    assert hit_rate >= 0.9, f"file-level hit rate {hit_rate:.0%}"
 
 
 def test_cli_json_exit_zero():
@@ -2131,3 +2160,758 @@ def test_quantized_collectives_clean_without_suppressions():
                          families={"W6", "W8"}, baseline=None)
     hits = [f for f in report.new if f.path == rel]
     assert hits == [], [f.format() for f in hits]
+
+
+# -- WAxx wire-protocol drift ------------------------------------------------
+
+WA_CLIENT_SCORE_PROBE = """
+class Client:
+    def request(self, msg):
+        return msg
+
+    def score(self, rows):
+        return self.request({"kind": "score", "rows": rows})
+
+    def probe(self):
+        return self.request({"kind": "probe"})
+"""
+
+WA_SERVER_SCORE_ONLY = """
+def serve_loop(recv, send):
+    msg = recv()
+    kind = msg.get("kind")
+    if kind == "score":
+        send({"kind": "scores", "rows": msg.get("rows")})
+"""
+
+WA_SERVER_SCORE_PROBE = """
+def serve_loop(recv, send):
+    msg = recv()
+    kind = msg.get("kind")
+    if kind == "score":
+        send({"kind": "scores", "rows": msg.get("rows")})
+    elif kind == "probe":
+        send({"kind": "pong"})
+"""
+
+WA_CLIENT_SCORE_ONLY = """
+class Client:
+    def request(self, msg):
+        return msg
+
+    def score(self, rows):
+        return self.request({"kind": "score", "rows": rows})
+"""
+
+
+def test_wa01_positive(tmp_path):
+    report = run_fixture(
+        tmp_path, {"serve/client.py": WA_CLIENT_SCORE_PROBE,
+                   "serve/server.py": WA_SERVER_SCORE_ONLY},
+        families={"WA"})
+    assert rules_of(report) == ["WA01"], [f.format() for f in report.new]
+    (f,) = report.new
+    assert '"probe"' in f.message
+    assert f.path == "pkg/serve/client.py", "WA01 names the SEND site"
+
+
+def test_wa01_negative(tmp_path):
+    report = run_fixture(
+        tmp_path, {"serve/client.py": WA_CLIENT_SCORE_PROBE,
+                   "serve/server.py": WA_SERVER_SCORE_PROBE},
+        families={"WA"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wa01_suppressed(tmp_path):
+    client = WA_CLIENT_SCORE_PROBE.replace(
+        'return self.request({"kind": "probe"})',
+        'return self.request({"kind": "probe"})  '
+        '# photonlint: allow-WA01(fixture: probe handler lands next PR)')
+    report = run_fixture(
+        tmp_path, {"serve/client.py": client,
+                   "serve/server.py": WA_SERVER_SCORE_ONLY},
+        families={"WA"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WA01"]
+
+
+def test_wa02_positive(tmp_path):
+    report = run_fixture(
+        tmp_path, {"serve/client.py": WA_CLIENT_SCORE_ONLY,
+                   "serve/server.py": WA_SERVER_SCORE_PROBE},
+        families={"WA"})
+    assert rules_of(report) == ["WA02"], [f.format() for f in report.new]
+    (f,) = report.new
+    assert '"probe"' in f.message
+    assert f.path == "pkg/serve/server.py", "WA02 names the dead handler"
+
+
+def test_wa02_negative(tmp_path):
+    report = run_fixture(
+        tmp_path, {"serve/client.py": WA_CLIENT_SCORE_ONLY,
+                   "serve/server.py": WA_SERVER_SCORE_ONLY},
+        families={"WA"})
+    assert report.new == []
+
+
+def test_wa02_suppressed(tmp_path):
+    server = WA_SERVER_SCORE_PROBE.replace(
+        '    elif kind == "probe":',
+        '    # photonlint: allow-WA02(fixture: probe client lands next'
+        ' PR)\n    elif kind == "probe":')
+    report = run_fixture(
+        tmp_path, {"serve/client.py": WA_CLIENT_SCORE_ONLY,
+                   "serve/server.py": server},
+        families={"WA"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WA02"]
+
+
+def test_wa00_dynamic_kind(tmp_path):
+    src = """
+def emit(client, kinds):
+    for k in kinds:
+        client.request({"kind": k})
+"""
+    report = run_fixture(tmp_path, {"serve/emit.py": src},
+                         families={"WA"})
+    assert "WA00" in rules_of(report), [f.format() for f in report.new]
+    suppressed = src.replace(
+        'client.request({"kind": k})',
+        'client.request({"kind": k})  '
+        '# photonlint: allow-WA00(fixture: kinds come from a test list)')
+    report = run_fixture(tmp_path, {"serve/emit.py": suppressed},
+                         families={"WA"})
+    assert report.new == []
+
+
+def test_wa00_literal_prefix_is_not_dynamic(tmp_path):
+    src = """
+def emit(client, n):
+    client.request({"kind": f"score_b{n}"})
+
+
+def serve_loop(recv, send):
+    msg = recv()
+    kind = msg.get("kind")
+    if kind == "score_b4":
+        send({"kind": "scores"})
+"""
+    report = run_fixture(tmp_path, {"serve/mod.py": src},
+                         families={"WA"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+WA03_PROTOCOL = """
+class ServeRequestError(RuntimeError):
+    pass
+
+
+class ShedError(ServeRequestError):
+    pass
+
+
+class BoomError(ServeRequestError):
+    pass
+
+
+_TYPED_ERRORS = {
+    "BoomError": BoomError,
+}
+
+
+def typed_error(resp):
+    err = resp.get("error")
+    if err is None:
+        return None
+    name = err.partition(":")[0]
+    if name in _TYPED_ERRORS:
+        return _TYPED_ERRORS[name](err)
+    return ServeRequestError(err)
+
+
+def fail(shard):
+    raise BoomError(f"shard {shard} down")
+"""
+
+
+def test_wa03_positive(tmp_path):
+    proto = WA03_PROTOCOL.replace('    "BoomError": BoomError,\n', '')
+    report = run_fixture(tmp_path, {"serve/protocol.py": proto},
+                         families={"WA"})
+    wa03 = [f for f in report.new if f.rule == "WA03"]
+    assert wa03, [f.format() for f in report.new]
+    assert "BoomError" in wa03[0].message
+    assert "raise BoomError" in (
+        tmp_path / "pkg/serve/protocol.py").read_text().splitlines()[
+            wa03[0].line - 1], "WA03 fires at the raise site"
+
+
+def test_wa03_negative(tmp_path):
+    report = run_fixture(tmp_path, {"serve/protocol.py": WA03_PROTOCOL},
+                         families={"WA"})
+    assert [f for f in report.new if f.rule == "WA03"] == [], \
+        [f.format() for f in report.new]
+
+
+def test_wa03_suppressed(tmp_path):
+    proto = WA03_PROTOCOL.replace(
+        '    "BoomError": BoomError,\n', '').replace(
+        '    raise BoomError(f"shard {shard} down")',
+        '    # photonlint: allow-WA03(fixture: parsed by a sidecar, not'
+        ' typed_error)\n'
+        '    raise BoomError(f"shard {shard} down")')
+    report = run_fixture(tmp_path, {"serve/protocol.py": proto},
+                         families={"WA"})
+    assert [f for f in report.new if f.rule == "WA03"] == []
+    assert "WA03" in [f.rule for f in report.suppressed]
+
+
+WA04_FIXTURE = """
+_TRANSPORT_REPLY_ERRORS = frozenset({
+    "OSError",
+    "GhostFault",
+})
+
+
+def run(sock, send):
+    try:
+        return sock.read()
+    except OSError as e:
+        send({"kind": "error", "error": f"{type(e).__name__}: {e}"})
+"""
+
+
+def test_wa04_positive(tmp_path):
+    report = run_fixture(tmp_path, {"serve/fleet.py": WA04_FIXTURE},
+                         families={"WA"})
+    wa04 = [f for f in report.new if f.rule == "WA04"]
+    assert len(wa04) == 1, [f.format() for f in report.new]
+    assert "GhostFault" in wa04[0].message
+    assert wa04[0].path == "pkg/serve/fleet.py"
+
+
+def test_wa04_negative(tmp_path):
+    src = WA04_FIXTURE.replace('    "GhostFault",\n', '')
+    report = run_fixture(tmp_path, {"serve/fleet.py": src},
+                         families={"WA"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wa04_python3_alias_is_unreachable(tmp_path):
+    """The exact PR 19 real finding: ``IOError`` aliases ``OSError`` in
+    Python 3, so ``type(e).__name__`` can never render it."""
+    src = WA04_FIXTURE.replace('"GhostFault"', '"IOError"')
+    report = run_fixture(tmp_path, {"serve/fleet.py": src},
+                         families={"WA"})
+    wa04 = [f for f in report.new if f.rule == "WA04"]
+    assert len(wa04) == 1 and "IOError" in wa04[0].message, \
+        [f.format() for f in report.new]
+
+
+def test_wa04_suppressed(tmp_path):
+    src = WA04_FIXTURE.replace(
+        '    "GhostFault",',
+        '    "GhostFault",  # photonlint: allow-WA04(fixture: emitted '
+        'by an out-of-tree member build)')
+    report = run_fixture(tmp_path, {"serve/fleet.py": src},
+                         families={"WA"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WA04"]
+
+
+WA05_FIXTURE = """
+def hello_msg():
+    return {"kind": "hello", "proto": 1, "model_id": "m0"}
+
+
+def read_hello(recv):
+    msg = recv()
+    if msg.get("kind") == "hello":
+        return msg.get("generation")
+"""
+
+
+def test_wa05_positive(tmp_path):
+    report = run_fixture(tmp_path, {"serve/proto.py": WA05_FIXTURE},
+                         families={"WA"})
+    wa05 = [f for f in report.new if f.rule == "WA05"]
+    assert len(wa05) == 1, [f.format() for f in report.new]
+    assert '"generation"' in wa05[0].message
+    assert '"hello"' in wa05[0].message
+
+
+def test_wa05_negative(tmp_path):
+    src = WA05_FIXTURE.replace('msg.get("generation")',
+                               'msg.get("model_id")')
+    report = run_fixture(tmp_path, {"serve/proto.py": src},
+                         families={"WA"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wa05_open_writer_exempt(tmp_path):
+    """A ``**spread`` writer is an open field set — reads of its kind
+    cannot be judged and must not fire."""
+    src = """
+def hello_msg(extra):
+    return {"kind": "hello", "proto": 1, **extra}
+
+
+def read_hello(recv):
+    msg = recv()
+    if msg.get("kind") == "hello":
+        return msg.get("generation")
+"""
+    report = run_fixture(tmp_path, {"serve/proto.py": src},
+                         families={"WA"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wa05_suppressed(tmp_path):
+    src = WA05_FIXTURE.replace(
+        '        return msg.get("generation")',
+        '        # photonlint: allow-WA05(fixture: field lands with the'
+        ' v2 hello)\n'
+        '        return msg.get("generation")')
+    report = run_fixture(tmp_path, {"serve/proto.py": src},
+                         families={"WA"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WA05"]
+
+
+# -- WBxx telemetry-taxonomy drift -------------------------------------------
+
+WB_EMIT_AND_STATUS = """
+def work(registry):
+    registry.counter("hits").inc(tier="hot")
+    registry.counter("misses").inc(tier="hot")
+
+
+def status(totals):
+    return totals.get("hits")
+"""
+
+WB_README_TAXONOMY = """# fixture
+
+| metric | type | where | labels |
+|--------|------|-------|--------|
+| `hits` | counter | work | `tier` |
+| `misses` | counter | work | `tier` |
+"""
+
+
+def test_wb01_positive(tmp_path):
+    readme = WB_README_TAXONOMY.replace(
+        "| `misses` | counter | work | `tier` |\n", "")
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                         readme=readme, families={"WB"})
+    wb01 = [f for f in report.new if f.rule == "WB01"]
+    assert len(wb01) == 1, [f.format() for f in report.new]
+    assert '"misses"' in wb01[0].message
+    assert wb01[0].path == "pkg/mod.py", "WB01 fires at the emit site"
+
+
+def test_wb01_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                         readme=WB_README_TAXONOMY, families={"WB"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wb01_no_table_no_reconcile(tmp_path):
+    """A README without a metric taxonomy table skips WB01/WB02 — the
+    reconcile is gated on the table existing, exactly like W401's."""
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                        readme="# fixture readme, no tables\n",
+                        families={"WB"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wb01_suppressed(tmp_path):
+    src = WB_EMIT_AND_STATUS.replace(
+        '    registry.counter("misses").inc(tier="hot")',
+        '    # photonlint: allow-WB01(fixture: row lands with the'
+        ' dashboard PR)\n'
+        '    registry.counter("misses").inc(tier="hot")')
+    readme = WB_README_TAXONOMY.replace(
+        "| `misses` | counter | work | `tier` |\n", "")
+    report = run_fixture(tmp_path, {"mod.py": src}, readme=readme,
+                         families={"WB"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WB01"]
+
+
+def test_wb02_positive(tmp_path):
+    readme = WB_README_TAXONOMY + "| `ghost` | counter | nowhere | — |\n"
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                         readme=readme, families={"WB"})
+    wb02 = [f for f in report.new if f.rule == "WB02"]
+    assert len(wb02) == 1, [f.format() for f in report.new]
+    assert "`ghost`" in wb02[0].message
+    assert wb02[0].path == "README.md"
+    assert wb02[0].line == len(readme.splitlines())
+
+
+def test_wb02_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                         readme=WB_README_TAXONOMY, families={"WB"})
+    assert report.new == []
+
+
+def test_wb02_baselined(tmp_path):
+    """README findings have no source line to carry an inline
+    directive, so a deliberate WB02 is grandfathered via the baseline
+    (same workflow as any README-side finding)."""
+    readme = WB_README_TAXONOMY + "| `ghost` | counter | nowhere | — |\n"
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(WB_EMIT_AND_STATUS)
+    readme_path = tmp_path / "README.md"
+    readme_path.write_text(readme)
+    baseline = tmp_path / "baseline.json"
+    n = runner.write_baseline(tmp_path, baseline, paths=["pkg"],
+                              readme=readme_path, families={"WB"})
+    assert n == 1
+    report = runner.lint(tmp_path, paths=["pkg"], readme=readme_path,
+                         baseline=baseline, families={"WB"})
+    assert report.new == []
+    assert [f.rule for f in report.baselined] == ["WB02"]
+
+
+def test_wb03_positive(tmp_path):
+    src = WB_EMIT_AND_STATUS.replace('totals.get("hits")',
+                                     'totals.get("hit_total")')
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    wb03 = [f for f in report.new if f.rule == "WB03"]
+    assert len(wb03) == 1, [f.format() for f in report.new]
+    assert '"hit_total"' in wb03[0].message
+    assert "totals.get" in (tmp_path / "pkg/mod.py").read_text(
+        ).splitlines()[wb03[0].line - 1], "WB03 fires at the read site"
+
+
+def test_wb03_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                         families={"WB"})
+    assert report.new == []
+
+
+def test_wb03_span_name_compare(tmp_path):
+    """Record-name comparisons (``rec.get("name") == ...``) are
+    consumer reads too — of the span namespace."""
+    src = """
+import trace
+
+
+def work():
+    with trace.span("phase.run", step=1):
+        pass
+
+
+def scan(rec):
+    return rec.get("name") == "phase.missing"
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    wb03 = [f for f in report.new if f.rule == "WB03"]
+    assert len(wb03) == 1, [f.format() for f in report.new]
+    assert '"phase.missing"' in wb03[0].message
+    clean = src.replace('"phase.missing"', '"phase.run"')
+    report = run_fixture(tmp_path, {"mod.py": clean}, families={"WB"})
+    assert report.new == []
+
+
+def test_wb03_prefix_emit_matches(tmp_path):
+    """A literal-head f-string emit is a prefix family: consumers of
+    any name under the prefix are satisfied, and no WB00 fires."""
+    src = """
+def work(registry, n):
+    registry.counter(f"bucket_{n}").inc()
+
+
+def status(totals):
+    return totals.get("bucket_3")
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    assert report.new == [], [f.format() for f in report.new]
+
+
+def test_wb03_suppressed(tmp_path):
+    src = WB_EMIT_AND_STATUS.replace(
+        '    return totals.get("hits")',
+        '    # photonlint: allow-WB03(fixture: emitted by the sibling'
+        ' service, not this package)\n'
+        '    return totals.get("hit_total")')
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WB03"]
+
+
+def test_wb04_positive(tmp_path):
+    src = """
+def a(registry):
+    registry.counter("hits").inc(tier="hot")
+
+
+def b(registry):
+    registry.counter("hits").inc()
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    wb04 = [f for f in report.new if f.rule == "WB04"]
+    assert len(wb04) == 1, [f.format() for f in report.new]
+    assert '"hits"' in wb04[0].message and "tier" in wb04[0].message
+
+
+def test_wb04_negative(tmp_path):
+    report = run_fixture(tmp_path, {"mod.py": WB_EMIT_AND_STATUS},
+                         families={"WB"})
+    assert report.new == []
+
+
+def test_wb04_suppressed(tmp_path):
+    src = """
+def a(registry):
+    registry.counter("hits").inc(tier="hot")
+
+
+def b(registry):
+    # photonlint: allow-WB04(fixture: label-less fallback cold path)
+    registry.counter("hits").inc()
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    assert report.new == []
+    assert [f.rule for f in report.suppressed] == ["WB04"]
+
+
+def test_wb00_dynamic_name(tmp_path):
+    src = """
+def work(registry, name):
+    registry.counter(name).inc()
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    assert rules_of(report) == ["WB00"], [f.format() for f in report.new]
+    suppressed = src.replace(
+        "    registry.counter(name).inc()",
+        "    # photonlint: allow-WB00(fixture: names come from operator"
+        " config)\n"
+        "    registry.counter(name).inc()")
+    report = run_fixture(tmp_path, {"mod.py": suppressed},
+                         families={"WB"})
+    assert report.new == []
+
+
+# -- WA/WB canaries on the real package --------------------------------------
+
+def _package_copy(tmp_path_factory, name):
+    root = tmp_path_factory.mktemp(name)
+    shutil.copytree(
+        REPO_ROOT / "photon_ml_tpu", root / "photon_ml_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    shutil.copy(README, root / "README.md")
+    return root
+
+
+def test_wa01_canary_renamed_dispatch_kind(tmp_path_factory):
+    """Renaming the ``score`` dispatch arm (service AND router — both
+    dispatch it) leaves the real client send sites orphaned: WA01 must
+    name the ``ServeClient.score`` send site in protocol.py."""
+    root = _package_copy(tmp_path_factory, "wa01_canary")
+    for rel in ("photon_ml_tpu/serve/service.py",
+                "photon_ml_tpu/serve/router.py"):
+        path = root / rel
+        src = path.read_text()
+        assert 'elif kind == "score":' in src, f"{rel} lost its score arm"
+        path.write_text(src.replace('elif kind == "score":',
+                                    'elif kind == "score_v9":'))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE,
+                         families={"WA"})
+    wa01 = [f for f in report.new if f.rule == "WA01"
+            and '"score"' in f.message]
+    assert wa01, [f.format() for f in report.new]
+    assert any(f.path == "photon_ml_tpu/serve/protocol.py"
+               for f in wa01), "WA01 must name the client send site"
+    # ...and the now-senderless arms fire the other direction
+    assert [f for f in report.new if f.rule == "WA02"
+            and '"score_v9"' in f.message]
+
+
+def test_wa03_canary_typed_error_dropped_from_table(tmp_path_factory):
+    """Deleting ``ShardUnavailableError`` from ``typed_error()``'s
+    table downgrades the fleet's shard-unavailable refusal to a generic
+    error on the client: WA03 must fire at the fleet raise site."""
+    root = _package_copy(tmp_path_factory, "wa03_canary")
+    proto = root / "photon_ml_tpu" / "serve" / "protocol.py"
+    src = proto.read_text()
+    entry = '    "ShardUnavailableError": ShardUnavailableError,\n'
+    assert entry in src, "protocol.py lost its typed-error table entry"
+    proto.write_text(src.replace(entry, ""))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE,
+                         families={"WA"})
+    wa03 = [f for f in report.new if f.rule == "WA03"
+            and "ShardUnavailableError" in f.message]
+    assert wa03, [f.format() for f in report.new]
+    assert all(f.path == "photon_ml_tpu/serve/fleet.py" for f in wa03)
+
+
+def test_wb03_canary_renamed_emit_orphans_router_read(tmp_path_factory):
+    """Renaming the ``serve_route`` counter at its fleet emit site
+    orphans the router's ``by_label`` stats read — the silent-dashboard
+    bug class WB03 exists for."""
+    root = _package_copy(tmp_path_factory, "wb03_canary")
+    fleet = root / "photon_ml_tpu" / "serve" / "fleet.py"
+    src = fleet.read_text()
+    emit = 'self._registry.counter("serve_route").inc(outcome=outcome)'
+    assert emit in src, "fleet.py lost its serve_route emit"
+    fleet.write_text(src.replace(
+        emit,
+        'self._registry.counter("serve_route_v2").inc(outcome=outcome)'))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE,
+                         families={"WB"})
+    wb03 = [f for f in report.new if f.rule == "WB03"
+            and '"serve_route"' in f.message]
+    assert wb03, [f.format() for f in report.new]
+    assert any(f.path == "photon_ml_tpu/serve/router.py" for f in wb03)
+    # the renamed emit is also undocumented + its README row phantom
+    assert [f for f in report.new if f.rule == "WB01"
+            and "serve_route_v2" in f.message]
+    assert [f for f in report.new if f.rule == "WB02"
+            and "serve_route" in f.message]
+
+
+def test_wb03_canary_photon_status_aux_read(tmp_path_factory):
+    """tools/photon_status.py is loaded as an AUXILIARY consumer: after
+    renaming the ``serve_rows_scored`` emit in scoring.py, WB03 must
+    fire at the photon_status totals read — outside the lint path set."""
+    root = _package_copy(tmp_path_factory, "wb03_aux_canary")
+    (root / "tools").mkdir()
+    shutil.copy(REPO_ROOT / "tools" / "photon_status.py",
+                root / "tools" / "photon_status.py")
+    scoring = root / "photon_ml_tpu" / "serve" / "scoring.py"
+    src = scoring.read_text()
+    assert '"serve_rows_scored"' in src
+    scoring.write_text(src.replace('"serve_rows_scored"',
+                                   '"serve_rows_scored_v2"'))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE,
+                         families={"WB"})
+    wb03 = [f for f in report.new if f.rule == "WB03"
+            and '"serve_rows_scored"' in f.message]
+    assert wb03, [f.format() for f in report.new]
+    assert any(f.path == "tools/photon_status.py" for f in wb03)
+
+
+# -- incremental cache -------------------------------------------------------
+
+WB_SECOND_MODULE = """
+def more(registry):
+    registry.counter("extra").inc()
+"""
+
+
+def test_cache_replay_is_identical_and_invalidates_on_edit(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(WB_EMIT_AND_STATUS)
+    (pkg / "b.py").write_text(WB_SECOND_MODULE)
+    cache_dir = tmp_path / "cache"
+
+    cold = runner.lint(tmp_path, paths=["pkg"], families={"WB"},
+                       cache_dir=cache_dir)
+    assert cold.cache_stats["file_misses"] == 2
+    assert not cold.cache_stats["program_hit"]
+
+    warm = runner.lint(tmp_path, paths=["pkg"], families={"WB"},
+                       cache_dir=cache_dir)
+    assert warm.cache_stats["program_hit"]
+    assert warm.format_json() == cold.format_json(), \
+        "replayed findings must be byte-identical"
+
+    # touch-without-edit (same bytes, fresh mtime): still a full hit
+    (pkg / "a.py").write_text(WB_EMIT_AND_STATUS)
+    touched = runner.lint(tmp_path, paths=["pkg"], families={"WB"},
+                          cache_dir=cache_dir)
+    assert touched.cache_stats["program_hit"], \
+        "content-keyed cache must ignore mtimes"
+
+    # a real edit: program replay misses, ONE file reloads, findings
+    # match a from-scratch run exactly
+    (pkg / "a.py").write_text(WB_EMIT_AND_STATUS.replace(
+        'totals.get("hits")', 'totals.get("hit_total")'))
+    edited = runner.lint(tmp_path, paths=["pkg"], families={"WB"},
+                         cache_dir=cache_dir)
+    assert not edited.cache_stats["program_hit"]
+    assert edited.cache_stats["file_hits"] == 1
+    assert edited.cache_stats["file_misses"] == 1
+    fresh = runner.lint(tmp_path, paths=["pkg"], families={"WB"})
+    assert edited.format_json() == fresh.format_json(), \
+        "cached partial rerun must equal a cold run"
+    assert [f.rule for f in edited.new] == ["WB03"]
+
+
+def test_cache_invalidates_when_analyzer_changes(tmp_path, monkeypatch):
+    from photon_ml_tpu.analysis import cache as cache_mod
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(WB_EMIT_AND_STATUS)
+    cache_dir = tmp_path / "cache"
+    runner.lint(tmp_path, paths=["pkg"], families={"WB"},
+                cache_dir=cache_dir)
+    # simulate an edited analyzer: every key must change
+    monkeypatch.setattr(cache_mod, "_analyzer_sig", "different-digest")
+    report = runner.lint(tmp_path, paths=["pkg"], families={"WB"},
+                         cache_dir=cache_dir)
+    assert not report.cache_stats["program_hit"]
+    assert report.cache_stats["file_misses"] == 1
+
+
+def test_cli_stats_and_cache_replay(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(WB_EMIT_AND_STATUS)
+    (tmp_path / "README.md").write_text("# fixture\n")
+    cli = [sys.executable, str(REPO_ROOT / "tools" / "photonlint.py"),
+           "pkg", "--root", str(tmp_path), "--no-baseline",
+           "--readme", str(tmp_path / "README.md"),
+           "--cache-dir", str(tmp_path / "cache"), "--stats"]
+    first = subprocess.run(cli, capture_output=True, text=True)
+    assert first.returncode == 0, first.stdout + first.stderr
+    assert "photonlint: timing WB:" in first.stderr
+    assert "1 miss(es)" in first.stderr
+    second = subprocess.run(cli, capture_output=True, text=True)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "program replay" in second.stderr
+    assert second.stdout == first.stdout, \
+        "cached CLI output must be byte-identical"
+
+
+def test_cli_list_rules_covers_wa_wb():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "photonlint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0
+    for rule_id in ("WA00", "WA01", "WA02", "WA03", "WA04", "WA05",
+                    "WB00", "WB01", "WB02", "WB03", "WB04"):
+        assert f"{rule_id}  " in proc.stdout, f"{rule_id} missing"
+
+
+def test_sarif_golden_fixture(tmp_path):
+    """Pin the full SARIF document — rules array (all families,
+    including WA/WB, with helpUri catalog anchors) and a result — to a
+    committed golden. Regenerate deliberately when the catalog grows:
+    the diff IS the review artifact."""
+    from photon_ml_tpu.analysis.sarif import to_sarif
+
+    report = run_fixture(
+        tmp_path, {"serve/client.py": WA_CLIENT_SCORE_PROBE,
+                   "serve/server.py": WA_SERVER_SCORE_ONLY},
+        families={"WA"})
+    doc = to_sarif(report)
+    golden = json.loads(
+        (REPO_ROOT / "tests" / "goldens" / "sarif_golden.json")
+        .read_text())
+    assert doc == golden, (
+        "SARIF output drifted from tests/goldens/sarif_golden.json — "
+        "if the change is deliberate, regenerate the golden")

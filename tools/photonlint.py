@@ -13,6 +13,8 @@ Usage (from the repo root)::
     python tools/photonlint.py --changed-files       # only files vs HEAD
     python tools/photonlint.py --since origin/main   # only files vs rev
     python tools/photonlint.py --trace-evidence runs/trace  # W702 mode
+    python tools/photonlint.py --stats               # per-family timing
+    python tools/photonlint.py --no-cache            # force a cold run
     python tools/photonlint.py --list-rules
 
 Exit codes: 0 clean (no non-baselined findings), 1 findings, 2 usage or
@@ -100,6 +102,14 @@ def parse_args(argv):
                     help="directory of obs/trace spans (*.jsonl); "
                          "xla.retrace records there drive W702 "
                          "runtime-confirmed retrace findings")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="incremental-cache directory (default: "
+                         ".photonlint_cache/ under --root)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the incremental cache (cold run)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-family timing and cache hit/miss "
+                         "stats to stderr")
     ap.add_argument("--list-rules", action="store_true")
     return ap.parse_args(argv)
 
@@ -156,14 +166,32 @@ def main(argv=None) -> int:
                   + (f" ({pruned} stale entr(ies) pruned)"
                      if pruned else ""))
             return 0
+        cache_dir = None
+        if not ns.no_cache:
+            cache_dir = ns.cache_dir or os.path.join(
+                ns.root, ".photonlint_cache")
         report = runner.lint(
             ns.root, paths=paths, readme=ns.readme,
             baseline=None if ns.no_baseline else ns.baseline,
             families=families, trace_dir=ns.trace_evidence,
-            changed_paths=changed)
+            changed_paths=changed, cache_dir=cache_dir)
     except (OSError, ValueError, SyntaxError) as e:
         print(f"photonlint: error: {e}", file=sys.stderr)
         return 2
+    if ns.stats:
+        if report.timings is not None:
+            for family, secs in sorted(report.timings.items()):
+                print(f"photonlint: timing {family}: {secs*1000:.1f} ms",
+                      file=sys.stderr)
+        else:
+            print("photonlint: timing: (program cache replay — rules "
+                  "did not run)", file=sys.stderr)
+    if report.cache_stats is not None and (ns.stats or not ns.no_cache):
+        cs = report.cache_stats
+        print(f"photonlint: cache: {cs['file_hits']} file hit(s), "
+              f"{cs['file_misses']} miss(es)"
+              + (", program replay" if cs["program_hit"] else ""),
+              file=sys.stderr)
     fmt = "sarif" if ns.sarif else ns.format
     if fmt == "json":
         print(report.format_json())
