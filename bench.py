@@ -1389,6 +1389,45 @@ def bench_avro_ingest(n=200_000, d=30) -> dict:
             "features_per_sec": round(data.features.nnz / dt, 0)}
 
 
+def _serve_stage_split(run_dirs) -> dict:
+    """Per-stage request-pipeline split from serve run dirs' exit
+    metrics snapshots: the ``serve_stage_ms{stage}`` histogram records
+    summed across processes (members + router), reduced to
+    count/mean/max per stage — the "where did request latency go"
+    column BENCH.md tracks next to the end-to-end p99."""
+    agg: dict[str, dict] = {}
+    for rd in run_dirs:
+        try:
+            fh = open(os.path.join(rd, "metrics.jsonl"))
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (rec.get("kind") != "histogram"
+                        or rec.get("name") != "serve_stage_ms"):
+                    continue
+                stage = (rec.get("labels") or {}).get("stage")
+                if stage is None:
+                    continue
+                s = agg.setdefault(stage, {"count": 0, "sum": 0.0,
+                                           "max": 0.0})
+                s["count"] += rec.get("count", 0)
+                s["sum"] += rec.get("sum", 0.0)
+                s["max"] = max(s["max"], rec.get("max", 0.0))
+    return {stage: {"count": int(s["count"]),
+                    "mean_ms": (round(s["sum"] / s["count"], 3)
+                                if s["count"] else None),
+                    "max_ms": round(s["max"], 3)}
+            for stage, s in sorted(agg.items())}
+
+
 def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
                 duration_secs=3.0) -> dict:
     """Sustained concurrent-client load against a real photon-serve
@@ -1594,6 +1633,74 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
             f"serve probe: {retrace_spans} warm retrace(s) across the "
             f"hot-swap — the candidate generation must reuse the "
             f"compiled shapes")
+        # per-stage latency split of the traced run (queue_wait /
+        # batch_form / tier_gather / device_score / reply)
+        stage_ms = _serve_stage_split([trace])
+
+        # tracing-overhead A/B: the SAME fixed request sequence against
+        # an untraced member and one traced at the DEFAULT sample rate
+        # (head sampling + exemplar reservoir armed — the
+        # --trace-dir production posture), alternating timed
+        # repetitions. Min-over-3 within 2% plus a 5 ms timer/
+        # scheduler-granularity floor — the PR 5 train-side tracing
+        # contract applied to the serve plane, asserted HERE because
+        # only the bench spawns real traced/untraced member pairs.
+        def _spawn_ab(name, extra):
+            ab_sock = os.path.join(tmp, f"{name}.sock")
+            ab = subprocess.Popen(
+                [sys.executable, "-m", "photon_ml_tpu.serve.service",
+                 "--game-model-input-dir", model_dir,
+                 "--listen", f"unix:{ab_sock}",
+                 "--feature-shard-id-to-feature-section-keys-map",
+                 "global:globalFeatures|user:userFeatures",
+                 "--random-effect-id-set", "userId",
+                 "--max-batch-rows", "256",
+                 "--serve-hbm-budget-mb", f"{budget_mb:.6f}"] + extra,
+                env=env, cwd=_REPO_DIR, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+            line = ab.stdout.readline().strip()
+            if "ready endpoint=" not in line:
+                ab.kill()
+                raise RuntimeError(
+                    f"serve A/B probe: no ready line: {line!r}")
+            return ab, line.split("endpoint=", 1)[1]
+
+        plain_proc, plain_ep = _spawn_ab("ab_plain", [])
+        traced_proc, traced_ep = _spawn_ab(
+            "ab_traced", ["--trace-dir", os.path.join(tmp, "trace_ab")])
+        try:
+            def timed_pass(client):
+                t0 = time.perf_counter()
+                for lo in range(0, 256, 16):
+                    client.score(records[lo:lo + 16])
+                return time.perf_counter() - t0
+
+            with ServeClient(plain_ep) as pc, \
+                    ServeClient(traced_ep) as tc:
+                for _ in range(2):  # warm tiers + compiles on both
+                    timed_pass(pc)
+                    timed_pass(tc)
+                plain_secs, traced_secs = [], []
+                for _ in range(3):
+                    plain_secs.append(timed_pass(pc))
+                    traced_secs.append(timed_pass(tc))
+        finally:
+            for ab in (plain_proc, traced_proc):
+                if ab.poll() is None:
+                    ab.send_signal(signal.SIGTERM)
+            for ab in (plain_proc, traced_proc):
+                try:
+                    ab.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    ab.kill()
+                    ab.wait()
+        serve_trace_overhead_pct = (
+            100.0 * (min(traced_secs) - min(plain_secs))
+            / min(plain_secs))
+        assert min(traced_secs) <= min(plain_secs) * 1.02 + 0.005, (
+            f"serve tracing overhead too high: {min(plain_secs):.4f}s "
+            f"untraced vs {min(traced_secs):.4f}s traced at the "
+            f"default sample rate")
     total_rows = int(sum(rows_scored))
     total_hits = sum(tier_hits.values())
     # bf16 device-tier capacity delta: the same model and HBM budget,
@@ -1629,6 +1736,11 @@ def bench_serve(n_users=512, d_g=16, d_u=8, n_clients=4,
         "swap_blackout_ms": round(swap_blackout_ms, 2),
         "swap_generation": int(stats.get("generation") or 0),
         "swap_outcome": swap_result.get("outcome"),
+        # request-pipeline stage split (serve_stage_ms from the traced
+        # run's exit snapshot) + the traced-vs-untraced A/B (< 2%
+        # asserted above on a min-over-repetitions basis)
+        "stage_ms": stage_ms,
+        "serve_trace_overhead_pct": round(serve_trace_overhead_pct, 2),
         # same budget, both --serve-tier-dtype values: bf16 halves
         # row_bytes, so hot-tier capacity ~doubles (entity-count capped)
         "tier_capacity": {
@@ -1826,6 +1938,13 @@ def bench_fleet(n_users=512, d_g=16, d_u=8, n_clients=8,
                     except subprocess.TimeoutExpired:
                         proc.kill()
                         proc.wait()
+            # per-stage split across the size's members + router exit
+            # snapshots (written at SIGTERM drain, so read after the
+            # wait loop): member pipeline stages plus the router's
+            # route.dispatch / route.member_wait attribution
+            per_size[size]["stage_ms"] = _serve_stage_split(
+                [f"{tmp}/f{size}m{k}" for k in range(size)]
+                + [f"{tmp}/f{size}router"])
     lo, hi = min(fleet_sizes), max(fleet_sizes)
     base = per_size[lo]["rows_per_sec"] or 1.0
     return {

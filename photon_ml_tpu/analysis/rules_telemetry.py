@@ -2,7 +2,8 @@
 
 The observability plane is held together by names: every
 ``REGISTRY.counter/gauge/histogram("...")`` emit, every
-``trace.span("...")``, the README taxonomy tables operators read, and
+``trace.span("...")`` / ``trace.record_span("...")``, the README
+taxonomy tables operators read, and
 the consumers that aggregate the stream (``tools/photon_status.py``,
 ``bench.py``, ``tools/trace_report.py``, ``tools/trace_diff.py``, the
 chaos drill's assertions). A renamed counter breaks the dashboard
@@ -11,7 +12,11 @@ forever. These rules reconcile the three corners:
 
 - **WB00** a telemetry name built from a fully dynamic expression —
   statically unauditable (an f-string with a literal head is tracked
-  as a prefix and matched by prefix everywhere below).
+  as a prefix and matched by prefix everywhere below; a name drawn
+  from a same-scope ``for name, ... in <literal tuple of tuples>``
+  loop — the stage-span table idiom — resolves to each row's literal
+  first element, constant slices included, so data-driven emit loops
+  stay auditable without suppressions).
 - **WB01** an emitted metric/span name missing from the README
   taxonomy tables (the ``| span |`` / ``| metric |`` tables).
 - **WB02** a README taxonomy row naming a metric/span nothing emits.
@@ -123,8 +128,9 @@ def _metric_call(mod: ModuleInfo, index: PackageIndex, node: ast.AST):
         form, name = name_value(mod, index, node.args[0])
         return (node.func.attr, form, name, node.args[0])
     dotted = mod.resolve(node.func)
-    if dotted is not None and dotted.endswith(".span") \
-            and "trace" in dotted:
+    if dotted is not None and "trace" in dotted \
+            and (dotted.endswith(".span")
+                 or dotted.endswith(".record_span")):
         form, name = name_value(mod, index, node.args[0])
         return ("span", form, name, node.args[0])
     return None
@@ -148,6 +154,89 @@ class _Site:
         self.labels = labels      # frozenset | None (unresolved)
 
 
+def _literal_seq(node: ast.AST):
+    """First-element string literals of a literal tuple/list whose
+    every element is itself a tuple/list led by a string constant
+    (the ``(("serve.batch_form", s, e), ...)`` span-table idiom);
+    None when any row breaks the shape."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    names: list[str] = []
+    for elt in node.elts:
+        if (isinstance(elt, (ast.Tuple, ast.List)) and elt.elts
+                and isinstance(elt.elts[0], ast.Constant)
+                and isinstance(elt.elts[0].value, str)):
+            names.append(elt.elts[0].value)
+        else:
+            return None
+    return names
+
+
+def _iter_literal_names(node: ast.AST, seq_vars: dict):
+    """Resolve a ``for``-loop iterable to the literal names it yields:
+    an inline span table, a local bound to one, or a constant slice of
+    such a local (``stage_spans[1:]``)."""
+    direct = _literal_seq(node)
+    if direct is not None:
+        return direct
+    if isinstance(node, ast.Name):
+        return seq_vars.get(node.id)
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id in seq_vars \
+            and isinstance(node.slice, ast.Slice):
+        bounds = []
+        for b in (node.slice.lower, node.slice.upper, node.slice.step):
+            if b is None:
+                bounds.append(None)
+            elif isinstance(b, ast.Constant) and isinstance(b.value, int):
+                bounds.append(b.value)
+            else:
+                return None
+        return seq_vars[node.value.id][slice(*bounds)]
+    return None
+
+
+def _collect_loop_emits(scope: ast.AST, mod: ModuleInfo,
+                        index: PackageIndex) -> dict[int, tuple]:
+    """``{id(call): literal names}`` for every telemetry call whose
+    name argument is a loop variable bound — by the INNERMOST enclosing
+    for-loop, so two loops reusing one variable name never cross — to
+    a statically literal span table."""
+    seq_vars: dict[str, list] = {}
+    for node in _scoped_walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            names = _literal_seq(node.value)
+            if names is not None:
+                seq_vars[node.targets[0].id] = names
+    out: dict[int, tuple] = {}
+
+    def visit(node: ast.AST, bindings: dict) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.For):
+            names = _iter_literal_names(node.iter, seq_vars)
+            if names and isinstance(node.target, ast.Tuple) \
+                    and node.target.elts \
+                    and isinstance(node.target.elts[0], ast.Name):
+                bindings = dict(bindings)
+                bindings[node.target.elts[0].id] = tuple(names)
+        inner = _metric_call(mod, index, node)
+        if inner is not None:
+            _kind, form, _name, name_node = inner
+            if form == "dynamic" and isinstance(name_node, ast.Name) \
+                    and name_node.id in bindings:
+                out[id(node)] = bindings[name_node.id]
+        for child in ast.iter_child_nodes(node):
+            visit(child, bindings)
+
+    for child in ast.iter_child_nodes(scope):
+        visit(child, {})
+    return out
+
+
 def _scan_module(mod: ModuleInfo, index: PackageIndex,
                  emits: list, consumes: list, findings: list) -> None:
     """One module's emit sites, registry-read consumes, and WB00s."""
@@ -155,6 +244,7 @@ def _scan_module(mod: ModuleInfo, index: PackageIndex,
     for scope in _scopes(mod):
         handled: set[int] = set()
         var_metric: dict[str, tuple] = {}
+        loop_emits = _collect_loop_emits(scope, mod, index)
         # pass 1: chained forms and handle-variable bindings
         for node in _scoped_walk(scope):
             if isinstance(node, ast.Call) \
@@ -213,7 +303,14 @@ def _scan_module(mod: ModuleInfo, index: PackageIndex,
             if skip_emits:
                 continue
             if form == "dynamic":
-                findings.append(_wb00(mod, name_node, kind))
+                names = loop_emits.get(id(node))
+                if names is not None:
+                    for nm in names:
+                        emits.append(_Site(kind, "literal", nm, mod,
+                                           name_node,
+                                           _mutator_labels(node)))
+                else:
+                    findings.append(_wb00(mod, name_node, kind))
             else:
                 emits.append(_Site(kind, form, name, mod, name_node,
                                    _mutator_labels(node)))

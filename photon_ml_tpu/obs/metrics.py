@@ -208,7 +208,15 @@ class MetricsRegistry:
         a distribution like ``re_chunk_active_lanes`` visible live
         (count and running sum; full bucket records still only ship in
         the exit snapshot). Scalar consumers key on scalar names, so
-        the dict-valued entries never collide with them."""
+        the dict-valued entries never collide with them.
+
+        A LABELED histogram's entry additionally carries ``series``:
+        the per-label-set records (count/sum/min/max + cumulative
+        ``le`` buckets), so a consumer like ``photon_status --fleet``
+        can estimate per-label percentiles (the
+        ``serve_stage_ms{stage}`` breakdown) from heartbeat totals
+        alone. Additive: scalar-shaped consumers never see it, and
+        unlabeled histograms stay in the compact two-key form."""
         with self._lock:
             metrics = list(self._metrics.values())
         out: dict = {}
@@ -217,9 +225,16 @@ class MetricsRegistry:
                 out[m.name] = m.total()
             elif isinstance(m, Histogram):
                 records = m.records()
-                out[m.name] = {
+                entry = {
                     "count": sum(r["count"] for r in records),
                     "sum": sum(r["sum"] for r in records)}
+                if any(r["labels"] for r in records):
+                    entry["series"] = [
+                        {"labels": r["labels"], "count": r["count"],
+                         "sum": r["sum"], "min": r["min"],
+                         "max": r["max"], "buckets": r["buckets"]}
+                        for r in records]
+                out[m.name] = entry
         return out
 
     def reset(self) -> None:

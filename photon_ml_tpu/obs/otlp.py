@@ -11,7 +11,11 @@ collectors consume a photon run with zero custom tooling:
   per thread from span containment (start/end nesting — the same sweep
   ``tools/trace_report.py`` uses for self-time), deterministic
   hash-derived trace/span ids so identical inputs convert identically
-  (golden-fixture testable);
+  (golden-fixture testable). Spans carrying propagated request-trace
+  labels (``trace_id``/``span_id``/``parent`` — the serve plane's wire
+  context) keep THOSE ids instead: the parent link then crosses
+  processes, so Jaeger stitches a client→router→member request into
+  one trace without any heuristic;
 - ``metric_totals`` (run_end preferred, else the latest heartbeat)
   plus the exit snapshot's counter/gauge/histogram records become
   ``resourceMetrics`` (sums / gauges / histograms, cumulative
@@ -206,8 +210,19 @@ def records_to_otlp(records: Iterable[dict]) -> dict:
             for (rec, span_id, start, end), parent in zip(group, parents):
                 labels = dict(rec.get("labels") or {})
                 labels["thread.id"] = tid
+                # propagated request-trace context wins over the
+                # containment sweep: its ids are shared across processes
+                # (router stamps them on the wire), so keeping them lets
+                # a collector join the cross-process request tree
+                wire_span = labels.get("span_id")
+                wire_trace = labels.get("trace_id")
+                wire_parent = labels.get("parent")
+                if wire_span:
+                    span_id = str(wire_span)
+                    parent = str(wire_parent) if wire_parent else ""
                 otlp_spans.append({
-                    "traceId": trace_id,
+                    "traceId": (str(wire_trace).zfill(32)[:32]
+                                if wire_trace else trace_id),
                     "spanId": span_id,
                     "parentSpanId": parent,
                     "name": str(rec.get("name", "")),

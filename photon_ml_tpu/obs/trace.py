@@ -119,6 +119,20 @@ class Tracer:
     def span(self, name: str, **labels) -> _Span:
         return _Span(self, name, labels)
 
+    def record_span(self, name: str, start_ns: int, end_ns: int,
+                    depth: int = 0, labels: Optional[dict] = None) -> None:
+        """Record an already-timed span from explicit
+        ``time.perf_counter_ns`` timestamps (same clock as the context
+        manager, so recorded and live spans share one timeline).
+
+        The serve plane's request spans are timed by hand — the start
+        (admission, enqueue) and the end (reply) happen on different
+        threads, so a context manager can't bracket them. Cross-process
+        request linkage rides on ``labels``: ``trace_id``/``span_id``/
+        ``parent`` labels stitch the trees back together in
+        ``tools/trace_merge.py`` / ``obs/otlp.py``."""
+        self._record(name, start_ns, end_ns, depth, labels or None)
+
     def _record(self, name, start_ns, end_ns, depth, labels) -> None:
         event = (name, threading.get_ident(), depth,
                  start_ns - self._t0_ns, end_ns - start_ns, labels)
@@ -169,6 +183,13 @@ class Tracer:
 
     def uptime_seconds(self) -> float:
         return (time.perf_counter_ns() - self._t0_ns) / 1e9
+
+    def rel_ts_us(self, ns: int) -> float:
+        """Tracer-epoch-relative microseconds for a ``perf_counter_ns``
+        stamp — the ``ts_us`` convention of :meth:`events`, so records
+        built outside the tracer (the serve exemplar reservoir) land on
+        the same timeline as drained spans."""
+        return (ns - self._t0_ns) / 1e3
 
     # -- export ------------------------------------------------------------
 
@@ -260,3 +281,13 @@ def span(name: str, **labels):
     if t is None:
         return _NULL_SPAN
     return _Span(t, name, labels)
+
+
+def record_span(name: str, start_ns: int, end_ns: int,
+                depth: int = 0, **labels) -> None:
+    """An explicit-timestamp span on the global tracer (no-op when
+    tracing is off) — see :meth:`Tracer.record_span`."""
+    t = _tracer
+    if t is None:
+        return
+    t.record_span(name, start_ns, end_ns, depth, labels or None)

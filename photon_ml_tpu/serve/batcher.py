@@ -23,7 +23,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from photon_ml_tpu.obs import trace
 from photon_ml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from photon_ml_tpu.serve.reqtrace import child_span_id, observe_stage
 
 #: Smallest pad bucket: micro-batches of 1..8 rows share one shape.
 MIN_BUCKET = 8
@@ -50,6 +52,16 @@ class ScoreWork:
     whatever is current. A batch never spans two generations (see
     :meth:`MicroBatcher.next_batch`), so no response ever mixes scores
     from two models.
+
+    The trace fields are the request's distributed-tracing context
+    (``serve/reqtrace.py``): ``trace_id`` names the end-to-end trace
+    (None = untraced), ``span_id`` is this process's ``serve.request``
+    span, ``parent_span`` the upstream caller's span (the router's
+    ``route.dispatch``), and ``sampled`` gates tracer-span EMISSION —
+    stage timing itself (``serve_stage_ms``) is always on.
+    ``enqueued_ns``/``picked_ns`` are ``perf_counter_ns`` stamps (the
+    span clock) bracketing the queue wait; ``enqueued_at`` stays on
+    ``time.monotonic`` for the existing latency gauges.
     """
 
     rows: list  # decoded records, Avro record shape
@@ -57,6 +69,13 @@ class ScoreWork:
     reply: Callable[[object], None]  # called with the response dict
     enqueued_at: float = field(default_factory=time.monotonic)
     generation: int = 0
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_span: Optional[str] = None
+    sampled: bool = False
+    read_ns: int = 0
+    enqueued_ns: int = field(default_factory=time.perf_counter_ns)
+    picked_ns: int = 0
 
 
 class MicroBatcher:
@@ -125,7 +144,19 @@ class MicroBatcher:
             self._queued_rows -= rows
             self._registry.gauge("serve_queue_depth").set(
                 self._queued_rows)
-            return batch
+        now_ns = time.perf_counter_ns()
+        for w in batch:
+            w.picked_ns = now_ns
+            observe_stage("queue_wait", (now_ns - w.enqueued_ns) / 1e6,
+                          self._registry)
+            if w.sampled and w.trace_id is not None:
+                trace.record_span(
+                    "serve.queue_wait", w.enqueued_ns, now_ns,
+                    trace_id=w.trace_id,
+                    span_id=child_span_id(w.trace_id, "serve.queue_wait",
+                                          w.span_id or 0),
+                    parent=w.span_id)
+        return batch
 
     def queue_depth(self) -> int:
         with self._lock:

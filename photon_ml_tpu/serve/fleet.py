@@ -296,15 +296,28 @@ class Fleet:
 
     # -- dispatch (router reader threads) -------------------------------
 
-    def dispatch(self, shard: int, msg: dict) -> dict:
+    def dispatch(self, shard: int, msg: dict,
+                 timing: Optional[dict] = None) -> dict:
         """Route one sub-request to the shard's owner with bounded
         retry, failing over to the fallback member, shedding typed when
         the shard has no live member. Raises on failure — the caller
         turns the exception into a typed error reply, so every routed
-        request resolves one way or another."""
+        request resolves one way or another.
+
+        ``timing``, when given, is filled with the dispatch's trace
+        facts (the router's ``route.dispatch``/``route.member_wait``
+        spans ride it): ``outcome`` mirrors the ``serve_route{outcome}``
+        ledger entry this dispatch resolved to, ``member`` is the
+        member index that answered (or was last tried), ``hops`` counts
+        failovers taken, and ``wait_start_ns``/``wait_end_ns`` bracket
+        the LAST on-the-wire member round trip
+        (``time.perf_counter_ns``)."""
+        if timing is None:
+            timing = {}
         chain = self.route_chain(shard)
         if not chain:
             self._count("shed")
+            timing["outcome"] = "shed"
             raise ShardUnavailableError(
                 f"shard {shard} has no live member "
                 f"(owner and fallback are dead)")
@@ -312,13 +325,16 @@ class Fleet:
         for hop, member in enumerate(chain):
             if hop:
                 self._count("failover")
+            timing["member"] = member.index
+            timing["hops"] = hop
             try:
                 resp = call_with_retry(
-                    lambda m=member: self._dispatch_once(m, msg),
+                    lambda m=member: self._dispatch_once(m, msg, timing),
                     "serve.route", policy=self._retry, warn=self._warn)
             except RetryExhaustedError as e:
                 self._record_failure(member)
                 self._count("member_failed")
+                timing["outcome"] = "member_failed"
                 last = e.__cause__ or e
                 continue
             except ShedError:
@@ -328,6 +344,7 @@ class Fleet:
                 # would amplify the very overload that caused it — and
                 # an answering member takes no health penalty.
                 self._count("shed")
+                timing["outcome"] = "shed"
                 raise
             except ServeRequestError:
                 # deterministic application error (malformed rows, a
@@ -335,16 +352,20 @@ class Fleet:
                 # no failover, no health penalty — a poison request
                 # stream must not darken a healthy fleet.
                 self._count("error")
+                timing["outcome"] = "error"
                 raise
             self._record_success(member)
             self._count("ok")
+            timing["outcome"] = "failover" if hop else "ok"
             return resp
         self._count("error")
+        timing["outcome"] = "error"
         raise OSError(
             f"shard {shard}: every route attempt failed "
             f"(last: {type(last).__name__}: {last})")
 
-    def _dispatch_once(self, member: FleetMember, msg: dict) -> dict:
+    def _dispatch_once(self, member: FleetMember, msg: dict,
+                       timing: Optional[dict] = None) -> dict:
         with self._lock:
             if member.state == "dead":
                 raise OSError(f"member {member.index} is dead")
@@ -363,6 +384,7 @@ class Fleet:
                     f"member {member.index}: every pooled connection "
                     f"busy for {self._member_timeout:.0f}s") from None
             client = self._repair(member, pool, client)
+            t_wire = time.perf_counter_ns()
             try:
                 resp = client.request(msg)
             except BaseException:
@@ -379,6 +401,13 @@ class Fleet:
                 raise
             else:
                 pool.put(client)
+            finally:
+                if timing is not None:
+                    # the LAST attempt's wire bracket (failed attempts
+                    # overwrite, so the span shows the round trip that
+                    # produced the outcome)
+                    timing["wait_start_ns"] = t_wire
+                    timing["wait_end_ns"] = time.perf_counter_ns()
         finally:
             with self._lock:
                 self._inflight.pop(token, None)

@@ -14,7 +14,8 @@ Grammar (one JSON object per line):
 
 - client → server::
 
-    {"kind": "score", "id": <echoed>, "rows": [<record>, ...]}
+    {"kind": "score", "id": <echoed>, "rows": [<record>, ...],
+     "trace_id"?: "<16-hex>", "parent_span"?: "<16-hex>"}
     {"kind": "ping"}
     {"kind": "stats"}
     {"kind": "swap", "id": <echoed>, "model_dir": "...",
@@ -32,10 +33,12 @@ Grammar (one JSON object per line):
 
 - server → client::
 
-    {"kind": "scores", "proto": 1, "id": ..., "scores": [...], "uids": [...]}
+    {"kind": "scores", "proto": 1, "id": ..., "scores": [...], "uids": [...],
+     "trace_id"?: "<16-hex>"}
     {"kind": "pong",   "proto": 1}
     {"kind": "stats",  "proto": 1, "generation": ..., "last_swap": ..., ...}
-    {"kind": "error",  "proto": 1, "id": ..., "error": "..."}
+    {"kind": "error",  "proto": 1, "id": ..., "error": "...",
+     "trace_id"?: "<16-hex>"}
     {"kind": "swap_result", "proto": 1, "id": ...,
      "outcome": "ok"|"refused", "generation": <now current>,
      "model_id": <now current>, "reason"?: "...", "canary"?: {...},
@@ -55,6 +58,14 @@ Grammar (one JSON object per line):
   that connection's score requests. ``error`` strings follow a typed
   grammar — ``shed:<reason>`` or ``<TypeName>: <message>`` — parsed
   back into exceptions by :func:`typed_error`.
+
+  ``trace_id``/``parent_span`` are the OPTIONAL distributed-tracing
+  context (``serve/reqtrace.py``): absent fields mean an untraced
+  request, so old clients and old members interoperate unchanged. The
+  fleet router mints ids for sampled requests and stamps them onto
+  every scattered sub-request; replies — including ``error`` replies,
+  so a shed or typed refusal stays attributable — echo the
+  ``trace_id`` back to the caller.
 
 Endpoints reuse the telemetry grammar (``host:port`` /
 ``unix:/path.sock``); ``file:`` endpoints are rejected — a request
@@ -186,16 +197,23 @@ def hello(model_id: str, coordinates: Sequence[str],
             "coordinates": list(coordinates)}
 
 
-def error_response(request_id, message: str) -> dict:
-    return {"kind": "error", "proto": SERVE_PROTO, "id": request_id,
-            "error": message}
+def error_response(request_id, message: str,
+                   trace_id: Optional[str] = None) -> dict:
+    out = {"kind": "error", "proto": SERVE_PROTO, "id": request_id,
+           "error": message}
+    if trace_id is not None:
+        out["trace_id"] = trace_id
+    return out
 
 
-def scores_response(request_id, scores, uids=None) -> dict:
+def scores_response(request_id, scores, uids=None,
+                    trace_id: Optional[str] = None) -> dict:
     out = {"kind": "scores", "proto": SERVE_PROTO, "id": request_id,
            "scores": [float(s) for s in scores]}
     if uids is not None:
         out["uids"] = [str(u) for u in uids]
+    if trace_id is not None:
+        out["trace_id"] = trace_id
     return out
 
 
@@ -317,9 +335,20 @@ class ServeClient:
         return resp
 
     def score(self, rows: Sequence[dict],
-              request_id: Optional[str] = None) -> dict:
-        return self.request({"kind": "score", "id": request_id or "0",
-                             "rows": list(rows)})
+              request_id: Optional[str] = None,
+              trace_id: Optional[str] = None,
+              parent_span: Optional[str] = None) -> dict:
+        """Score ``rows``; pass ``trace_id`` (and optionally the
+        caller's ``parent_span``) to request a traced scoring — the
+        reply echoes the id and the far side links its stage spans
+        under it. Omitted = untraced (the wire fields stay absent)."""
+        msg = {"kind": "score", "id": request_id or "0",
+               "rows": list(rows)}
+        if trace_id is not None:
+            msg["trace_id"] = trace_id
+        if parent_span is not None:
+            msg["parent_span"] = parent_span
+        return self.request(msg)
 
     def ping(self) -> dict:
         return self.request({"kind": "ping"})

@@ -69,6 +69,12 @@ from photon_ml_tpu.serve.protocol import (
     scores_response,
     wire_error,
 )
+from photon_ml_tpu.serve.reqtrace import (
+    HeadSampler,
+    TraceIdMinter,
+    child_span_id,
+    observe_stage,
+)
 
 #: Same SLO windows as the single-process service.
 _LATENCY_WINDOW = 1024
@@ -80,11 +86,17 @@ class FleetRouter:
 
     def __init__(self, fleet: Fleet, listen: str,
                  registry: MetricsRegistry = REGISTRY, warn=None,
-                 drain_grace_seconds: float = 2.0):
+                 drain_grace_seconds: float = 2.0,
+                 trace_sample_rate: float = 0.05):
         self.fleet = fleet
         self._registry = registry
         self._warn = warn or (lambda msg: None)
         self._drain_grace = float(drain_grace_seconds)
+        # request tracing: the router is where trace ids are MINTED for
+        # sampled requests (deterministic blake2b counter, no random —
+        # serve/reqtrace.py); members inherit the id over the wire
+        self._sampler = HeadSampler(trace_sample_rate)
+        self._minter = TraceIdMinter()
         self._lock = threading.Lock()
         self._conns: set[socket.socket] = set()
         self._closed = False
@@ -207,19 +219,53 @@ class FleetRouter:
         reassemble in row order. All-or-nothing per request: a shard
         that cannot be served fails the whole request with a typed
         error reply (the client's rows may straddle shards — a partial
-        score vector would be silently wrong)."""
+        score vector would be silently wrong).
+
+        Tracing: a request that arrives with a wire ``trace_id`` is
+        traced; otherwise the head sampler decides and the router MINTS
+        the id. Sampled requests get a router-side ``serve.request``
+        span over ``route.dispatch{shard}`` ⊃ ``route.member_wait``
+        children, every scattered sub-request is stamped with the
+        trace context (``parent_span`` = that shard's dispatch span),
+        and every reply — scores or error — echoes the ``trace_id``."""
         rid = msg.get("id")
         rows = list(msg.get("rows") or [])
         started = time.monotonic()
+        recv_ns = time.perf_counter_ns()
+        wire_tid = msg.get("trace_id")
+        client_parent = msg.get("parent_span")
+        if wire_tid is not None:
+            trace_id, sampled = str(wire_tid), True
+        elif self._sampler.should_sample():
+            trace_id, sampled = self._minter.mint(), True
+        else:
+            trace_id, sampled = None, False
+        client_parent = (str(client_parent)
+                         if client_parent is not None else None)
+        req_span = (child_span_id(trace_id, "serve.request",
+                                  client_parent or 0)
+                    if sampled else None)
+
+        def finish(outcome: str) -> None:
+            if sampled:
+                trace.record_span(
+                    "serve.request", recv_ns, time.perf_counter_ns(),
+                    trace_id=trace_id, span_id=req_span,
+                    parent=client_parent, rows=len(rows),
+                    outcome=outcome)
+
         if not rows:
-            send(scores_response(rid, []))
+            send(scores_response(rid, [], trace_id=trace_id))
             self._note_done(started)
+            finish("ok")
             return
         groups: dict[int, list[int]] = {}
         for pos, row in enumerate(rows):
             if not isinstance(row, dict):
                 send(error_response(
-                    rid, f"TypeError: row {pos} is not an object"))
+                    rid, f"TypeError: row {pos} is not an object",
+                    trace_id=trace_id))
+                finish("error:TypeError")
                 return
             groups.setdefault(self.fleet.shard_of_row(row),
                               []).append(pos)
@@ -235,10 +281,45 @@ class FleetRouter:
         def _scatter(shard: int) -> None:
             sub = {"kind": "score", "id": f"{rid}/s{shard}",
                    "rows": [rows[p] for p in groups[shard]]}
+            dspan = None
+            if sampled:
+                dspan = child_span_id(trace_id, "route.dispatch", shard)
+                sub["trace_id"] = trace_id
+                sub["parent_span"] = dspan
+            timing: dict = {}
+            t0 = time.perf_counter_ns()
             try:
-                outcomes[shard] = self.fleet.dispatch(shard, sub)
+                outcomes[shard] = self.fleet.dispatch(shard, sub,
+                                                      timing=timing)
             except Exception as e:
                 outcomes[shard] = e
+            t1 = time.perf_counter_ns()
+            # stage timing is always on (ledger-consistent); span
+            # emission is what sampling gates
+            observe_stage("route.dispatch", (t1 - t0) / 1e6,
+                          self._registry)
+            wait_s = timing.get("wait_start_ns")
+            wait_e = timing.get("wait_end_ns")
+            if wait_s is not None and wait_e is not None:
+                observe_stage("route.member_wait",
+                              (wait_e - wait_s) / 1e6, self._registry)
+            if sampled:
+                # outcome mirrors the serve_route{outcome} ledger entry
+                # this dispatch resolved to (ok/failover/shed/...)
+                trace.record_span(
+                    "route.dispatch", t0, t1, depth=1,
+                    trace_id=trace_id, span_id=dspan, parent=req_span,
+                    shard=shard, member=timing.get("member", -1),
+                    hops=timing.get("hops", 0),
+                    outcome=str(timing.get("outcome", "error")))
+                if wait_s is not None and wait_e is not None:
+                    trace.record_span(
+                        "route.member_wait", wait_s, wait_e, depth=2,
+                        trace_id=trace_id,
+                        span_id=child_span_id(
+                            trace_id, "route.member_wait", shard),
+                        parent=dspan,
+                        member=timing.get("member", -1))
 
         if len(shards) == 1:
             _scatter(shards[0])
@@ -260,7 +341,9 @@ class FleetRouter:
                 # wire_error keeps the typed grammar intact — a
                 # member's shed:queue_full reaches the client as a
                 # ShedError, not a generic string
-                send(error_response(rid, wire_error(resp)))
+                send(error_response(rid, wire_error(resp),
+                                    trace_id=trace_id))
+                finish(f"error:{type(resp).__name__}")
                 return
             sub_scores = resp.get("scores") or []
             sub_uids = resp.get("uids")
@@ -270,7 +353,9 @@ class FleetRouter:
                 send(error_response(
                     rid, f"RuntimeError: shard {shard} returned "
                          f"{len(sub_scores)} scores for "
-                         f"{len(positions)} rows"))
+                         f"{len(positions)} rows",
+                    trace_id=trace_id))
+                finish("error:ShortReply")
                 return
             if sub_uids is None or len(sub_uids) != len(positions):
                 with_uids = False
@@ -279,8 +364,10 @@ class FleetRouter:
                 if with_uids:
                     uids[p] = sub_uids[i]
         send(scores_response(rid, scores,
-                             uids if with_uids else None))
+                             uids if with_uids else None,
+                             trace_id=trace_id))
         self._note_done(started)
+        finish("ok")
 
     def _note_done(self, started: float) -> None:
         """SLO bookkeeping — reader threads share the windows, so this
@@ -404,6 +491,12 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--drain-grace-seconds", type=float, default=2.0,
                    help="stop-drain bound on waiting for in-flight "
                         "dispatch replies to flush")
+    p.add_argument("--trace-sample-rate", type=float, default=0.05,
+                   help="head-sampling rate for request tracing: this "
+                        "fraction of client requests get a minted "
+                        "trace id and full router+member span trees "
+                        "(deterministic pacing, no RNG; 0 disables, 1 "
+                        "traces everything)")
     p.add_argument("--max-serve-seconds", type=float, default=None,
                    help="scheduled stop: drain and exit 0 (SIGTERM "
                         "drains and exits 75 instead — requeue me)")
@@ -454,7 +547,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                       connections_per_member=ns.member_connections)
         live = fleet.admit_all()
         router = FleetRouter(fleet, ns.listen, warn=logger.warn,
-                            drain_grace_seconds=ns.drain_grace_seconds)
+                             drain_grace_seconds=ns.drain_grace_seconds,
+                             trace_sample_rate=ns.trace_sample_rate)
         router.start()
         logger.info(f"routing {fleet.live_model_id()} across "
                     f"{live}/{len(endpoints)} member(s) on "

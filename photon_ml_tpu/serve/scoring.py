@@ -29,6 +29,7 @@ sliced off before they can touch a real row).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -193,16 +194,21 @@ class ServingScorer:
 
     # -- per-batch path --------------------------------------------------
 
-    def score_records(self, records: Sequence[dict]
+    def score_records(self, records: Sequence[dict],
+                      stages: Optional[dict] = None,
                       ) -> tuple[np.ndarray, Optional[np.ndarray]]:
         """Protocol rows → ``(scores, uids)``. Chunks above the batch
         cap; per-row scores are row-local, so chunk boundaries cannot
-        change any row's bits."""
+        change any row's bits. ``stages`` is an optional accumulator
+        dict the request-tracing layer passes in: per-stage
+        ``perf_counter_ns`` durations (``tier_gather``/``device_score``)
+        are ADDED into it so a chunked request reports the summed cost
+        across its chunks. Timing never touches the score math."""
         if not records:
             return np.zeros(0), None
         if len(records) > self.max_batch_rows:
             parts = [self.score_records(
-                records[i:i + self.max_batch_rows])
+                records[i:i + self.max_batch_rows], stages=stages)
                 for i in range(0, len(records), self.max_batch_rows)]
             scores = np.concatenate([p[0] for p in parts])
             uids = (np.concatenate([p[1] for p in parts])
@@ -211,9 +217,10 @@ class ServingScorer:
         data = game_dataset_from_records(
             records, self.section_keys, self.index_maps,
             id_types=self.id_types, response_required=False)
-        return self.score_dataset(data), data.uids
+        return self.score_dataset(data, stages=stages), data.uids
 
-    def score_dataset(self, data) -> np.ndarray:
+    def score_dataset(self, data, stages: Optional[dict] = None
+                      ) -> np.ndarray:
         """Σ-coordinate score through the tiered stores + bucketed fold.
         Bit-identical to :func:`score_game_dataset` on the same rows."""
         n = data.num_samples
@@ -229,17 +236,24 @@ class ServingScorer:
             raw_ids = np.asarray(
                 [str(x) for x in np.asarray(vocab).ravel()],
                 dtype=object)[codes]
-            w_rows = store.lookup(raw_ids)
+            # the store credits its own wall time to
+            # stages["tier_gather"] — attribution lives in tiers.py
+            w_rows = store.lookup(raw_ids, stages=stages)
             contributions.append(rowwise_sparse_dot(
                 data.feature_shards[m.feature_shard_id], w_rows))
         stacked = np.zeros((len(contributions), bucket), np.float32)
         for i, c in enumerate(contributions):
             stacked[i, :n] = np.asarray(c, np.float32)
+        t0 = time.perf_counter_ns()
         total = obs_compile.call(
             f"serve.combine[b{bucket}]", self._fold_fn,
             (jnp.asarray(stacked),), arg_names=("contributions",))
+        out = np.asarray(total)[:n].astype(np.float64)
+        if stages is not None:
+            stages["device_score"] = stages.get("device_score", 0) \
+                + (time.perf_counter_ns() - t0)
         self._registry.counter("serve_rows_scored").inc(n)
-        return np.asarray(total)[:n].astype(np.float64)
+        return out
 
     def stats(self) -> dict:
         return {"tiers": [s.stats() for s in self.stores.values()],

@@ -92,6 +92,13 @@ from photon_ml_tpu.serve.protocol import (
     scores_response,
     swap_response,
 )
+from photon_ml_tpu.serve.reqtrace import (
+    ExemplarReservoir,
+    HeadSampler,
+    TraceIdMinter,
+    child_span_id,
+    observe_stage,
+)
 from photon_ml_tpu.serve.scoring import GenerationStore, ServingScorer
 from photon_ml_tpu.utils.faults import InjectedFault, fault_point
 from photon_ml_tpu.utils.retry import RetryPolicy, call_with_retry
@@ -172,7 +179,10 @@ class ServeService:
                  probation_secs: float = 5.0,
                  probation_p99_pct: float = 100.0,
                  probation_p99_min_ms: float = 50.0,
-                 probation_max_sheds: int = 0):
+                 probation_max_sheds: int = 0,
+                 trace_sample_rate: float = 0.05,
+                 exemplar_slots: int = 8,
+                 exemplar_path: Optional[str] = None):
         self.gens = GenerationStore(scorer, model_id, registry=registry)
         self.batcher = batcher
         self.model_id = model_id  # the BOOT model id; stats track gens
@@ -199,6 +209,18 @@ class ServeService:
         self._swap: Optional[_SwapTask] = None
         self._probation: Optional[dict] = None
         self.last_swap: Optional[dict] = None
+        # -- request tracing (serve/reqtrace.py) -------------------------
+        # Every score request gets a trace identity (locally minted when
+        # the wire carries none) so the slowest-N exemplar reservoir can
+        # name its keeps; ``sampled`` additionally gates tracer-span
+        # emission and the reply's trace_id echo. Stage timing feeds
+        # ``serve_stage_ms`` for EVERY completed request.
+        self._sampler = HeadSampler(trace_sample_rate)
+        self._minter = TraceIdMinter()
+        self._exemplars = ExemplarReservoir(max(int(exemplar_slots), 1))
+        self._exemplar_path = exemplar_path
+        self._exemplar_spilled_gen = 0
+        self._exemplar_last_spill = 0.0
         # boot marker for the status plane: generation + model id ride
         # a span (strings cannot ride the label-summed heartbeat totals)
         with trace.span("serve.generation", generation=1,
@@ -325,14 +347,43 @@ class ServeService:
                     # pin at admission: the response is scored entirely
                     # by the generation that was current RIGHT NOW,
                     # even if a flip lands while the work is queued
+                    recv_ns = time.perf_counter_ns()
+                    wire_tid = msg.get("trace_id")
+                    parent = msg.get("parent_span")
+                    if wire_tid is not None:
+                        # the caller (fleet router or a tracing client)
+                        # already decided to trace this request
+                        trace_id, sampled = str(wire_tid), True
+                    else:
+                        trace_id = self._minter.mint()
+                        sampled = self._sampler.should_sample()
+                    parent = str(parent) if parent is not None else None
                     pin = self.gens.pin()
                     work = ScoreWork(rows=list(msg.get("rows") or []),
                                      request_id=rid, reply=send,
-                                     generation=pin)
+                                     generation=pin,
+                                     trace_id=trace_id,
+                                     span_id=child_span_id(
+                                         trace_id, "serve.request",
+                                         parent or 0),
+                                     parent_span=parent,
+                                     sampled=sampled,
+                                     read_ns=recv_ns)
                     shed = self.batcher.submit(work)
                     if shed is not None:
                         self.gens.unpin(pin)
-                        send(error_response(rid, f"shed:{shed}"))
+                        send(error_response(
+                            rid, f"shed:{shed}",
+                            trace_id=trace_id if sampled else None))
+                        if sampled:
+                            trace.record_span(
+                                "serve.request", recv_ns,
+                                time.perf_counter_ns(),
+                                trace_id=trace_id,
+                                span_id=work.span_id,
+                                parent=parent,
+                                rows=len(work.rows),
+                                outcome=f"shed:{shed}")
                 elif kind == "swap":
                     self._request_swap(msg, send)
                 else:
@@ -376,10 +427,12 @@ class ServeService:
             if batch:
                 self._score_batch(batch)
             elif draining:
+                self._maybe_spill_exemplars(force=True)
                 return reason
             if not draining:
                 self._step_swap()
                 self._check_probation()
+                self._maybe_spill_exemplars()
             for scorer in self.gens.reap():
                 # the retired generation's last pinned batch drained:
                 # release its device rows (device loop = the only
@@ -393,10 +446,13 @@ class ServeService:
         # head's pin names the scorer for every work item (0 =
         # untagged direct submission: score against current)
         scorer = self.gens.scorer(batch[0].generation)
+        stages: dict = {}
         try:
             fault_point("serve.batch", tag=str(len(batch)))
             all_rows = [r for w in batch for r in w.rows]
-            scores, uids = scorer.score_records(all_rows)
+            formed_ns = time.perf_counter_ns()
+            scores, uids = scorer.score_records(all_rows, stages=stages)
+            scored_ns = time.perf_counter_ns()
         except InjectedFault:
             raise  # process-scoped: the clean-abort contract applies
         except clean_abort_types():
@@ -405,8 +461,16 @@ class ServeService:
             self._registry.counter("serve_errors").inc(
                 kind=type(e).__name__)
             for w in batch:
-                w.reply(error_response(w.request_id,
-                                       f"{type(e).__name__}: {e}"))
+                w.reply(error_response(
+                    w.request_id, f"{type(e).__name__}: {e}",
+                    trace_id=w.trace_id if w.sampled else None))
+                if w.sampled:
+                    trace.record_span(
+                        "serve.request", w.read_ns,
+                        time.perf_counter_ns(),
+                        trace_id=w.trace_id, span_id=w.span_id,
+                        parent=w.parent_span, rows=len(w.rows),
+                        outcome=f"error:{type(e).__name__}")
                 if w.generation:
                     self.gens.unpin(w.generation)
             return
@@ -425,12 +489,17 @@ class ServeService:
         off = 0
         for w in batch:
             k = len(w.rows)
+            reply_ns = time.perf_counter_ns()
             w.reply(scores_response(
                 w.request_id, scores[off:off + k],
-                uids[off:off + k] if uids is not None else None))
+                uids[off:off + k] if uids is not None else None,
+                trace_id=w.trace_id if w.sampled else None))
             if w.generation:
                 self.gens.unpin(w.generation)
             off += k
+            self._finish_request_trace(w, formed_ns, scored_ns,
+                                       stages, reply_ns,
+                                       time.perf_counter_ns())
 
     def _update_slo_gauges(self, now: float) -> None:
         """p50/p99/qps as process gauges: they ride every heartbeat's
@@ -447,6 +516,104 @@ class ServeService:
             float(np.percentile(lat, 50)))
         self._registry.gauge("serve_p99_ms").set(
             float(np.percentile(lat, 99)))
+
+    # -- request tracing -------------------------------------------------
+
+    def _finish_request_trace(self, w: ScoreWork, formed_ns: int,
+                              scored_ns: int, stages: dict,
+                              reply_ns: int, end_ns: int) -> None:
+        """One completed request's trace bookkeeping.
+
+        Always: one ``serve_stage_ms{stage}`` observation per stage per
+        request (ledger-consistent — sampling never gates stage
+        timing) and an offer to the slowest-N exemplar reservoir,
+        whose record carries the full stage-event tree whether or not
+        the request was head-sampled. When sampled: the
+        ``serve.request`` span plus stage children on the tracer
+        (``serve.queue_wait`` was already emitted at batch pickup).
+
+        ``tier_gather``/``device_score`` are batch-level costs — every
+        request in the batch waited on them, so each observes the full
+        duration; the span tree renders them as contiguous segments
+        after batch formation (an attribution convention, not a
+        per-request measurement).
+        """
+        gather_ns = int(stages.get("tier_gather", 0))
+        device_ns = int(stages.get("device_score", 0))
+        seq = w.span_id or 0
+        stage_spans = (
+            ("serve.queue_wait", w.enqueued_ns, w.picked_ns),
+            ("serve.batch_form", w.picked_ns, formed_ns),
+            ("serve.tier_gather", formed_ns, formed_ns + gather_ns),
+            ("serve.device_score", scored_ns - device_ns, scored_ns),
+            ("serve.reply", reply_ns, end_ns),
+        )
+        for name, s_ns, e_ns in stage_spans[1:]:
+            observe_stage(name[len("serve."):], (e_ns - s_ns) / 1e6,
+                          self._registry)
+            if w.sampled:
+                trace.record_span(
+                    name, s_ns, e_ns, depth=1,
+                    trace_id=w.trace_id,
+                    span_id=child_span_id(w.trace_id, name, seq),
+                    parent=w.span_id)
+        if w.sampled:
+            trace.record_span(
+                "serve.request", w.read_ns, end_ns,
+                trace_id=w.trace_id, span_id=w.span_id,
+                parent=w.parent_span, rows=len(w.rows), outcome="ok")
+        tracer = trace.get_tracer()
+        if tracer is None or self._exemplar_path is None:
+            return
+        tid = threading.get_ident()
+        events = [{"name": "serve.request",
+                   "tid": tid, "depth": 0,
+                   "ts_us": tracer.rel_ts_us(w.read_ns),
+                   "dur_us": (end_ns - w.read_ns) / 1e3,
+                   "labels": {"trace_id": w.trace_id,
+                              "span_id": w.span_id,
+                              "parent": w.parent_span,
+                              "rows": len(w.rows), "outcome": "ok"}}]
+        for name, s_ns, e_ns in stage_spans:
+            events.append({
+                "name": name, "tid": tid, "depth": 1,
+                "ts_us": tracer.rel_ts_us(s_ns),
+                "dur_us": (e_ns - s_ns) / 1e3,
+                "labels": {"trace_id": w.trace_id,
+                           "span_id": child_span_id(w.trace_id, name,
+                                                    seq),
+                           "parent": w.span_id}})
+        self._exemplars.offer(
+            (end_ns - w.read_ns) / 1e6,
+            {"trace_id": w.trace_id,
+             "request_id": str(w.request_id),
+             "sampled": w.sampled,
+             "latency_ms": (end_ns - w.read_ns) / 1e6,
+             "events": events})
+
+    def _maybe_spill_exemplars(self, force: bool = False) -> None:
+        """Rewrite ``exemplars.jsonl`` when the reservoir changed
+        (throttled to ~2Hz; atomic replace so readers never see a torn
+        file). The file is tiny — at most N exemplar records — and sits
+        next to ``spans.jsonl``, on the same tracer timeline."""
+        if self._exemplar_path is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._exemplar_last_spill < 0.5:
+            return
+        gen = self._exemplars.generation()
+        if gen == self._exemplar_spilled_gen:
+            return
+        self._exemplar_last_spill = now
+        self._exemplar_spilled_gen = gen
+        tmp = self._exemplar_path + ".tmp"
+        try:
+            with open(tmp, "w") as fh:
+                for rec in self._exemplars.snapshot():
+                    fh.write(json.dumps(rec) + "\n")
+            os.replace(tmp, self._exemplar_path)
+        except OSError:
+            pass  # drop-only: exemplar spill may never hurt serving
 
     # -- the hot-swap state machine -------------------------------------
 
@@ -794,6 +961,17 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--swap-max-probation-sheds", type=int, default=0,
                    help="sheds tolerated during probation before "
                         "rollback")
+    p.add_argument("--trace-sample-rate", type=float, default=0.05,
+                   help="head-sampling rate for request tracing: this "
+                        "fraction of direct-client score requests emit "
+                        "full stage-span trees (deterministic pacing, "
+                        "no RNG; wire-traced requests from the fleet "
+                        "router are always traced; 0 disables, 1 "
+                        "traces everything)")
+    p.add_argument("--trace-exemplar-slots", type=int, default=8,
+                   help="slowest-N exemplar reservoir size: the N "
+                        "slowest requests keep full stage traces in "
+                        "exemplars.jsonl regardless of the sample rate")
     p.add_argument("--max-serve-seconds", type=float, default=None,
                    help="scheduled stop: drain and exit 0 (SIGTERM "
                         "drains and exits 75 instead — requeue me)")
@@ -903,7 +1081,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             probation_secs=ns.swap_probation_seconds,
             probation_p99_pct=ns.swap_p99_regression_pct,
             probation_p99_min_ms=ns.swap_p99_min_delta_ms,
-            probation_max_sheds=ns.swap_max_probation_sheds)
+            probation_max_sheds=ns.swap_max_probation_sheds,
+            trace_sample_rate=ns.trace_sample_rate,
+            exemplar_slots=ns.trace_exemplar_slots,
+            exemplar_path=(os.path.join(ns.trace_dir,
+                                        "exemplars.jsonl")
+                           if ns.trace_dir else None))
         service.start()
         logger.info(f"serving {ns.model_id} on {service.endpoint} "
                     f"({len(scorer.stores)} tiered coordinate(s))")

@@ -38,8 +38,10 @@ the scoring tolerance absorbs bf16 rounding.
 
 from __future__ import annotations
 
+import time
 import weakref
 from collections import OrderedDict
+from typing import Optional
 
 import numpy as np
 
@@ -217,13 +219,25 @@ class TieredCoefficientStore:
 
     # -- the lookup -----------------------------------------------------
 
-    def lookup(self, raw_ids: np.ndarray) -> np.ndarray:
+    def lookup(self, raw_ids: np.ndarray,
+               stages: Optional[dict] = None) -> np.ndarray:
         """f32 coefficient row per request row (zeros for unknown
         entities), served device-first with promotion on host/model
-        hits. ``raw_ids`` is an object array of python strings."""
+        hits. ``raw_ids`` is an object array of python strings.
+
+        ``stages`` is the request-tracing stage accumulator
+        (``serve/reqtrace.py``): the store credits its own wall time —
+        tier resolution, promotion writes, the bucketed device gather —
+        to ``stages["tier_gather"]`` in ``perf_counter_ns``, so the
+        ``serve.tier_gather`` stage is attributed where the work
+        actually happens rather than guessed by the caller."""
+        t0 = time.perf_counter_ns()
         b = len(raw_ids)
         out = np.zeros((b, self.dim), np.float32)
         if b == 0 or len(self._ids) == 0:
+            if stages is not None:
+                stages["tier_gather"] = stages.get("tier_gather", 0) \
+                    + (time.perf_counter_ns() - t0)
             return out
         unique_ids, inverse = np.unique(
             np.asarray([str(x) for x in raw_ids], dtype=object),
@@ -288,6 +302,9 @@ class TieredCoefficientStore:
             elif ent in from_model:
                 out[row_idx] = self._block_np[from_model[ent]]
             # miss → stays zero (cold entity scores 0)
+        if stages is not None:
+            stages["tier_gather"] = stages.get("tier_gather", 0) \
+                + (time.perf_counter_ns() - t0)
         return out
 
     # -- introspection ---------------------------------------------------

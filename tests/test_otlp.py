@@ -261,7 +261,23 @@ class TestTotalsHistograms:
         h.observe(5.0, site="b")
         totals = registry.totals()
         assert totals["host_fetches"] == 4
-        assert totals["update_ms"] == {"count": 3, "sum": 8.0}
+        entry = totals["update_ms"]
+        assert entry["count"] == 3
+        assert entry["sum"] == 8.0
+        # labeled histograms additionally carry per-label-set records
+        # so heartbeat consumers can estimate per-label percentiles
+        by_site = {s["labels"]["site"]: s for s in entry["series"]}
+        assert by_site["a"]["count"] == 2 and by_site["a"]["sum"] == 3.0
+        assert by_site["b"]["count"] == 1 and by_site["b"]["sum"] == 5.0
+        assert by_site["a"]["min"] == 1.0 and by_site["a"]["max"] == 2.0
+        assert by_site["b"]["buckets"]["le_inf"] == 1
+
+    def test_totals_unlabeled_histogram_stays_compact(self):
+        registry = MetricsRegistry()
+        h = registry.histogram("plain_ms")
+        h.observe(1.0)
+        h.observe(3.0)
+        assert registry.totals()["plain_ms"] == {"count": 2, "sum": 4.0}
 
 
 class TestReportSchemaStability:

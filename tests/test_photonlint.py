@@ -2681,6 +2681,45 @@ def b(registry):
     assert [f.rule for f in report.suppressed] == ["WB04"]
 
 
+def test_wb00_loop_literal_span_table_resolved(tmp_path):
+    """The stage-span table idiom — ``for name, ... in <literal tuple
+    of tuples>`` feeding ``record_span(name, ...)`` — is statically
+    auditable: no WB00, each row's name registers as an emit (constant
+    slices respected), and a second loop reusing the same variable
+    without a telemetry call contributes nothing."""
+    src = """
+import trace
+
+
+def work(w):
+    stage_spans = (
+        ("stage.alpha", 1, 2),
+        ("stage.beta", 2, 3),
+        ("stage.gamma", 3, 4),
+    )
+    for name, s, e in stage_spans[1:]:
+        trace.record_span(name, s, e, tag="x")
+    events = []
+    for name, s, e in stage_spans:
+        events.append({"name": name})
+    return events
+
+
+def scan(rec):
+    return rec.get("name") == "stage.beta"
+"""
+    report = run_fixture(tmp_path, {"mod.py": src}, families={"WB"})
+    assert report.new == [], [f.format() for f in report.new]
+    # the sliced-away first row is NOT an emit: a consumer of it is a
+    # phantom, proving resolution honors the [1:] slice
+    orphan = src.replace('rec.get("name") == "stage.beta"',
+                         'rec.get("name") == "stage.alpha"')
+    report = run_fixture(tmp_path, {"mod.py": orphan}, families={"WB"})
+    assert rules_of(report) == ["WB03"], \
+        [f.format() for f in report.new]
+    assert '"stage.alpha"' in report.new[0].message
+
+
 def test_wb00_dynamic_name(tmp_path):
     src = """
 def work(registry, name):
@@ -2799,6 +2838,36 @@ def test_wb03_canary_photon_status_aux_read(tmp_path_factory):
             and '"serve_rows_scored"' in f.message]
     assert wb03, [f.format() for f in report.new]
     assert any(f.path == "tools/photon_status.py" for f in wb03)
+
+
+def test_wbxx_canary_renamed_queue_wait_span(tmp_path_factory):
+    """Renaming the batcher's ``serve.queue_wait`` span emit orphans
+    three corners at once: photon_status's per-request queue-wait fold
+    goes silently dark (WB03 at the aux consumer), the renamed emit is
+    undocumented (WB01 at the batcher), and the README taxonomy row
+    turns phantom (WB02)."""
+    root = _package_copy(tmp_path_factory, "wb_queue_wait_canary")
+    (root / "tools").mkdir()
+    shutil.copy(REPO_ROOT / "tools" / "photon_status.py",
+                root / "tools" / "photon_status.py")
+    batcher = root / "photon_ml_tpu" / "serve" / "batcher.py"
+    src = batcher.read_text()
+    assert '"serve.queue_wait"' in src, "batcher lost its span emit"
+    batcher.write_text(src.replace('"serve.queue_wait"',
+                                   '"serve.queue_wait_v2"'))
+    report = runner.lint(root, paths=["photon_ml_tpu"],
+                         readme=root / "README.md", baseline=BASELINE,
+                         families={"WB"})
+    wb03 = [f for f in report.new if f.rule == "WB03"
+            and '"serve.queue_wait"' in f.message]
+    assert wb03, [f.format() for f in report.new]
+    assert any(f.path == "tools/photon_status.py" for f in wb03)
+    wb01 = [f for f in report.new if f.rule == "WB01"
+            and "serve.queue_wait_v2" in f.message]
+    assert wb01 and all(
+        f.path == "photon_ml_tpu/serve/batcher.py" for f in wb01)
+    assert [f for f in report.new if f.rule == "WB02"
+            and "`serve.queue_wait`" in f.message]
 
 
 # -- incremental cache -------------------------------------------------------

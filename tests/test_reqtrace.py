@@ -1,0 +1,528 @@
+"""Request-scoped distributed tracing for the serve plane.
+
+Layers:
+- unit: ``TraceIdMinter`` determinism, ``child_span_id`` stability,
+  the pacing ``HeadSampler`` (no RNG — the sampled set is a pure
+  function of arrival order), the slowest-N ``ExemplarReservoir``,
+  and the always-on ``serve_stage_ms{stage}`` histogram feed
+- tools, synthetic fleet dir: ``trace_merge`` merges router/ +
+  member<k>/ run dirs into ONE document (per-member tracks,
+  start_unix alignment, unsampled exemplar folding) and
+  ``trace_report --request`` stitches a cross-process waterfall from
+  the propagated span ids
+- e2e acceptance: a REAL router + 2 scorer members under load; the
+  merged trace — rebuilt from the run dirs alone — contains a
+  client-traced request's span tree crossing client→router→member
+  with every batcher stage, the slowest requests survive as
+  exemplars regardless of the sample rate, ``serve_stage_ms`` totals
+  are consistent with the route ledger, and scores are bit-identical
+  traced vs untraced
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.obs.metrics import MetricsRegistry
+from photon_ml_tpu.serve.protocol import ServeClient
+from photon_ml_tpu.serve.reqtrace import (
+    STAGE_MS_BUCKETS,
+    ExemplarReservoir,
+    HeadSampler,
+    TraceIdMinter,
+    child_span_id,
+    observe_stage,
+)
+from test_fleet import fleet_fixture  # noqa: F401 — shared fleet ref
+from test_serve import (  # noqa: F401 — shared serving fixtures
+    _serve_args,
+    _spawn_serve,
+    _subprocess_env,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+PREEMPTED_EXIT = 75
+
+_HEX16 = "0123456789abcdef"
+
+
+# ---------------------------------------------------------------------------
+# unit: trace identity
+# ---------------------------------------------------------------------------
+
+
+class TestTraceIdMinter:
+    def test_seeded_minter_is_deterministic(self):
+        ma, mb = TraceIdMinter(seed="s"), TraceIdMinter(seed="s")
+        a = [ma.mint() for _ in range(3)]
+        b = [mb.mint() for _ in range(3)]
+        assert a == b
+        assert len(set(a)) == 3
+
+    def test_ids_are_16_hex_and_distinct(self):
+        m = TraceIdMinter(seed="x")
+        ids = {m.mint() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(i) == 16 and set(i) <= set(_HEX16) for i in ids)
+
+    def test_distinct_seeds_never_collide(self):
+        # two fleet members (distinct pids/seeds) mint disjoint ids
+        a = TraceIdMinter(seed="m0")
+        b = TraceIdMinter(seed="m1")
+        assert not {a.mint() for _ in range(32)} \
+            & {b.mint() for _ in range(32)}
+
+
+class TestChildSpanId:
+    def test_stable_and_16_hex(self):
+        sid = child_span_id("ab" * 8, "serve.queue_wait", 3)
+        assert sid == child_span_id("ab" * 8, "serve.queue_wait", 3)
+        assert len(sid) == 16 and set(sid) <= set(_HEX16)
+
+    def test_name_seq_and_trace_all_distinguish(self):
+        base = child_span_id("ab" * 8, "route.dispatch", 0)
+        assert child_span_id("ab" * 8, "route.dispatch", 1) != base
+        assert child_span_id("ab" * 8, "route.member_wait", 0) != base
+        assert child_span_id("cd" * 8, "route.dispatch", 0) != base
+
+
+class TestHeadSampler:
+    def test_rate_one_samples_everything(self):
+        s = HeadSampler(1.0)
+        assert all(s.should_sample() for _ in range(20))
+
+    def test_rate_zero_samples_nothing(self):
+        s = HeadSampler(0.0)
+        assert not any(s.should_sample() for _ in range(20))
+
+    def test_pacing_is_exactly_one_in_n(self):
+        # 0.25 fires on every 4th arrival — evenly spaced, no RNG
+        s = HeadSampler(0.25)
+        got = [s.should_sample() for _ in range(12)]
+        assert got == [False, False, False, True] * 3
+
+    def test_sampled_set_is_pure_function_of_arrival_order(self):
+        sa, sb = HeadSampler(0.05), HeadSampler(0.05)
+        a = [sa.should_sample() for _ in range(100)]
+        b = [sb.should_sample() for _ in range(100)]
+        assert a == b
+        assert sum(a) == 5
+
+    def test_out_of_range_rates_clamp(self):
+        assert HeadSampler(7.0).should_sample()
+        assert not HeadSampler(-1.0).should_sample()
+
+
+class TestExemplarReservoir:
+    def test_keeps_the_slowest_n(self):
+        r = ExemplarReservoir(n=3)
+        for ms in (5.0, 1.0, 9.0, 2.0, 7.0):
+            r.offer(ms, {"ms": ms})
+        assert [rec["ms"] for rec in r.snapshot()] == [9.0, 7.0, 5.0]
+
+    def test_fast_offer_rejected_when_full(self):
+        r = ExemplarReservoir(n=2)
+        assert r.offer(10.0, {}) and r.offer(20.0, {})
+        gen = r.generation()
+        assert not r.offer(1.0, {"fast": True})
+        assert r.generation() == gen  # rejection is not a dirty event
+        assert len(r) == 2
+
+    def test_generation_bumps_on_every_kept_offer(self):
+        r = ExemplarReservoir(n=2)
+        r.offer(1.0, {})
+        r.offer(2.0, {})
+        r.offer(3.0, {})  # evicts the 1.0 entry
+        assert r.generation() == 3
+        assert len(r) == 2
+
+    def test_non_positive_size_refused(self):
+        with pytest.raises(ValueError):
+            ExemplarReservoir(n=0)
+
+
+class TestObserveStage:
+    def test_stage_histogram_series_rides_totals(self):
+        reg = MetricsRegistry()
+        observe_stage("queue_wait", 0.2, reg)
+        observe_stage("queue_wait", 30.0, reg)
+        observe_stage("device_score", 3.0, reg)
+        totals = reg.totals()
+        hist = totals["serve_stage_ms"]
+        series = {s["labels"]["stage"]: s for s in hist["series"]}
+        assert series["queue_wait"]["count"] == 2
+        assert series["device_score"]["count"] == 1
+        # cumulative le-buckets over the sub-ms..multi-second range
+        qw = series["queue_wait"]["buckets"]
+        assert qw["le_0.25"] == 1 and qw["le_50"] == 2
+        assert STAGE_MS_BUCKETS[0] == 0.05
+
+
+# ---------------------------------------------------------------------------
+# tools on a synthetic fleet dir (no subprocesses, no jax)
+# ---------------------------------------------------------------------------
+
+CLIENT_PARENT = "f" * 16
+TID = "ab" * 8          # the cross-process request under test
+EX_TID = "cd" * 8       # unsampled exemplar-only trace
+SAMPLED_EX_TID = "ee" * 8
+
+
+def _x(name, ts, dur, tid=1, **labels):
+    return {"name": name, "cat": "photon", "ph": "X", "ts": ts,
+            "dur": dur, "pid": 0, "tid": tid, "args": labels}
+
+
+def _trace_doc(events, start_unix):
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"start_unix_time": start_unix}}
+
+
+def _write_fleet_dir(root) -> str:
+    """router/ + member0/ + member1/ run dirs holding one request's
+    cross-process span tree (ids derived exactly as the serve plane
+    derives them) plus member0 exemplars."""
+    fleet = os.path.join(str(root), "fleet")
+    rs = child_span_id(TID, "serve.request", CLIENT_PARENT)
+    ds = child_span_id(TID, "route.dispatch", 1)
+    ws = child_span_id(TID, "route.member_wait", 1)
+    ms = child_span_id(TID, "serve.request", ds)
+    router = [
+        _x("serve.request", 1000.0, 9000.0, trace_id=TID, span_id=rs,
+           parent=CLIENT_PARENT, rows=24, outcome="ok"),
+        _x("route.dispatch", 1500.0, 8000.0, trace_id=TID, span_id=ds,
+           parent=rs, shard=1, member=0, hops=1, outcome="ok"),
+        _x("route.member_wait", 2000.0, 7000.0, trace_id=TID,
+           span_id=ws, parent=ds, member=0),
+    ]
+    stage_at = {"serve.queue_wait": (2600.0, 400.0),
+                "serve.batch_form": (3000.0, 200.0),
+                "serve.tier_gather": (3200.0, 800.0),
+                "serve.device_score": (4000.0, 3000.0),
+                "serve.reply": (7000.0, 500.0)}
+    member0 = [_x("serve.request", 2500.0, 5200.0, trace_id=TID,
+                  span_id=ms, parent=ds, rows=14, outcome="ok")]
+    for name, (ts, dur) in stage_at.items():
+        member0.append(_x(name, ts, dur, trace_id=TID,
+                          span_id=child_span_id(TID, name, ms),
+                          parent=ms))
+    member1 = [_x("serve.request", 100.0, 50.0, trace_id="99" * 8,
+                  span_id=child_span_id("99" * 8, "serve.request", 0),
+                  parent="", rows=1, outcome="ok")]
+    starts = {"router": 1000.0, "member0": 1000.5, "member1": 1001.0}
+    for sub, events in (("router", router), ("member0", member0),
+                        ("member1", member1)):
+        d = os.path.join(fleet, sub)
+        os.makedirs(d)
+        with open(os.path.join(d, "trace.json"), "w") as fh:
+            json.dump(_trace_doc(events, starts[sub]), fh)
+    # member0 exemplars: one UNSAMPLED record (must be folded in) and
+    # one sampled record (already in the span stream — must NOT be)
+    def _ex(trace_id, sampled, ts):
+        evs = [{"name": "serve.request", "tid": 9, "depth": 0,
+                "ts_us": ts, "dur_us": 9000.0,
+                "labels": {"trace_id": trace_id,
+                           "span_id": child_span_id(
+                               trace_id, "serve.request", 0),
+                           "parent": "", "rows": 4, "outcome": "ok"}}]
+        for name in stage_at:
+            evs.append({"name": name, "tid": 9, "depth": 1,
+                        "ts_us": ts + 100.0, "dur_us": 500.0,
+                        "labels": {"trace_id": trace_id,
+                                   "span_id": child_span_id(
+                                       trace_id, name, 0),
+                                   "parent": evs[0]["labels"][
+                                       "span_id"]}})
+        return {"trace_id": trace_id, "request_id": "r1",
+                "sampled": sampled, "latency_ms": 9.0, "events": evs}
+    with open(os.path.join(fleet, "member0", "exemplars.jsonl"),
+              "w") as fh:
+        fh.write(json.dumps(_ex(EX_TID, False, 50_000.0)) + "\n")
+        fh.write(json.dumps(_ex(SAMPLED_EX_TID, True, 60_000.0)) + "\n")
+    return fleet
+
+
+def _run_tool(script, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, script), *args],
+        capture_output=True, text=True, cwd=_REPO, timeout=120)
+
+
+class TestTraceMergeFleetDir:
+    def test_fleet_dir_merges_to_one_aligned_document(self, tmp_path):
+        fleet = _write_fleet_dir(tmp_path)
+        out = str(tmp_path / "merged.json")
+        # no --fleet flag: the layout is auto-detected
+        res = _run_tool("trace_merge.py", fleet, "--out", out)
+        assert res.returncode == 0, res.stderr
+        doc = json.load(open(out))
+        other = doc["otherData"]
+        assert other["merged_processes"] == [0, 1, 2]
+        assert other["alignment"] == "start_unix"
+        names = {e["pid"]: e["args"]["name"]
+                 for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names[0].startswith("router (")
+        assert names[1].startswith("member0 (")
+        assert names[2].startswith("member1 (")
+        # clocks: member0 started 0.5s after the router, so its events
+        # shift +500000us onto the shared timeline
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        m0_req = [e for e in xs if e["pid"] == 1
+                  and e["args"].get("trace_id") == TID
+                  and e["name"] == "serve.request"]
+        assert len(m0_req) == 1
+        assert m0_req[0]["ts"] == pytest.approx(2500.0 + 500_000.0)
+        assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+
+    def test_unsampled_exemplars_fold_sampled_do_not(self, tmp_path):
+        fleet = _write_fleet_dir(tmp_path)
+        out = str(tmp_path / "merged.json")
+        res = _run_tool("trace_merge.py", fleet, "--fleet",
+                        "--out", out)
+        assert res.returncode == 0, res.stderr
+        xs = [e for e in json.load(open(out))["traceEvents"]
+              if e.get("ph") == "X"]
+        ex = [e for e in xs if e["args"].get("trace_id") == EX_TID]
+        assert len(ex) == 6  # serve.request + 5 stages, member0 track
+        assert {e["pid"] for e in ex} == {1}
+        assert not [e for e in xs
+                    if e["args"].get("trace_id") == SAMPLED_EX_TID]
+
+    def test_empty_dir_is_a_clean_failure(self, tmp_path):
+        res = _run_tool("trace_merge.py", str(tmp_path / "nothing"))
+        assert res.returncode == 2
+
+
+class TestTraceReportRequest:
+    def _merged(self, tmp_path) -> str:
+        fleet = _write_fleet_dir(tmp_path)
+        out = str(tmp_path / "merged.json")
+        assert _run_tool("trace_merge.py", fleet, "--out",
+                         out).returncode == 0
+        return out
+
+    def test_waterfall_crosses_processes(self, tmp_path):
+        res = _run_tool("trace_report.py", self._merged(tmp_path),
+                        "--request", TID, "--json")
+        assert res.returncode == 0, res.stderr
+        rep = json.loads(res.stdout)
+        assert rep["kind"] == "trace_report_request"
+        assert rep["trace_id"] == TID
+        [root] = rep["spans"]
+        assert root["name"] == "serve.request" and root["pid"] == 0
+        [dispatch] = root["children"]
+        assert dispatch["name"] == "route.dispatch"
+        assert dispatch["labels"]["shard"] == 1
+        kids = {c["name"]: c for c in dispatch["children"]}
+        assert set(kids) == {"route.member_wait", "serve.request"}
+        member_req = kids["serve.request"]
+        assert member_req["pid"] == 1  # the hop crossed processes
+        stages = [c["name"] for c in member_req["children"]]
+        assert stages == ["serve.queue_wait", "serve.batch_form",
+                          "serve.tier_gather", "serve.device_score",
+                          "serve.reply"]
+        # self-time: the parent's duration minus its children's
+        total_stage_us = sum(c["dur_us"]
+                             for c in member_req["children"])
+        assert member_req["self_us"] == pytest.approx(
+            member_req["dur_us"] - total_stage_us)
+
+    def test_exemplar_only_trace_resolves(self, tmp_path):
+        res = _run_tool("trace_report.py", self._merged(tmp_path),
+                        "--request", EX_TID, "--json")
+        assert res.returncode == 0, res.stderr
+        [root] = json.loads(res.stdout)["spans"]
+        assert root["name"] == "serve.request"
+        assert len(root["children"]) == 5
+
+    def test_unknown_trace_id_exits_2(self, tmp_path):
+        res = _run_tool("trace_report.py", self._merged(tmp_path),
+                        "--request", "0" * 16)
+        assert res.returncode == 2
+        assert "no spans" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: real router + 2 members, merged from run dirs alone
+# ---------------------------------------------------------------------------
+
+
+def _spawn_router(members, listen, trace, extra=()):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serve.router",
+         "--listen", listen, "--members", ",".join(members),
+         "--route-id", "userId", "--heartbeat-seconds", "0.1",
+         "--member-timeout", "15",
+         "--trace-dir", trace, "--trace-heartbeat-seconds", "0.2",
+         *extra],
+        env=_subprocess_env(), cwd=_REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("PHOTON_SERVE ready endpoint="):
+        proc.kill()
+        _, err = proc.communicate()
+        raise RuntimeError(f"router not ready: {line!r}\n{err[-2000:]}")
+    return proc, line.split("endpoint=", 1)[1]
+
+
+def _last_metric_totals(run_dir: str) -> dict:
+    totals: dict = {}
+    with open(os.path.join(run_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric_totals"):
+                totals = rec["metric_totals"]
+    return totals
+
+
+def _stage_counts(totals: dict) -> dict:
+    hist = totals.get("serve_stage_ms") or {}
+    return {s["labels"]["stage"]: s["count"]
+            for s in hist.get("series") or []}
+
+
+class TestDistributedTracingEndToEnd:
+    def test_fleet_request_trace_acceptance(self, fleet_fixture,
+                                            tmp_path):
+        """Router + 2 members under load with a TINY sample rate; the
+        merged trace — from the run dirs alone — resolves a traced
+        request's client→router→member tree with every stage, the
+        slowest requests are exemplars regardless of sampling, stage
+        totals agree with the route ledger, and tracing never touches
+        the bits."""
+        records = fleet_fixture["records"]
+        ref = fleet_fixture["ref"]
+        fleet = tmp_path / "fleet"
+        members, endpoints = [], []
+        router = None
+        client_tid = "ab" * 8
+        try:
+            for k in range(2):
+                proc, ep = _spawn_serve(_serve_args(
+                    fleet_fixture["model_dir"],
+                    "unix:" + str(tmp_path / f"m{k}.sock"),
+                    str(fleet / f"member{k}"),
+                    extra=["--trace-sample-rate", "0.05"]))
+                members.append(proc)
+                endpoints.append(ep)
+            router, endpoint = _spawn_router(
+                endpoints, "unix:" + str(tmp_path / "r.sock"),
+                str(fleet / "router"),
+                extra=["--trace-sample-rate", "0.05"])
+
+            with ServeClient(endpoint, timeout=60) as client:
+                # untraced load: at 0.05 almost none head-sampled,
+                # but EVERY request feeds stage timing + exemplars
+                plain = [client.score(records) for _ in range(12)]
+                # one client-traced request: wire context from the
+                # caller forces the full cross-process span tree
+                traced = client.score(records, trace_id=client_tid,
+                                      parent_span="f" * 16)
+            for resp in plain + [traced]:
+                assert resp["kind"] == "scores", resp
+            # bit-exactness: tracing on/off is invisible in the scores
+            np.testing.assert_array_equal(
+                np.asarray(traced["scores"], np.float64), ref)
+            for resp in plain:
+                np.testing.assert_array_equal(
+                    np.asarray(resp["scores"], np.float64), ref)
+            assert traced.get("trace_id") == client_tid
+
+            with ServeClient(endpoint) as client:
+                route = client.stats()["route"]
+
+            # drain everything so run dirs finalize (trace.json +
+            # exit metric snapshot + forced exemplar spill)
+            router.send_signal(signal.SIGTERM)
+            assert router.wait(timeout=60) == PREEMPTED_EXIT
+            router = None
+            for proc in members:
+                proc.send_signal(signal.SIGTERM)
+                assert proc.wait(timeout=60) == PREEMPTED_EXIT
+            members = []
+        finally:
+            for proc in members + ([router] if router else []):
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=30)
+
+        # 1. merge from the run dirs alone — one doc, 3 tracks
+        out = str(tmp_path / "merged.json")
+        res = _run_tool("trace_merge.py", str(fleet), "--out", out)
+        assert res.returncode == 0, res.stderr
+        doc = json.load(open(out))
+        assert doc["otherData"]["merged_processes"] == [0, 1, 2]
+        assert doc["otherData"]["alignment"] == "start_unix"
+
+        # 2. the client-traced request resolves client→router→member
+        res = _run_tool("trace_report.py", out, "--request",
+                        client_tid, "--json")
+        assert res.returncode == 0, res.stderr
+        [root] = json.loads(res.stdout)["spans"]
+        assert root["name"] == "serve.request"
+        assert root["labels"]["outcome"] == "ok"
+        dispatches = [c for c in root["children"]
+                      if c["name"] == "route.dispatch"]
+        assert dispatches, res.stdout
+        member_reqs = [c for d in dispatches for c in d["children"]
+                       if c["name"] == "serve.request"]
+        assert member_reqs, "no member-side request span linked"
+        assert all(m["pid"] != root["pid"] for m in member_reqs)
+        stage_names = {c["name"] for m in member_reqs
+                       for c in m["children"]}
+        assert {"serve.queue_wait", "serve.batch_form",
+                "serve.tier_gather", "serve.device_score",
+                "serve.reply"} <= stage_names
+
+        # 3. slowest requests survive as exemplars despite the 0.05
+        # rate: full stage trees, mostly unsampled
+        ex_records = []
+        for k in range(2):
+            path = fleet / f"member{k}" / "exemplars.jsonl"
+            assert path.exists(), f"member{k} spilled no exemplars"
+            with open(path) as fh:
+                ex_records += [json.loads(line) for line in fh
+                               if line.strip()]
+        assert ex_records
+        assert any(not r["sampled"] for r in ex_records)
+        for rec in ex_records:
+            assert len(rec["trace_id"]) == 16
+            assert [e["name"] for e in rec["events"]] == [
+                "serve.request", "serve.queue_wait",
+                "serve.batch_form", "serve.tier_gather",
+                "serve.device_score", "serve.reply"]
+
+        # 4. always-on stage totals are ledger-consistent: every
+        # routed sub-request produced exactly one member queue_wait
+        # observation and one router dispatch observation
+        router_stages = _stage_counts(
+            _last_metric_totals(str(fleet / "router")))
+        member_stages = [
+            _stage_counts(_last_metric_totals(
+                str(fleet / f"member{k}"))) for k in range(2)]
+        dispatched = router_stages.get("route.dispatch", 0)
+        assert dispatched == route.get("ok", 0) > 0
+        assert sum(m.get("queue_wait", 0)
+                   for m in member_stages) == dispatched
+        for m in member_stages:
+            # each member saw traffic, with a full stage split
+            assert {"queue_wait", "batch_form", "tier_gather",
+                    "device_score", "reply"} <= set(m)
+            assert len({m["queue_wait"], m["batch_form"],
+                        m["tier_gather"], m["device_score"],
+                        m["reply"]}) == 1

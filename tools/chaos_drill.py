@@ -395,6 +395,21 @@ def build_cells(smoke: bool) -> list[CellDef]:
                   "scores or a typed error, zero silent drops), "
                   "answered scores bit-exact, and the relaunched "
                   "member re-admits onto the live generation"),
+        # --- serve telemetry plane: fleet traffic with EVERY process
+        # --- (members + router) pointed at a permanently dead
+        # --- --telemetry-endpoint (a never-writable file: target —
+        # --- the terminal mode past the dead-socket fallback) — no
+        # --- fault spec, the dead consumer IS the chaos. Scores
+        # --- bit-exact, ledger clean, the only evidence
+        # --- telemetry_dropped{kind} counters ------------------------
+        cell("serve.telemetry", "dead_consumer",
+             "--telemetry-endpoint=<never-writable>", "ok",
+             serve=True, variant="fleet_dead_telemetry",
+             bit_exact=True, expect_drops=True,
+             note="fleet traffic under a permanently dead telemetry "
+                  "consumer: every request answers bit-exact, the "
+                  "route ledger stays clean, and the only evidence is "
+                  "telemetry_dropped counters in the run dirs"),
     ]
     if smoke:
         cells = [c for c in cells if c["smoke"]]
@@ -929,6 +944,9 @@ def _run_serve_cell(c: CellDef, workdir: str) -> dict:
             return _run_fleet_kill_cell(c, name, fix, cell_dir,
                                         failures, t0)
         return _run_fleet_cell(c, name, fix, cell_dir, failures, t0)
+    if c["point"] == "serve.telemetry":
+        return _run_fleet_dead_telemetry_cell(c, name, fix, cell_dir,
+                                              failures, t0)
     if c["point"] in ("serve.model_load", "serve.swap"):
         if expected == "killed":
             return _run_serve_swap_kill_cell(c, name, fix, cell_dir,
@@ -1077,7 +1095,8 @@ def _run_serve_kill_cell(c: CellDef, name: str, fix: dict, cell_dir: str,
 
 
 def _spawn_fleet_router(members: list[str], listen: str, trace: str,
-                        extra_env: dict | None = None):
+                        extra_env: dict | None = None,
+                        extra_args: list | None = None):
     """Start the fleet router subprocess, wait for its ready line
     (printed only after every reachable member admitted)."""
     env = dict(os.environ)
@@ -1088,7 +1107,8 @@ def _spawn_fleet_router(members: list[str], listen: str, trace: str,
         [sys.executable, "-m", "photon_ml_tpu.serve.router",
          "--listen", listen, "--members", ",".join(members),
          "--route-id", "userId", "--heartbeat-seconds", "0.1",
-         "--trace-dir", trace, "--trace-heartbeat-seconds", "0.2"],
+         "--trace-dir", trace, "--trace-heartbeat-seconds", "0.2",
+         *(extra_args or [])],
         env=env, cwd=_REPO, text=True,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     line = proc.stdout.readline().strip()
@@ -1176,6 +1196,120 @@ def _run_fleet_cell(c: CellDef, name: str, fix: dict, cell_dir: str,
     if "Traceback (most recent call last)" in err:
         failures.append("router stack-trace crash:\n" + err[-2000:])
     _check_trace_survives(os.path.join(cell_dir, "router"), failures)
+    return {"cell": name, "spec": c["spec"], "expected": c["expected"],
+            "rc": rc, "outcome": outcome, "note": c["note"],
+            "seconds": round(time.monotonic() - t0, 1),
+            "failures": failures, "passed": not failures}
+
+
+def _run_fleet_dead_telemetry_cell(c: CellDef, name: str, fix: dict,
+                                   cell_dir: str, failures: list[str],
+                                   t0: float) -> dict:
+    """The serve-plane dead-consumer drill: a 2-member fleet plus the
+    router, EVERY process pointed at a ``--telemetry-endpoint`` that
+    can never accept a record. A dead SOCKET consumer diverts to the
+    run-dir fallback stream (the training drill's standing posture),
+    so this cell arms the terminal mode instead: a ``file:`` target
+    whose parent is a regular file — every append fails ENOTDIR and
+    every batch is drop-counted. No fault spec is armed — the dead
+    consumer is the whole cell. Invariants: every request answers
+    bit-exact against the shared batch scoring core, the route ledger
+    shows zero errors/sheds, every process drains cleanly, and the
+    only evidence anything was wrong is a non-zero
+    ``telemetry_dropped`` total in each run dir."""
+    import numpy as np
+
+    from photon_ml_tpu.serve.protocol import ServeClient
+
+    blocked = os.path.join(cell_dir, "blocked")
+    with open(blocked, "w") as fh:
+        fh.write("not a directory\n")
+    dead = "file:" + os.path.join(blocked, "telemetry.jsonl")
+    members, endpoints = [], []
+    router = None
+    rc = None
+    outcome = "?"
+    try:
+        for k in range(2):
+            proc, ep = _spawn_serve(serve_args(
+                fix["model_dir"],
+                "unix:" + os.path.join(cell_dir, f"m{k}.sock"),
+                os.path.join(cell_dir, f"member{k}"),
+                extra=["--telemetry-endpoint", dead]))
+            members.append(proc)
+            endpoints.append(ep)
+        router, endpoint = _spawn_fleet_router(
+            endpoints, "unix:" + os.path.join(cell_dir, "router.sock"),
+            os.path.join(cell_dir, "router"),
+            extra_args=["--telemetry-endpoint", dead])
+        answered = 0
+        with ServeClient(endpoint) as client:
+            for i in range(6):
+                resp = client.score(fix["records"])
+                if resp.get("kind") != "scores":
+                    failures.append(f"request {i} not answered with "
+                                    f"scores: {str(resp)[:200]}")
+                    continue
+                answered += 1
+                if not np.array_equal(
+                        np.asarray(resp["scores"], np.float64),
+                        fix["ref"]):
+                    failures.append(f"request {i} NOT bit-exact vs "
+                                    f"the shared batch scoring core "
+                                    f"under the dead consumer")
+            route = client.stats().get("route") or {}
+        for bad in ("error", "shed"):
+            if route.get(bad):
+                failures.append(f"route ledger shows {bad}="
+                                f"{route[bad]} — a dead telemetry "
+                                f"consumer must not touch scoring")
+        # let at least one sink flush interval elapse so the dropped
+        # batches are counted and a heartbeat carries the totals out
+        time.sleep(1.0)
+        router.terminate()
+        rc = router.wait(timeout=90)
+        if rc != PREEMPTED_EXIT:
+            failures.append(f"router SIGTERM drain must exit "
+                            f"rc={PREEMPTED_EXIT}, got rc={rc}")
+        for proc in members:
+            proc.terminate()
+        for proc in members:
+            mrc = proc.wait(timeout=90)
+            if mrc != PREEMPTED_EXIT:
+                failures.append(f"member SIGTERM drain must exit "
+                                f"rc={PREEMPTED_EXIT}, got rc={mrc}")
+        dropped = {}
+        for role in ("member0", "member1", "router"):
+            dropped[role] = _serve_metric_total(
+                os.path.join(cell_dir, role), "telemetry_dropped")
+            if not dropped[role]:
+                failures.append(
+                    f"{role}: expected a non-zero telemetry_dropped "
+                    f"total as the dead-consumer evidence, got "
+                    f"{dropped[role]!r}")
+        outcome = (f"contained(answered={answered}, "
+                   f"dropped={dropped})")
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        failures.append(f"dead-telemetry cell harness error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        err = ""
+        if router is not None:
+            if router.poll() is None:
+                router.kill()
+            _, err = router.communicate()
+        for proc in members:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+    if "Traceback (most recent call last)" in err:
+        failures.append("router stack-trace crash:\n" + err[-2000:])
+    for role in ("member0", "member1", "router"):
+        _check_trace_survives(os.path.join(cell_dir, role), failures)
     return {"cell": name, "spec": c["spec"], "expected": c["expected"],
             "rc": rc, "outcome": outcome, "note": c["note"],
             "seconds": round(time.monotonic() - t0, 1),
@@ -1897,6 +2031,10 @@ def run_campaign(workdir: str, smoke: bool,
             "a dead collector leaves the OTLP bridge exit-0 with its "
             "batches dropped+counted, and the run it watches exit-0 "
             "and bit-exact (obs.otlp cells)",
+            "a permanently dead --telemetry-endpoint under fleet "
+            "traffic leaves every answer bit-exact and every process "
+            "draining cleanly, with only telemetry_dropped counters "
+            "as evidence (serve.telemetry cell)",
             "a scoring-service fault is connection-scoped: the service "
             "outlives its worst request/client, post-fault scores stay "
             "bit-identical to the shared batch core, and an injected "

@@ -247,6 +247,73 @@ def _as_int_label(value) -> int | None:
         return None
 
 
+def _bucket_percentile(series: dict, q: float):
+    """Upper-bound percentile estimate from one cumulative ``le``
+    bucket record (``{"count", "max", "buckets": {"le_X": cum}}``):
+    the smallest bucket bound covering ``q`` of the observations, or
+    the observed max when the quantile lands in the overflow bucket."""
+    count = int(series.get("count", 0) or 0)
+    buckets = series.get("buckets") or {}
+    if not count or not buckets:
+        return None
+    target = q * count
+    bounds = []
+    for key, cum in buckets.items():
+        if key == "le_inf":
+            continue
+        try:
+            bounds.append((float(key[len("le_"):]), cum))
+        except ValueError:
+            continue
+    for bound, cum in sorted(bounds):
+        if cum >= target:
+            return bound
+    return series.get("max")
+
+
+#: Request-pipeline order for the serve_stage_ms breakdown (member
+#: stages in wall-clock order, then the router's routing stages).
+_STAGE_ORDER = ("queue_wait", "batch_form", "tier_gather",
+                "device_score", "reply", "route.dispatch",
+                "route.member_wait")
+
+
+def _stage_key(stage: str):
+    try:
+        return (_STAGE_ORDER.index(stage), stage)
+    except ValueError:
+        return (len(_STAGE_ORDER), stage)
+
+
+def _stage_latency(totals: dict):
+    """Per-stage latency estimates from the ``serve_stage_ms{stage}``
+    histogram series riding the heartbeat ``metric_totals`` — the
+    ``photon_status --fleet`` per-stage breakdown needs no span
+    stream, just the compact per-heartbeat snapshot. The raw
+    cumulative buckets ride along so the fleet view can merge members
+    before estimating fleet-wide percentiles."""
+    entry = totals.get("serve_stage_ms")
+    if not isinstance(entry, dict):
+        return None
+    out = {}
+    for s in entry.get("series") or []:
+        stage = (s.get("labels") or {}).get("stage")
+        if stage is None:
+            continue
+        count = int(s.get("count", 0) or 0)
+        out[stage] = {
+            "count": count,
+            "sum": s.get("sum", 0.0),
+            "mean_ms": (round(s.get("sum", 0.0) / count, 3)
+                        if count else None),
+            "p50_ms": _bucket_percentile(s, 0.50),
+            "p99_ms": _bucket_percentile(s, 0.99),
+            "max_ms": s.get("max"),
+            "buckets": s.get("buckets") or {},
+        }
+    return out or None
+
+
 def _serving_status(p: dict, totals: dict):
     """The scoring-service sub-dict (photon_ml_tpu/serve): SLO gauges
     and shed/tier counters ride the heartbeat metric_totals; the model
@@ -255,9 +322,11 @@ def _serving_status(p: dict, totals: dict):
     the label-summed totals). None for processes that aren't serving."""
     gen_span = p.pop("_serve_gen", None)
     swap_span = p.pop("_serve_swap", None)
+    queue_wait = p.pop("_serve_queue_wait", None)
     if (totals.get("serve_rows_scored") is None
             and totals.get("serve_qps") is None
             and totals.get("serve_generation") is None
+            and totals.get("serve_stage_ms") is None
             and gen_span is None):
         return None
     generation = totals.get("serve_generation")
@@ -279,6 +348,13 @@ def _serving_status(p: dict, totals: dict):
         "last_swap": ({"outcome": swap_span.get("outcome"),
                        "reason": swap_span.get("reason") or ""}
                       if swap_span else None),
+        # per-stage request-pipeline latency (serve_stage_ms heartbeat
+        # series) plus the live sampled queue-wait spans — the
+        # "where inside the member did the time go" columns
+        "stages": _stage_latency(totals),
+        "queue_wait_spans": queue_wait["count"] if queue_wait else 0,
+        "queue_wait_max_ms": (round(queue_wait["max_us"] / 1e3, 3)
+                              if queue_wait else None),
     }
 
 
@@ -325,6 +401,15 @@ def compute_status(records: list[dict]) -> dict:
                 p["_serve_gen"] = labels
             elif rec.get("name") == "serve.swap":
                 p["_serve_swap"] = labels
+            elif rec.get("name") == "serve.queue_wait":
+                # sampled queue-wait stage spans: a live (if sampled)
+                # view of how long requests sit before batch pickup —
+                # the first stage to balloon when a member saturates
+                qw = p.setdefault("_serve_queue_wait",
+                                  {"count": 0, "max_us": 0.0})
+                qw["count"] += 1
+                qw["max_us"] = max(qw["max_us"],
+                                   float(rec.get("dur_us", 0.0) or 0.0))
         elif kind == "heartbeat":
             p["heartbeat"] = rec
             p["totals"].update(rec.get("metric_totals") or {})
@@ -594,11 +679,34 @@ def compute_fleet(fleet_dir: str) -> dict:
             "shed": serving.get("shed"),
             "generation": serving.get("generation"),
             "model_id": serving.get("model_id"),
+            "stages": serving.get("stages"),
         }
 
     fleet = [summarize(k, path) for k, path in sorted(members)]
     router_row = summarize("router", router) if router else None
     rows = fleet + ([router_row] if router_row else [])
+    # fleet-wide per-stage latency: merge every process's cumulative
+    # serve_stage_ms buckets (identical bounds by construction — one
+    # registration site), THEN estimate percentiles; averaging
+    # per-member percentiles would be wrong under skewed load
+    stage_agg: dict[str, dict] = {}
+    for r in rows:
+        for stage, s in (r.get("stages") or {}).items():
+            a = stage_agg.setdefault(stage, {"count": 0, "sum": 0.0,
+                                             "max": None, "buckets": {}})
+            a["count"] += s.get("count", 0) or 0
+            a["sum"] += s.get("sum", 0.0) or 0.0
+            if s.get("max_ms") is not None:
+                a["max"] = (s["max_ms"] if a["max"] is None
+                            else max(a["max"], s["max_ms"]))
+            for key, cum in (s.get("buckets") or {}).items():
+                a["buckets"][key] = a["buckets"].get(key, 0) + cum
+    for a in stage_agg.values():
+        a["mean_ms"] = (round(a["sum"] / a["count"], 3)
+                        if a["count"] else None)
+        a["p50_ms"] = _bucket_percentile(a, 0.50)
+        a["p99_ms"] = _bucket_percentile(a, 0.99)
+        a.pop("buckets")
     generations = sorted({r["generation"] for r in fleet
                           if r["generation"] is not None})
     agg = {
@@ -614,6 +722,7 @@ def compute_fleet(fleet_dir: str) -> dict:
         # >1 live generation = a split fleet — exactly what the
         # router's generation-checked re-admission prevents
         "generations": generations,
+        "stages": stage_agg or None,
     }
     if not rows:
         status, exit_code = "no_data", EXIT_NO_DATA
@@ -670,6 +779,19 @@ def format_fleet(status: dict, source: str) -> str:
         f"tier_hits={agg['tier_hits']:.0f} shed={agg['shed']:.0f} "
         f"generations={','.join(str(g) for g in gens) or '—'}"
         f"{' SPLIT-FLEET' if len(gens) > 1 else ''}")
+    stages = agg.get("stages")
+    if stages:
+        lines.append("  stage latency (serve_stage_ms, fleet-wide):")
+        lines.append(f"  {'stage':<18} {'count':>8} {'mean_ms':>8} "
+                     f"{'p50_ms':>8} {'p99_ms':>8} {'max_ms':>8}")
+        for stage in sorted(stages, key=_stage_key):
+            s = stages[stage]
+            lines.append(
+                f"  {stage:<18} {s['count']:>8} "
+                f"{s['mean_ms'] if s['mean_ms'] is not None else 0:>8.3f} "
+                f"{s['p50_ms'] if s['p50_ms'] is not None else 0:>8.3f} "
+                f"{s['p99_ms'] if s['p99_ms'] is not None else 0:>8.3f} "
+                f"{s['max'] if s['max'] is not None else 0:>8.3f}")
     return "\n".join(lines)
 
 

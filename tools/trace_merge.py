@@ -23,10 +23,21 @@ Alignment ladder (recorded in ``otherData.alignment``):
 3. ``none`` — raw concatenation with a warning (still loadable; the
    tracks just don't share a clock).
 
+A serve FLEET dir (``tools/photon_supervise.py --fleet``: ``router/``
+plus ``member<k>/`` run-dir subdirectories) merges the same way — one
+track per fleet process, detected automatically (or forced with
+``--fleet``). Serve processes never ``gang.form``, so fleet merges
+align on the ``start_unix`` rung. Each member's ``exemplars.jsonl``
+(the always-keep-slowest reservoir) contributes the span trees of its
+UNSAMPLED exemplar requests, so the slowest requests are on the merged
+timeline even when head sampling skipped them; sampled exemplars are
+already in the span stream and are not duplicated.
+
 Usage::
 
     python tools/trace_merge.py out/trace [--out merged_trace.json]
                                 [--anchor gang.form] [--from-spans]
+    python tools/trace_merge.py out/fleet [--fleet]
 
 Exit codes: 0 = merged document written, 2 = no per-process traces
 found / unreadable input.
@@ -42,6 +53,7 @@ import sys
 
 _TRACE_RE = re.compile(r"^trace(?:\.(\d+))?\.json$")
 _SPANS_RE = re.compile(r"^spans(?:\.(\d+))?\.jsonl$")
+_FLEET_SUB_RE = re.compile(r"^(?:router|member(\d+))$")
 
 DEFAULT_ANCHOR = "gang.form"
 
@@ -115,6 +127,76 @@ def discover_processes(run_dir: str, from_spans: bool = False
     return procs
 
 
+def _load_exemplar_events(path: str) -> list[dict]:
+    """UNSAMPLED exemplar records' span events → Chrome "X" events.
+    Sampled exemplars already live in the span stream (head sampling
+    let them through), so only the unsampled slowest-N trees — the
+    requests the sampler skipped — are added to the track."""
+    events: list[dict] = []
+    try:
+        fh = open(path)
+    except OSError:
+        return events
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from an in-flight rewrite
+            if not isinstance(rec, dict) or rec.get("sampled"):
+                continue
+            for e in rec.get("events") or []:
+                if not isinstance(e, dict) or "name" not in e \
+                        or "ts_us" not in e:
+                    continue
+                events.append({"name": e["name"], "cat": "photon",
+                               "ph": "X", "ts": e["ts_us"],
+                               "dur": e.get("dur_us", 0.0),
+                               "tid": e.get("tid", 0),
+                               "args": e.get("labels") or {}})
+    return events
+
+
+def discover_fleet(fleet_dir: str, from_spans: bool = False
+                   ) -> dict[int, dict]:
+    """:func:`discover_processes` over a supervisor fleet layout
+    (``router/`` + ``member<k>/`` run-dir subdirectories), flattened
+    onto sequential merged pids: router first, then members by index.
+    Each member's unsampled exemplar span trees join its track."""
+    subs: list[tuple[int, str]] = []
+    for name in sorted(os.listdir(fleet_dir)):
+        m = _FLEET_SUB_RE.match(name)
+        if m and os.path.isdir(os.path.join(fleet_dir, name)):
+            order = -1 if m.group(1) is None else int(m.group(1))
+            subs.append((order, name))
+    subs.sort()
+    procs: dict[int, dict] = {}
+    pid = 0
+    for _, sub in subs:
+        sub_dir = os.path.join(fleet_dir, sub)
+        try:
+            sub_procs = discover_processes(sub_dir, from_spans=from_spans)
+        except (OSError, ValueError):
+            continue  # a half-written member dir must not sink the rest
+        exemplars = _load_exemplar_events(
+            os.path.join(sub_dir, "exemplars.jsonl"))
+        for idx in sorted(sub_procs):
+            p = sub_procs[idx]
+            if exemplars:
+                # exemplar events share the serve process's tracer
+                # epoch, so they land on its (single-process) track
+                p["events"] = p["events"] + exemplars
+                exemplars = []
+            p["source"] = f"{sub}/{p['source']}"
+            p["role"] = sub if len(sub_procs) == 1 else f"{sub}.{idx}"
+            procs[pid] = p
+            pid += 1
+    return procs
+
+
 def _anchor_us(events: list[dict], anchor: str) -> float | None:
     """END of the process's FIRST anchor span (the gang-formation
     barrier: every process leaves ``jax.distributed.initialize`` at the
@@ -158,8 +240,9 @@ def merge(procs: dict[int, dict], anchor: str = DEFAULT_ANCHOR,
     out: list[dict] = []
     for i in sorted(procs):
         # one named, ordered track per process in the Perfetto UI
+        role = procs[i].get("role") or f"process {i}"
         out.append({"ph": "M", "name": "process_name", "pid": i,
-                    "args": {"name": f"process {i} "
+                    "args": {"name": f"{role} "
                                      f"({procs[i]['source']})"}})
         out.append({"ph": "M", "name": "process_sort_index", "pid": i,
                     "args": {"sort_index": i}})
@@ -196,9 +279,22 @@ def main(argv=None) -> int:
                    help="read the live spans[.i].jsonl spill instead of "
                         "the rebuilt trace[.i].json (a run still in "
                         "flight)")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat run_dir as a serve fleet dir (router/ + "
+                        "member<k>/ subdirectories); auto-detected when "
+                        "the dir itself holds no trace streams")
     ns = p.parse_args(argv)
     try:
-        procs = discover_processes(ns.run_dir, from_spans=ns.from_spans)
+        if ns.fleet:
+            procs = discover_fleet(ns.run_dir, from_spans=ns.from_spans)
+        else:
+            procs = discover_processes(ns.run_dir,
+                                       from_spans=ns.from_spans)
+            if not any(p_["events"] for p_ in procs.values()):
+                fleet = discover_fleet(ns.run_dir,
+                                       from_spans=ns.from_spans)
+                if fleet:
+                    procs = fleet
     except (OSError, ValueError) as e:
         print(f"trace_merge: cannot read {ns.run_dir}: {e}",
               file=sys.stderr)

@@ -20,12 +20,25 @@ device-plane view: per compile site, ``xla.compile`` span labels
 runtime span's self-time into a roofline-style achieved GF/s / GB/s
 column, plus retrace counts and the last retrace cause.
 
-Exit codes: 0 = report printed, 2 = unreadable/empty/invalid trace.
+``--request <trace_id>`` switches to the serve plane's request view:
+every span carrying that propagated ``trace_id`` label — across every
+process track of a ``trace_merge``'d fleet document — is stitched into
+one waterfall via its ``span_id``/``parent`` labels (NOT containment:
+the parent link crosses processes, client→router→member), with
+per-stage self-times so "where did this request's latency go" reads
+straight off the tree. Works on sampled spans and on the exemplar
+trees ``trace_merge --fleet`` folds in, so the slowest requests
+resolve regardless of the sample rate.
+
+Exit codes: 0 = report printed, 2 = unreadable/empty/invalid trace
+(or an unknown ``--request`` trace id).
 
 Usage::
 
     python tools/trace_report.py out/trace/trace.json [--top 15]
                                  [--process 0] [--json]
+    python tools/trace_report.py out/fleet/merged_trace.json \
+                                 --request 1f00ab34c55d9e21
 """
 
 from __future__ import annotations
@@ -195,6 +208,82 @@ def format_device_report(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def request_tree(events: list[dict], trace_id: str) -> list[dict]:
+    """The one request's spans as nested nodes (roots first, children
+    under ``"children"``, siblings by start time), stitched by the
+    propagated ``span_id``/``parent`` labels rather than containment —
+    the links cross process tracks in a merged fleet document.
+
+    Each node: ``{name, pid, ts, dur_us, self_us, labels, children}``.
+    Spans appearing twice (a sampled span AND its exemplar-tree copy)
+    dedup by ``span_id``. Self time is duration minus DIRECT children's
+    durations; a remote child (the member's ``serve.request`` under the
+    router's ``route.dispatch``) subtracts like a local one, so the
+    router's dispatch self-time reads as pure wire+routing overhead."""
+    by_id: dict[str, dict] = {}
+    for e in events:
+        args = e.get("args") or {}
+        if args.get("trace_id") != trace_id:
+            continue
+        sid = args.get("span_id")
+        if not sid or sid in by_id:
+            continue
+        by_id[sid] = {"name": e.get("name", ""),
+                      "pid": e.get("pid", 0),
+                      "ts": float(e.get("ts", 0.0)),
+                      "dur_us": float(e.get("dur", 0.0)),
+                      "self_us": float(e.get("dur", 0.0)),
+                      "labels": {k: v for k, v in args.items()
+                                 if k not in ("trace_id", "span_id",
+                                              "parent")},
+                      "parent": args.get("parent"),
+                      "children": []}
+    roots: list[dict] = []
+    for node in by_id.values():
+        parent = by_id.get(node["parent"] or "")
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+            parent["self_us"] = max(0.0,
+                                    parent["self_us"] - node["dur_us"])
+        else:
+            roots.append(node)
+    for node in by_id.values():
+        node["children"].sort(key=lambda n: n["ts"])
+        del node["parent"]
+    roots.sort(key=lambda n: n["ts"])
+    return roots
+
+
+def format_request(events: list[dict], trace_id: str) -> str | None:
+    """The --request waterfall (None when the trace id is unknown)."""
+    roots = request_tree(events, trace_id)
+    if not roots:
+        return None
+    t0 = min(n["ts"] for n in roots)
+    lines = [f"request {trace_id}:",
+             f"{'span':<40} {'pid':>4} {'start_ms':>9} {'dur_ms':>9} "
+             f"{'self_ms':>9}  detail",
+             "-" * 92]
+
+    def walk(node: dict, depth: int) -> None:
+        label = "  " * depth + node["name"]
+        detail = " ".join(
+            f"{k}={node['labels'][k]}"
+            for k in ("outcome", "rows", "shard", "member", "hops")
+            if k in node["labels"])
+        lines.append(
+            f"{label:<40} {node['pid']:>4} "
+            f"{(node['ts'] - t0) / 1e3:>9.3f} "
+            f"{node['dur_us'] / 1e3:>9.3f} "
+            f"{node['self_us'] / 1e3:>9.3f}  {detail}".rstrip())
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    return "\n".join(lines)
+
+
 def format_report(events: list[dict], top: int) -> str:
     lines = []
     stats = self_times(events)
@@ -274,6 +363,11 @@ def main(argv=None) -> int:
                         "span self-time for a roofline-style achieved "
                         "rate per compile site (needs a trace recorded "
                         "with --device-telemetry)")
+    p.add_argument("--request", default=None, metavar="TRACE_ID",
+                   help="serve-plane request view: the cross-process "
+                        "waterfall of one propagated trace id (stitched "
+                        "by span_id/parent labels across a merged fleet "
+                        "document) with per-stage self-times")
     ns = p.parse_args(argv)
     try:
         events = load_events(ns.trace)
@@ -290,6 +384,19 @@ def main(argv=None) -> int:
         print(f"trace_report: {ns.trace} holds no complete span "
               f"events{where}", file=sys.stderr)
         return 2
+    if ns.request is not None:
+        roots = request_tree(events, ns.request)
+        if not roots:
+            print(f"trace_report: no spans labeled trace_id="
+                  f"{ns.request} in {ns.trace}", file=sys.stderr)
+            return 2
+        if ns.json:
+            print(json.dumps({"kind": "trace_report_request",
+                              "trace_id": ns.request,
+                              "spans": roots}, indent=1))
+        else:
+            print(format_request(events, ns.request))
+        return 0
     if ns.json:
         doc = json_report(events, ns.top)
         if ns.device:
